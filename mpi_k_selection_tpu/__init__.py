@@ -1,0 +1,47 @@
+"""TPU-native k-selection framework.
+
+A brand-new framework with the capabilities of the reference
+``laertispappas/MPI-k-selection`` (a C/MPI CGM k-selection project), rebuilt
+idiomatically for TPU on JAX/XLA/Pallas:
+
+- exact 1-indexed k-th-element selection over large int/float arrays
+  (reference semantics: sort ascending, answer = element ``k-1`` —
+  ``kth-problem-seq.c:32-33``)
+- top-k and batched top-k
+- a sequential CPU oracle backend (``seq``), a multi-process CGM backend over
+  a native shared-memory collectives runtime (``mpi``), and the TPU backend
+  (``tpu``) built on radix-select histograms + XLA collectives over a device
+  mesh (replacing the reference's MPI_Scatterv/Gather/Bcast/Allreduce protocol,
+  ``TODO-kth-problem-cgm.c:103-293``).
+
+Public API::
+
+    import mpi_k_selection_tpu as ks
+    ks.kselect(x, k)              # exact k-th smallest (1-indexed), any backend
+    ks.topk(x, k)                 # top-k values (and indices)
+    ks.distributed_kselect(x, k)  # sharded over a jax.sharding.Mesh
+"""
+
+from mpi_k_selection_tpu.version import __version__
+from mpi_k_selection_tpu.ops.sort import sort_select
+from mpi_k_selection_tpu.ops.radix import radix_select
+from mpi_k_selection_tpu.ops.topk import topk, batched_topk
+from mpi_k_selection_tpu.api import kselect, median
+from mpi_k_selection_tpu.parallel import (
+    distributed_kselect,
+    distributed_radix_select,
+    distributed_cgm_select,
+)
+
+__all__ = [
+    "__version__",
+    "kselect",
+    "median",
+    "sort_select",
+    "radix_select",
+    "topk",
+    "batched_topk",
+    "distributed_kselect",
+    "distributed_radix_select",
+    "distributed_cgm_select",
+]

@@ -1,0 +1,5 @@
+import sys
+
+from mpi_k_selection_tpu.cli import main
+
+sys.exit(main())

@@ -1,0 +1,43 @@
+"""High-level selection API — algorithm dispatch on device arrays.
+
+The reference exposes its capability only as two ``main()`` programs
+(SURVEY.md §1: "the driver *is* the algorithm"). Here selection is a library
+function; the CLI (cli.py) and the backends are thin wrappers over this.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from mpi_k_selection_tpu.ops.radix import radix_select
+from mpi_k_selection_tpu.ops.sort import sort_select
+
+ALGORITHMS = ("auto", "radix", "sort")
+
+
+def kselect(x, k, *, algorithm: str = "auto", **kwargs):
+    """Exact k-th smallest element (1-indexed k, reference semantics:
+    ``kth-problem-seq.c:32-33``)."""
+    x = jnp.asarray(x)
+    if x.size == 0:
+        raise ValueError("kselect requires a non-empty input")
+    if isinstance(k, (int, np.integer)) and not 1 <= int(k) <= x.size:
+        # concrete k is validated here; traced k is clamped inside the ops
+        raise ValueError(f"k={k} out of range [1, {x.size}] (k is 1-indexed)")
+    if algorithm == "auto":
+        # sort is competitive only for small inputs; radix is O(n) passes.
+        algorithm = "sort" if x.size <= 1 << 14 else "radix"
+    if algorithm == "radix":
+        return radix_select(x, k, **kwargs)
+    if algorithm == "sort":
+        return sort_select(x, k)
+    raise ValueError(f"unknown algorithm {algorithm!r}; choose from {ALGORITHMS}")
+
+
+def median(x, **kwargs):
+    """Lower median: k = max(1, n//2), matching the reference's median
+    operating point ``k = N/2`` (``kth-problem-seq.c~:24``,
+    ``TODO-kth-problem-cgm.c~:48``)."""
+    x = jnp.asarray(x)
+    return kselect(x, max(1, x.size // 2), **kwargs)

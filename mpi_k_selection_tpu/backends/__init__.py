@@ -1,0 +1,21 @@
+"""Backend registry: ``seq`` (CPU oracle), ``tpu`` (JAX), ``mpi`` (native
+multi-process CGM) — the ``--backend={seq,mpi,tpu}`` surface mandated by the
+north star (BASELINE.json)."""
+
+BACKENDS = ("seq", "tpu", "mpi")
+
+
+def get_backend(name: str):
+    if name == "seq":
+        from mpi_k_selection_tpu.backends import seq
+
+        return seq
+    if name == "tpu":
+        from mpi_k_selection_tpu.backends import tpu
+
+        return tpu
+    if name == "mpi":
+        from mpi_k_selection_tpu.backends import mpi
+
+        return mpi
+    raise ValueError(f"unknown backend {name!r}; choose from {BACKENDS}")

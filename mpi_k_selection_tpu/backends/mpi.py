@@ -1,0 +1,33 @@
+"""MPI-parity backend (``--backend=mpi``) — multi-process CGM selection.
+
+Reproduces the reference's CGM weighted-median k-selection
+(``TODO-kth-problem-cgm.c:35-296``) as P local OS processes communicating
+through the framework's native shared-memory collectives runtime
+(native/shmcoll.cpp), the in-tree equivalent of the MPICH ``libmpi.so.12``
+the reference links. Lands with the native runtime build.
+"""
+
+from __future__ import annotations
+
+NAME = "mpi"
+
+_NOT_BUILT = (
+    "the mpi backend requires the native shared-memory collectives runtime; "
+    "build it with `python -m mpi_k_selection_tpu.native.build`"
+)
+
+
+def kselect(x, k: int, *, num_procs: int = 4, **kwargs):
+    try:
+        from mpi_k_selection_tpu.native import cgm_driver
+    except ImportError as e:
+        raise RuntimeError(_NOT_BUILT) from e
+
+    return cgm_driver.kselect(x, k, num_procs=num_procs, **kwargs)
+
+
+def median(x, **kwargs):
+    import numpy as np
+
+    x = np.asarray(x).ravel()
+    return kselect(x, max(1, x.size // 2), **kwargs)

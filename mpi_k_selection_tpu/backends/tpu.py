@@ -1,0 +1,45 @@
+"""TPU backend (``--backend=tpu``) — JAX/XLA execution.
+
+Single-chip selection dispatches to the radix/sort ops (ops/); when more than
+one device is visible and the input is large, selection runs sharded over a
+1-D device mesh via the distributed radix path (parallel/), which replaces
+the reference's MPI scatter/iterate/gather protocol
+(``TODO-kth-problem-cgm.c:103-293``) with XLA collectives over ICI.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mpi_k_selection_tpu import api
+
+NAME = "tpu"
+
+
+def kselect(x, k: int, *, algorithm: str = "auto", distribute: str = "auto", **kwargs):
+    """Exact k-th smallest (1-indexed). ``distribute`` in {auto, never, always}."""
+    n_dev = len(jax.devices())
+    n = np.asarray(x).size if not hasattr(x, "size") else x.size
+    use_mesh = {
+        "auto": n_dev > 1 and n >= 1 << 20 and n % n_dev == 0,
+        "never": False,
+        "always": n_dev > 1,
+    }[distribute]
+    if use_mesh:
+        from mpi_k_selection_tpu.parallel import radix as pradix
+
+        return pradix.distributed_radix_select(jnp.asarray(x), k, **kwargs)
+    return api.kselect(jnp.asarray(x), k, algorithm=algorithm, **kwargs)
+
+
+def topk(x, k: int, *, largest: bool = True, **kwargs):
+    from mpi_k_selection_tpu.ops.topk import topk as _topk
+
+    return _topk(jnp.asarray(x), k, largest=largest, **kwargs)
+
+
+def median(x, **kwargs):
+    x = jnp.asarray(x)
+    return kselect(x, max(1, x.size // 2), **kwargs)

@@ -1,0 +1,209 @@
+"""Command-line driver: ``python -m mpi_k_selection_tpu`` (or ``kselect``).
+
+The reference's entry points are two compiled binaries with every parameter a
+compile-time constant (``kth-problem-seq.c:7,24``; ``TODO-kth-problem-cgm.c:
+44-48`` — changing k meant recompiling, hence the ``~`` backup files). This
+CLI is the configurable replacement mandated by the north star:
+``--backend={seq,mpi,tpu}`` plus the full parameter surface, with defaults
+matching the reference constants (config.py).
+
+Examples::
+
+    # reference sequential operating point (N=1e8, k=250) on the CPU oracle
+    kselect --backend seq --n 100000000 --k 250
+
+    # TPU radix select, median of 1B int32
+    kselect --backend tpu --n 1000000000
+
+    # distributed CGM parity algorithm over all devices
+    kselect --backend tpu --algorithm cgm --n 16000000 --verify
+
+    # top-k mode (MoE-router config from BASELINE.md)
+    kselect --backend tpu --gen normal --dtype float32 --n 67108864 --topk 128
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+from mpi_k_selection_tpu import config
+from mpi_k_selection_tpu.utils import datagen
+from mpi_k_selection_tpu.utils.timing import ResultRecord, time_fn
+from mpi_k_selection_tpu.utils.x64 import maybe_x64
+
+DTYPES = ("int32", "int64", "uint32", "float32", "float64", "int16", "bfloat16")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="kselect",
+        description="TPU-native exact k-selection (capabilities of MPI-k-selection)",
+    )
+    p.add_argument("--backend", choices=("seq", "tpu", "mpi"), default="tpu")
+    p.add_argument("--n", type=int, default=1 << 20, help="number of elements")
+    p.add_argument(
+        "--k", type=int, default=None,
+        help="1-indexed rank (default: N/2, the reference's median operating point)",
+    )
+    p.add_argument("--gen", choices=datagen.PATTERNS, default="uniform")
+    p.add_argument("--dtype", choices=DTYPES, default="int32")
+    p.add_argument("--seed", type=int, default=config.DEFAULT_SEED)
+    p.add_argument(
+        "--algorithm", choices=("auto", "radix", "sort", "cgm"), default="auto",
+        help="selection algorithm (tpu backend); cgm is the reference-parity protocol",
+    )
+    p.add_argument(
+        "--distribute", choices=("auto", "never", "always"), default="auto",
+        help="shard over all devices (tpu backend)",
+    )
+    p.add_argument("--devices", type=int, default=None, help="mesh size cap")
+    p.add_argument(
+        "--num-procs", type=int, default=4,
+        help="process count for the mpi backend (reference: mpirun -np P)",
+    )
+    p.add_argument(
+        "--c", type=int, default=config.REFERENCE_C,
+        help="CGM coarseness constant (mpi backend; TODO-kth-problem-cgm.c:44)",
+    )
+    p.add_argument("--topk", type=int, default=None, help="return top-k instead of k-th")
+    p.add_argument("--smallest", action="store_true", help="top-k smallest instead of largest")
+    p.add_argument("--batch", type=int, default=None, help="batch dimension for top-k")
+    p.add_argument("--repeats", type=int, default=1)
+    p.add_argument("--verify", action="store_true", help="check against the seq oracle")
+    p.add_argument("--json", action="store_true", help="emit a JSON result record")
+    return p
+
+
+def _run_kth(args, x):
+    from mpi_k_selection_tpu.backends import get_backend
+
+    n = x.size
+    k = args.k if args.k is not None else max(1, n // 2)
+    if not 1 <= k <= n:
+        raise SystemExit(f"error: k={k} out of range [1, {n}]")
+    backend = get_backend(args.backend)
+    rounds = None
+    if args.backend == "seq":
+        fn = lambda: backend.kselect(x, k)
+    elif args.backend == "mpi":
+        fn = lambda: backend.kselect(x, k, num_procs=args.num_procs, c=args.c)
+    else:
+        import jax.numpy as jnp
+
+        xd = jnp.asarray(x)
+        if args.algorithm == "cgm":
+            from mpi_k_selection_tpu.parallel import distributed_cgm_select, make_mesh
+
+            mesh = make_mesh(args.devices)
+            fn = lambda: distributed_cgm_select(xd, k, mesh=mesh, return_rounds=True)
+        else:
+            fn = lambda: backend.kselect(
+                xd, k, algorithm=args.algorithm, distribute=args.distribute
+            )
+    seconds, answer = time_fn(fn, repeats=args.repeats, warmup=1 if args.backend == "tpu" else 0)
+    if isinstance(answer, tuple):  # cgm returns (value, rounds)
+        answer, rounds = answer
+        rounds = int(np.asarray(rounds))
+    answer = np.asarray(answer).item()
+    record = ResultRecord(
+        answer=answer,
+        n=n,
+        k=k,
+        backend=args.backend,
+        algorithm=args.algorithm,
+        dtype=args.dtype,
+        seconds=seconds,
+        n_devices=_device_count(args),
+        rounds=rounds,
+    )
+    ok = True
+    if args.verify:
+        from mpi_k_selection_tpu.backends import seq
+
+        want = np.asarray(seq.kselect(x, k)).item()
+        ok = answer == want
+        record.extra["oracle"] = want
+        record.extra["exact_match"] = ok
+    return record, ok
+
+
+def _run_topk(args, x):
+    k = args.topk
+    if args.backend == "seq":
+        from mpi_k_selection_tpu.backends import seq
+
+        fn = lambda: seq.topk(x, k, largest=not args.smallest)[0]
+    else:
+        import jax.numpy as jnp
+
+        from mpi_k_selection_tpu.ops.topk import topk as _topk
+
+        xd = jnp.asarray(x)
+        fn = lambda: _topk(xd, k, largest=not args.smallest)[0]
+    seconds, values = time_fn(fn, repeats=args.repeats, warmup=1 if args.backend != "seq" else 0)
+    values = np.asarray(values)
+    record = ResultRecord(
+        answer=values.ravel()[:8].tolist(),
+        n=x.size,
+        k=k,
+        backend=args.backend,
+        algorithm="topk",
+        dtype=args.dtype,
+        seconds=seconds,
+        n_devices=_device_count(args),
+    )
+    ok = True
+    if args.verify:
+        from mpi_k_selection_tpu.backends import seq
+
+        want, _ = seq.topk(x, k, largest=not args.smallest)
+        ok = np.array_equal(values, want)
+        record.extra["exact_match"] = ok
+    return record, ok
+
+
+def _device_count(args) -> int:
+    if args.backend == "seq":
+        return 1
+    if args.backend == "mpi":
+        return args.num_procs
+    import jax
+
+    n = len(jax.devices())
+    return min(n, args.devices) if args.devices else n
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.batch and args.topk is None:
+        raise SystemExit("error: --batch only applies to --topk mode")
+    if args.topk is not None and args.backend == "mpi":
+        raise SystemExit("error: the mpi backend does not support --topk")
+    x64_needed = args.dtype in ("int64", "float64")
+    try:
+        with maybe_x64(x64_needed):
+            batch = (args.batch,) if args.batch else ()
+            x = datagen.generate(
+                args.n, pattern=args.gen, seed=args.seed, dtype=args.dtype, batch=batch
+            )
+            if args.topk is not None:
+                record, ok = _run_topk(args, x)
+            else:
+                record, ok = _run_kth(args, x)
+    except (ValueError, RuntimeError) as e:
+        raise SystemExit(f"error: {e}") from e
+    if args.json:
+        print(record.to_json())
+    else:
+        record.print_reference_style()
+        if args.verify:
+            status = "exact match" if ok else "MISMATCH"
+            print(f"oracle check: {status}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

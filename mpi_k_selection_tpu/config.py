@@ -1,0 +1,20 @@
+"""Framework defaults, carrying over the reference's compile-time constants.
+
+The reference has no config system at all — every parameter is a compile-time
+constant and changing one means recompiling (SURVEY.md §5: ``SIZE_OF_SAMPLES``
+at ``kth-problem-seq.c:7``, ``MAX_NUMBERS``/``k``/``c`` at
+``TODO-kth-problem-cgm.c:44-48``; the ``~`` backup files exist precisely
+because ``k`` was edited between runs). Here they become defaults of a real
+CLI/config surface (cli.py).
+"""
+
+REFERENCE_N = 100_000_000  # SIZE_OF_SAMPLES (kth-problem-seq.c:7) == MAX_NUMBERS (TODO-…:46)
+REFERENCE_K_SEQ = 250  # kth-problem-seq.c:24
+REFERENCE_K_CGM = 150  # TODO-kth-problem-cgm.c:48
+REFERENCE_C = 500  # CGM coarseness constant c (TODO-kth-problem-cgm.c:44)
+
+# The CGM program aborts unless world_size >= 2 (TODO-kth-problem-cgm.c:56-59).
+MIN_DEVICES_DISTRIBUTED = 2
+
+DEFAULT_RADIX_BITS = 8
+DEFAULT_SEED = 0

@@ -1,0 +1,12 @@
+from mpi_k_selection_tpu.ops.sort import sort_select
+from mpi_k_selection_tpu.ops.radix import radix_select
+from mpi_k_selection_tpu.ops.topk import topk, batched_topk
+from mpi_k_selection_tpu.ops.histogram import masked_radix_histogram
+
+__all__ = [
+    "sort_select",
+    "radix_select",
+    "topk",
+    "batched_topk",
+    "masked_radix_histogram",
+]

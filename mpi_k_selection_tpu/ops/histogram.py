@@ -1,0 +1,127 @@
+"""Radix-digit histograms — the hot primitive of TPU k-selection.
+
+This replaces the reference's hot local compute: the per-shard ``qsort``
+(``TODO-kth-problem-cgm.c:115``, ``vector.c:239-241``) and the linear
+less/equal/greater counting sweep (``TODO-kth-problem-cgm.c:175-185``). On
+TPU, counting digit occurrences is the entire inner loop of radix select:
+per pass, ``hist[b] = #{ i : active(i) and digit(i) == b }`` where
+``digit(i) = (key >> shift) & (R-1)`` and ``active(i)`` means the key's
+higher bits equal the current prefix.
+
+Methods:
+
+- ``scatter`` — ``zeros(R).at[digit].add(1)``; best on CPU, where XLA lowers
+  it to a tight serial loop. Used by the unit-test/oracle path.
+- ``onehot`` — chunked compare-and-reduce: each chunk materializes
+  ``(chunk, R)`` equality bits in registers/VMEM and reduces over the chunk
+  axis. XLA fuses the compare into the reduction; on TPU this feeds the
+  VPU/MXU and streams the input at HBM bandwidth.
+- ``pallas`` — the hand-written TPU kernel (ops/pallas/histogram.py), used by
+  the production TPU path.
+
+Counts use ``count_dtype`` (int32 by default — exact for n < 2^31; pass int64
+under x64 for larger n, per SURVEY.md §7 "int overflow hygiene").
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _digit_and_mask(keys, shift, radix_bits, prefix):
+    kdt = keys.dtype
+    digits = jax.lax.shift_right_logical(keys, kdt.type(shift))
+    digits = (digits & kdt.type((1 << radix_bits) - 1)).astype(jnp.int32)
+    if prefix is None:
+        return digits, None
+    high = jax.lax.shift_right_logical(keys, kdt.type(shift + radix_bits))
+    return digits, high == jnp.asarray(prefix, kdt)
+
+
+def _hist_scatter(digits, mask, nbuckets, count_dtype):
+    if mask is None:
+        weights = jnp.ones(digits.shape, count_dtype)
+    else:
+        weights = mask.astype(count_dtype)
+    return jnp.zeros((nbuckets,), count_dtype).at[digits].add(weights)
+
+
+def _chunk_hist(digits, mask, nbuckets, count_dtype):
+    iota = jnp.arange(nbuckets, dtype=digits.dtype)
+    eq = digits[:, None] == iota[None, :]
+    if mask is not None:
+        eq = jnp.logical_and(eq, mask[:, None])
+    return jnp.sum(eq, axis=0, dtype=count_dtype)
+
+
+def _hist_onehot(digits, mask, nbuckets, count_dtype, chunk):
+    n = digits.shape[0]
+    main = (n // chunk) * chunk
+    hist = jnp.zeros((nbuckets,), count_dtype)
+    if main:
+        dm = digits[:main].reshape(-1, chunk)
+        mm = None if mask is None else mask[:main].reshape(-1, chunk)
+
+        def body(i, h):
+            m = None if mm is None else mm[i]
+            return h + _chunk_hist(dm[i], m, nbuckets, count_dtype)
+
+        hist = jax.lax.fori_loop(0, dm.shape[0], body, hist)
+    if n - main:
+        m = None if mask is None else mask[main:]
+        hist = hist + _chunk_hist(digits[main:], m, nbuckets, count_dtype)
+    return hist
+
+
+def resolve_hist_method(method: str) -> str:
+    if method != "auto":
+        return method
+    return "onehot" if jax.default_backend() == "tpu" else "scatter"
+
+
+@functools.partial(
+    jax.jit, static_argnames=("shift", "radix_bits", "method", "count_dtype", "chunk")
+)
+def masked_radix_histogram(
+    keys: jax.Array,
+    *,
+    shift: int,
+    radix_bits: int,
+    prefix=None,
+    method: str = "auto",
+    count_dtype=jnp.int32,
+    chunk: int = 32768,
+) -> jax.Array:
+    """Histogram of the ``radix_bits``-wide digit at ``shift`` over active keys.
+
+    ``keys`` must be unsigned (see utils/dtypes.py). An element is active when
+    ``keys >> (shift + radix_bits) == prefix``; ``prefix=None`` means all
+    elements are active (the first radix pass).
+    """
+    keys = keys.ravel()
+    nbuckets = 1 << radix_bits
+    digits, mask = _digit_and_mask(keys, shift, radix_bits, prefix)
+    method = resolve_hist_method(method)
+    if method == "scatter":
+        return _hist_scatter(digits, mask, nbuckets, count_dtype)
+    if method == "onehot":
+        return _hist_onehot(digits, mask, nbuckets, count_dtype, chunk)
+    if method == "pallas":
+        try:
+            from mpi_k_selection_tpu.ops.pallas.histogram import pallas_radix_histogram
+        except ImportError as e:
+            raise NotImplementedError(
+                "the pallas histogram kernel is not available in this build"
+            ) from e
+
+        return pallas_radix_histogram(
+            keys,
+            shift=shift,
+            radix_bits=radix_bits,
+            prefix=prefix,
+            count_dtype=count_dtype,
+        )
+    raise ValueError(f"unknown histogram method {method!r}")

@@ -1,0 +1,94 @@
+"""Exact k-selection by radix descent — the TPU-native core algorithm.
+
+This is the TPU replacement for the reference's selection engines: the
+sequential sort-then-index (``kth-problem-seq.c:32-33``), the hand-rolled
+quicksort partition (``vector.c:23-50``), and the CGM pivot-count-discard loop
+(``TODO-kth-problem-cgm.c:122-232``). Instead of physically discarding
+elements (``VecErase`` swap-deletes, ``TODO-…:204-225``) — impossible under
+XLA's static shapes — radix descent never moves data at all: each pass counts
+digit occurrences among the elements that still match the current bit prefix,
+narrows the prefix by ``radix_bits`` bits, and rescales k. After
+``key_bits / radix_bits`` passes the answer's bits are fully determined.
+
+Properties that make this the right TPU design (SURVEY.md §7):
+
+- fixed trip count (4 passes for 32-bit at radix 256) — no data-dependent
+  control flow, everything jits into one XLA program;
+- static shapes throughout — the "discard" is implicit in the prefix mask;
+- the only cross-pass state is (prefix, k): two scalars, so the distributed
+  version needs just one psum of the histogram per pass
+  (parallel/radix.py), mirroring how the reference's per-round traffic is
+  O(p) scalars (SURVEY.md §3.2) but with even fewer rounds.
+
+Exactness: counts are integer and exact, so the returned value is always the
+true k-th smallest (1-indexed, duplicates included) — the same guarantee the
+reference's ``L < k <= L+E`` test provides (``TODO-…:194``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from mpi_k_selection_tpu.ops.histogram import masked_radix_histogram
+from mpi_k_selection_tpu.utils import dtypes as _dt
+
+
+def select_count_dtype(n: int):
+    """int32 counts are exact for n < 2^31; beyond that int64 (requires x64)."""
+    if n < 2**31:
+        return jnp.int32
+    if not jax.config.jax_enable_x64:
+        raise ValueError(
+            f"n={n} needs int64 counters; enable jax_enable_x64 "
+            "(SURVEY.md §7: int overflow hygiene)"
+        )
+    return jnp.int64
+
+
+@functools.partial(jax.jit, static_argnames=("radix_bits", "hist_method", "chunk"))
+def radix_select(
+    x: jax.Array,
+    k,
+    *,
+    radix_bits: int = 8,
+    hist_method: str = "auto",
+    chunk: int = 32768,
+) -> jax.Array:
+    """Exact k-th smallest element of ``x`` (k is 1-indexed, reference semantics).
+
+    ``x`` may have any shape (flattened); ``k`` may be a traced scalar.
+    """
+    x = x.ravel()
+    n = x.shape[0]
+    total_bits = _dt.key_bits(x.dtype)
+    if total_bits % radix_bits:
+        raise ValueError(f"radix_bits={radix_bits} must divide key bits {total_bits}")
+    cdt = select_count_dtype(n)
+    u = _dt.to_sortable_bits(x)
+    kdt = u.dtype
+
+    kk = jnp.clip(jnp.asarray(k, cdt), 1, n)
+    prefix = None
+    for p in range(total_bits // radix_bits):
+        shift = total_bits - (p + 1) * radix_bits
+        hist = masked_radix_histogram(
+            u,
+            shift=shift,
+            radix_bits=radix_bits,
+            prefix=prefix,
+            method=hist_method,
+            count_dtype=cdt,
+            chunk=chunk,
+        )
+        cum = jnp.cumsum(hist)
+        bucket = jnp.argmax(cum >= kk)
+        kk = kk - (cum[bucket] - hist[bucket])
+        bkey = bucket.astype(kdt)
+        if prefix is None:
+            prefix = bkey
+        else:
+            prefix = jax.lax.shift_left(prefix, kdt.type(radix_bits)) | bkey
+    return _dt.from_sortable_bits(prefix, x.dtype)

@@ -1,0 +1,22 @@
+"""Sort-then-index selection — the XLA baseline and on-device oracle.
+
+Reproduces the reference's sequential semantics exactly: sort ascending and
+take element ``k-1`` (1-indexed k, ``kth-problem-seq.c:32-33``, via
+``VecQuickSort`` -> libc ``qsort``, ``vector.c:239-241``). O(n log n) — used
+as the correctness baseline that radix_select (O(n) passes) is tested and
+benchmarked against.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def sort_select(x: jax.Array, k) -> jax.Array:
+    """Exact k-th smallest (1-indexed) by full sort."""
+    x = x.ravel()
+    s = jax.lax.sort(x)
+    idx = jnp.clip(jnp.asarray(k, jnp.int32) - 1, 0, x.shape[0] - 1)
+    return jnp.take(s, idx)

@@ -1,0 +1,98 @@
+"""Top-k selection (values + indices), single and batched.
+
+The reference only ever returns the single k-th order statistic; top-k (the
+full set of k extreme elements) is the north-star extension covering the
+BASELINE.md configs "Single-chip top-k: N=64M float32, k=128 (MoE router
+logits)" and "Batched top-k: B=4096 x D=32768 float32, k=8 (beam-search /
+vocab top-k)".
+
+Implementation notes:
+
+- ``lax.top_k`` is the XLA baseline (operates on the last axis; leading axes
+  batch for free, so batched_topk is the same code path).
+- ``smallest``-k and unsigned dtypes are handled via the order-preserving
+  key transforms in utils/dtypes.py: build signed keys whose descending order
+  equals the requested order, top_k the keys, gather the original values.
+- ``method="chunked"`` is the two-stage large-D variant: split the last axis
+  into C chunks, take top-k per chunk (parallel, small sorts), then top-k of
+  the C*k candidates. For D >> k this does ~D + C*k work per row instead of
+  a single large-D top_k, and it is how the Pallas block kernel decomposes.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mpi_k_selection_tpu.utils import dtypes as _dt
+
+
+def _signed_keys(x: jax.Array, largest: bool) -> jax.Array:
+    """Keys whose *descending* signed order equals the requested value order."""
+    dtype = np.dtype(x.dtype)
+    if largest and (jnp.issubdtype(dtype, jnp.signedinteger) or dtype.kind == "f"):
+        return x  # lax.top_k compares these natively
+    u = _dt.to_sortable_bits(x)
+    kdt = u.dtype
+    bits = _dt.key_bits(dtype)
+    if not largest:
+        u = ~u
+    msb = kdt.type(np.uint64(1) << np.uint64(bits - 1))
+    signed = np.dtype(f"int{bits}")
+    return jax.lax.bitcast_convert_type(u ^ msb, signed)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "largest", "method", "num_chunks"))
+def topk(
+    x: jax.Array,
+    k: int,
+    *,
+    largest: bool = True,
+    method: str = "auto",
+    num_chunks: int | None = None,
+):
+    """Top-k along the last axis. Returns ``(values, indices)`` sorted by rank.
+
+    ``largest=False`` returns the k smallest (ascending). Leading axes batch.
+    """
+    d = x.shape[-1]
+    if not 1 <= k <= d:
+        raise ValueError(f"k={k} out of range for last axis of size {d}")
+    keys = _signed_keys(x, largest)
+    if method == "auto":
+        method = "chunked" if d >= 1 << 16 and d >= 64 * k else "flat"
+    if method == "flat":
+        _, idx = jax.lax.top_k(keys, k)
+    elif method == "chunked":
+        c = num_chunks or _pick_num_chunks(d, k)
+        if c <= 1 or d % c:
+            _, idx = jax.lax.top_k(keys, k)
+        else:
+            sub = d // c
+            kk = keys.reshape(*keys.shape[:-1], c, sub)
+            subvals, subidx = jax.lax.top_k(kk, min(k, sub))
+            base = jnp.arange(c, dtype=subidx.dtype)[:, None] * sub
+            cand_idx = (subidx + base).reshape(*keys.shape[:-1], -1)
+            cand_vals = subvals.reshape(*keys.shape[:-1], -1)
+            _, pos = jax.lax.top_k(cand_vals, k)
+            idx = jnp.take_along_axis(cand_idx, pos, axis=-1)
+    else:
+        raise ValueError(f"unknown topk method {method!r}")
+    values = jnp.take_along_axis(x, idx, axis=-1)
+    return values, idx
+
+
+def _pick_num_chunks(d: int, k: int) -> int:
+    """Largest power-of-two chunk count with chunk size >= max(256, 2k)."""
+    c = 1
+    while d % (c * 2) == 0 and d // (c * 2) >= max(256, 2 * k):
+        c *= 2
+    return c
+
+
+def batched_topk(x: jax.Array, k: int, **kwargs):
+    """Alias for :func:`topk` on ``(..., D)`` arrays (BASELINE batched config)."""
+    return topk(x, k, **kwargs)

@@ -1,0 +1,31 @@
+"""Distributed selection over a jax.sharding.Mesh (ICI/DCN collectives)."""
+
+from mpi_k_selection_tpu.parallel.cgm import distributed_cgm_select
+from mpi_k_selection_tpu.parallel.mesh import make_mesh, require_distributed, shard_1d
+from mpi_k_selection_tpu.parallel.radix import distributed_radix_select
+
+DISTRIBUTED_ALGORITHMS = ("radix", "cgm")
+
+
+def distributed_kselect(x, k, *, algorithm: str = "radix", mesh=None, **kwargs):
+    """Exact k-th smallest of ``x`` sharded over ``mesh`` (all devices by
+    default). ``algorithm='radix'`` is the flagship fixed-round path;
+    ``'cgm'`` is the reference-parity weighted-median iteration."""
+    if algorithm == "radix":
+        return distributed_radix_select(x, k, mesh=mesh, **kwargs)
+    if algorithm == "cgm":
+        return distributed_cgm_select(x, k, mesh=mesh, **kwargs)
+    raise ValueError(
+        f"unknown distributed algorithm {algorithm!r}; choose from {DISTRIBUTED_ALGORITHMS}"
+    )
+
+
+__all__ = [
+    "distributed_kselect",
+    "distributed_radix_select",
+    "distributed_cgm_select",
+    "make_mesh",
+    "require_distributed",
+    "shard_1d",
+    "DISTRIBUTED_ALGORITHMS",
+]

@@ -1,0 +1,69 @@
+"""Device mesh construction — the process-group layer.
+
+Replaces the reference's MPI process-group bootstrap
+(``MPI_Init/Comm_size/Comm_rank``, ``TODO-kth-problem-cgm.c:53-61``) with a
+1-D ``jax.sharding.Mesh`` over all visible devices. The reference's
+``world_size >= 2`` guard (``MPI_Abort`` at ``TODO-…:56-59``) is mirrored as
+a clean error in :func:`require_distributed`.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from mpi_k_selection_tpu import config
+
+DATA_AXIS = "data"
+
+
+def make_mesh(n_devices: int | None = None, axis_name: str = DATA_AXIS) -> Mesh:
+    """1-D mesh over the first `n_devices` devices (all by default)."""
+    devices = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(f"requested {n_devices} devices, have {len(devices)}")
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (axis_name,))
+
+
+def require_distributed(mesh: Mesh) -> None:
+    """Mirror of the reference's world_size >= 2 guard (TODO-…:56-59)."""
+    if mesh.size < config.MIN_DEVICES_DISTRIBUTED:
+        raise ValueError(
+            f"distributed selection needs >= {config.MIN_DEVICES_DISTRIBUTED} "
+            f"devices, got {mesh.size} (reference aborts the same way: "
+            "TODO-kth-problem-cgm.c:56-59)"
+        )
+
+
+def shard_1d(x: jax.Array, mesh: Mesh) -> jax.Array:
+    """Place a 1-D array block-sharded over the mesh (the Scatterv analogue,
+    TODO-kth-problem-cgm.c:103 — here a zero-copy sharding annotation)."""
+    return jax.device_put(x, NamedSharding(mesh, P(mesh.axis_names[0])))
+
+
+def pad_to_multiple(x, multiple: int):
+    """Pad 1-D `x` to a multiple of `multiple` with order-maximal sentinels.
+
+    Balanced block distribution analogue of ``TODO-…:81-100``: XLA needs equal
+    shards, so instead of first-(N%p)-ranks-get-one-extra we pad with values
+    whose radix keys are all-ones (the dtype's order-maximum). Safe for
+    selection as long as 1 <= k <= len(x): the sentinels occupy only the top
+    ranks, and cumulative counts reach k within real elements first (see
+    ops/radix.py docstring).
+    """
+    import jax.numpy as jnp
+
+    from mpi_k_selection_tpu.utils import dtypes as _dt
+
+    n = x.shape[0]
+    rem = n % multiple
+    if rem == 0:
+        return x, n
+    pad = multiple - rem
+    kdt = _dt.key_dtype(x.dtype)
+    ones = np.array(~np.uint64(0), dtype=np.uint64).astype(kdt)
+    sentinel = _dt.from_sortable_bits(jnp.full((pad,), ones, dtype=kdt), x.dtype)
+    return jnp.concatenate([x, sentinel]), n

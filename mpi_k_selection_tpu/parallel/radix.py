@@ -1,0 +1,95 @@
+"""Distributed radix k-selection over a device mesh — the flagship path.
+
+The TPU-native replacement for the reference's entire CGM protocol
+(``TODO-kth-problem-cgm.c:103-293``). Where the reference scatters data,
+iterates gather-medians -> bcast-pivot -> count -> allreduce -> physically
+discard, and finally gathers survivors to rank 0, this path:
+
+- keeps every shard resident in HBM and never moves an element
+  (the reference's only bulk transfers — initial Scatterv ``:103`` and final
+  Gatherv ``:270`` — become a one-time sharding annotation and nothing);
+- runs a fixed number of histogram passes (key_bits / radix_bits); each pass
+  is one local Pallas/XLA histogram + one ``lax.psum`` of the bucket counts
+  over the ICI mesh — the direct analogue of the single
+  ``MPI_Allreduce(leg, 3, SUM)`` at ``TODO-…:190``, except 4 rounds total
+  instead of O(log N) rounds;
+- computes the bucket walk replicated on every device (the reference computes
+  the weighted median only on rank 0 and broadcasts, ``:139-168``; SPMD
+  replication makes the Bcast implicit).
+
+Per-pass communication is one small vector of counts, independent of N —
+the same "O(p) scalars per round" property SURVEY.md §3.2 identifies as the
+reference's key design feature, mapped onto ICI collectives.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from mpi_k_selection_tpu.ops.histogram import masked_radix_histogram
+from mpi_k_selection_tpu.ops.radix import select_count_dtype
+from mpi_k_selection_tpu.parallel import mesh as mesh_lib
+from mpi_k_selection_tpu.utils import dtypes as _dt
+
+
+def _shard_map(fn, mesh, in_specs, out_specs):
+    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+
+
+def distributed_radix_select(
+    x: jax.Array,
+    k,
+    *,
+    mesh=None,
+    radix_bits: int = 8,
+    hist_method: str = "auto",
+    chunk: int = 32768,
+):
+    """Exact k-th smallest (1-indexed) of sharded ``x``; replicated scalar out."""
+    if mesh is None:
+        mesh = mesh_lib.make_mesh()
+    mesh_lib.require_distributed(mesh)
+    axis = mesh.axis_names[0]
+
+    x = jnp.ravel(jnp.asarray(x))
+    x, n = mesh_lib.pad_to_multiple(x, mesh.size)
+    cdt = select_count_dtype(n)
+    total_bits = _dt.key_bits(x.dtype)
+    if total_bits % radix_bits:
+        raise ValueError(f"radix_bits={radix_bits} must divide {total_bits}")
+
+    def shard_fn(xs, kk):
+        u = _dt.to_sortable_bits(xs.ravel())
+        kdt = u.dtype
+        kk = jnp.clip(kk.astype(cdt), 1, n)
+        prefix = None
+        for p in range(total_bits // radix_bits):
+            shift = total_bits - (p + 1) * radix_bits
+            local = masked_radix_histogram(
+                u,
+                shift=shift,
+                radix_bits=radix_bits,
+                prefix=prefix,
+                method=hist_method,
+                count_dtype=cdt,
+                chunk=chunk,
+            )
+            hist = jax.lax.psum(local, axis)  # the MPI_Allreduce analogue (TODO-…:190)
+            cum = jnp.cumsum(hist)
+            bucket = jnp.argmax(cum >= kk)
+            kk = kk - (cum[bucket] - hist[bucket])
+            bkey = bucket.astype(kdt)
+            if prefix is None:
+                prefix = bkey
+            else:
+                prefix = jax.lax.shift_left(prefix, kdt.type(radix_bits)) | bkey
+        return _dt.from_sortable_bits(prefix, xs.dtype)
+
+    fn = _shard_map(shard_fn, mesh, in_specs=(P(axis), P()), out_specs=P())
+    xs = jax.device_put(x, NamedSharding(mesh, P(axis)))
+    kk = jnp.asarray(k, cdt)
+    return jax.jit(fn)(xs, kk)

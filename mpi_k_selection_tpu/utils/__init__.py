@@ -1,0 +1,3 @@
+from mpi_k_selection_tpu.utils import datagen, dtypes, timing
+
+__all__ = ["datagen", "dtypes", "timing"]

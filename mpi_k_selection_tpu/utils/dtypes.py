@@ -1,0 +1,106 @@
+"""Order-preserving bit transforms for radix selection.
+
+Radix select works on unsigned keys whose numeric order equals the order of
+the original values. This module maps every supported dtype to such keys and
+back, so one selection kernel serves int8/16/32/64, uint*, bfloat16,
+float16/32/64.
+
+The reference operates only on C ``int`` (``vector.h:7-11``); supporting the
+wider dtype set is part of the north-star scope (BASELINE.json configs use
+int32, int64 and float32).
+
+Transform rules (classic radix-sort tricks):
+- signed int  -> flip the sign bit: ``u = bits(x) ^ MSB``
+- unsigned    -> identity
+- float       -> if sign bit set, flip all bits; else set the sign bit.
+  This orders -inf < ... < -0.0 < +0.0 < ... < +inf < +NaN, matching
+  ``np.sort`` for NaN-free data (NaNs with the sign bit clear sort last like
+  NumPy; negative-NaN bit patterns sort first — documented deviation).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# dtype -> (unsigned key dtype, total bits)
+_KEY_INFO = {
+    np.dtype(np.int8): (np.uint8, 8),
+    np.dtype(np.uint8): (np.uint8, 8),
+    np.dtype(np.int16): (np.uint16, 16),
+    np.dtype(np.uint16): (np.uint16, 16),
+    np.dtype(np.int32): (np.uint32, 32),
+    np.dtype(np.uint32): (np.uint32, 32),
+    np.dtype(np.int64): (np.uint64, 64),
+    np.dtype(np.uint64): (np.uint64, 64),
+    np.dtype(np.float16): (np.uint16, 16),
+    np.dtype(jnp.bfloat16): (np.uint16, 16),
+    np.dtype(np.float32): (np.uint32, 32),
+    np.dtype(np.float64): (np.uint64, 64),
+}
+
+
+def key_dtype(dtype) -> np.dtype:
+    """Unsigned key dtype used for radix passes over `dtype`."""
+    dtype = np.dtype(dtype)
+    if dtype not in _KEY_INFO:
+        raise TypeError(f"unsupported dtype for k-selection: {dtype}")
+    return np.dtype(_KEY_INFO[dtype][0])
+
+
+def key_bits(dtype) -> int:
+    """Total number of key bits for `dtype`."""
+    dtype = np.dtype(dtype)
+    if dtype not in _KEY_INFO:
+        raise TypeError(f"unsupported dtype for k-selection: {dtype}")
+    return _KEY_INFO[dtype][1]
+
+
+def _require_x64(dtype):
+    if np.dtype(dtype).itemsize == 8 and not jax.config.jax_enable_x64:
+        raise ValueError(
+            f"{np.dtype(dtype)} selection requires 64-bit mode; enable it via "
+            "jax.config.update('jax_enable_x64', True) or the "
+            "jax.experimental.enable_x64() context manager"
+        )
+
+
+def to_sortable_bits(x: jax.Array) -> jax.Array:
+    """Map `x` to unsigned keys with the same ordering."""
+    dtype = np.dtype(x.dtype)
+    kdt, bits = _KEY_INFO.get(dtype, (None, None))
+    if kdt is None:
+        raise TypeError(f"unsupported dtype for k-selection: {dtype}")
+    _require_x64(dtype)
+    kdt = np.dtype(kdt)
+    msb = np.array(1, dtype=np.uint64) << np.uint64(bits - 1)
+    msb = kdt.type(msb)
+    if jnp.issubdtype(dtype, jnp.unsignedinteger):
+        return x
+    u = jax.lax.bitcast_convert_type(x, kdt)
+    if jnp.issubdtype(dtype, jnp.signedinteger):
+        return u ^ msb
+    # floating point
+    all_ones = kdt.type(~np.uint64(0) >> np.uint64(64 - bits))
+    neg = (u >> kdt.type(bits - 1)) != kdt.type(0)
+    return jnp.where(neg, u ^ all_ones, u | msb)
+
+
+def from_sortable_bits(u: jax.Array, dtype) -> jax.Array:
+    """Inverse of :func:`to_sortable_bits`."""
+    dtype = np.dtype(dtype)
+    kdt, bits = _KEY_INFO.get(dtype, (None, None))
+    if kdt is None:
+        raise TypeError(f"unsupported dtype for k-selection: {dtype}")
+    kdt = np.dtype(kdt)
+    u = u.astype(kdt)
+    msb = kdt.type(np.uint64(1) << np.uint64(bits - 1))
+    if jnp.issubdtype(dtype, jnp.unsignedinteger):
+        return u
+    if jnp.issubdtype(dtype, jnp.signedinteger):
+        return jax.lax.bitcast_convert_type(u ^ msb, dtype)
+    all_ones = kdt.type(~np.uint64(0) >> np.uint64(64 - bits))
+    neg = (u & msb) == kdt.type(0)  # keys below MSB came from negative floats
+    raw = jnp.where(neg, u ^ all_ones, u & ~msb)
+    return jax.lax.bitcast_convert_type(raw, dtype)

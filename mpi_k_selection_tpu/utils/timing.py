@@ -1,0 +1,72 @@
+"""Wall-clock timing + structured result records.
+
+The reference self-times with a single ``clock()`` pair
+(``kth-problem-seq.c:30,35``) / ``MPI_Wtime()`` pair
+(``TODO-kth-problem-cgm.c:76,279``), both excluding data generation, and
+prints ``answer + seconds``. This module keeps that contract (time the solve,
+not the generation) and extends it with the SURVEY.md §5 observability plan:
+per-phase timing, repeat/median, elems/sec/chip, and a JSON-able record.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from typing import Any, Callable
+
+import jax
+
+
+def _block(x):
+    return jax.tree_util.tree_map(
+        lambda a: a.block_until_ready() if hasattr(a, "block_until_ready") else a, x
+    )
+
+
+def time_fn(fn: Callable[[], Any], *, repeats: int = 1, warmup: int = 0):
+    """Time `fn` with device-sync semantics. Returns (best_seconds, last_result)."""
+    result = None
+    for _ in range(warmup):
+        result = _block(fn())
+    best = float("inf")
+    for _ in range(max(1, repeats)):
+        t0 = time.perf_counter()
+        result = _block(fn())
+        best = min(best, time.perf_counter() - t0)
+    return best, result
+
+
+@dataclasses.dataclass
+class ResultRecord:
+    """Structured run record (SURVEY.md §5 metrics/logging plan)."""
+
+    answer: Any
+    n: int
+    k: int
+    backend: str
+    algorithm: str
+    dtype: str
+    seconds: float
+    n_devices: int = 1
+    rounds: int | None = None
+    extra: dict = dataclasses.field(default_factory=dict)
+
+    @property
+    def elems_per_sec_per_chip(self) -> float:
+        if self.seconds <= 0:
+            return float("inf")
+        return self.n / self.seconds / max(1, self.n_devices)
+
+    def to_json(self) -> str:
+        d = dataclasses.asdict(self)
+        d["elems_per_sec_per_chip"] = self.elems_per_sec_per_chip
+        if hasattr(d["answer"], "item"):
+            d["answer"] = d["answer"].item()
+        return json.dumps(d, default=str)
+
+    def print_reference_style(self) -> None:
+        # Mirrors the reference's output contract:
+        # "Solution found solution=%d \ntime: %f\n"  (kth-problem-seq.c:37)
+        # "kth element=%d \ntime: %f\n"              (TODO-kth-problem-cgm.c:280)
+        print(f"kth element={self.answer} \ntime: {self.seconds:f}")

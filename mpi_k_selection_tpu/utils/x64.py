@@ -1,0 +1,25 @@
+"""64-bit mode helper (int64/float64 selection needs jax x64 enabled)."""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+
+def enable_x64():
+    """Context manager enabling 64-bit types, across jax versions."""
+    if hasattr(jax, "enable_x64"):  # jax >= 0.9
+        return jax.enable_x64(True)
+    from jax.experimental import enable_x64 as _legacy  # pragma: no cover
+
+    return _legacy()  # pragma: no cover
+
+
+@contextlib.contextmanager
+def maybe_x64(active: bool):
+    if active:
+        with enable_x64():
+            yield
+    else:
+        yield

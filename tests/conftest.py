@@ -1,0 +1,29 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+The JAX analogue of running the reference under local ``mpirun -np P``
+(SURVEY.md §4, "Multi-node without a cluster"): the collective/sharded paths
+run on 8 virtual CPU devices so the full multi-chip code path executes
+without TPU hardware. Must run before the first ``import jax``.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The machine's site customization (PYTHONPATH sitecustomize) pins
+# jax_platforms to the real TPU; tests must run on the 8-device virtual CPU
+# mesh regardless, so override the config directly as well.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(1234)

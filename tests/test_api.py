@@ -1,0 +1,42 @@
+"""Public API dispatch + reference-semantics checks."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import mpi_k_selection_tpu as ks
+from mpi_k_selection_tpu.backends import get_backend, seq
+from mpi_k_selection_tpu.utils import datagen
+
+
+def test_kselect_dispatch():
+    x = datagen.generate(3000, pattern="uniform", seed=1, dtype=np.int32)
+    k = 1500
+    want = int(seq.kselect(x, k))
+    assert int(ks.kselect(jnp.asarray(x), k)) == want
+    assert int(ks.kselect(jnp.asarray(x), k, algorithm="sort")) == want
+    assert int(ks.kselect(jnp.asarray(x), k, algorithm="radix")) == want
+
+
+def test_median_matches_reference_operating_point():
+    # k = N/2, 1-indexed (kth-problem-seq.c~:24)
+    x = datagen.generate(1000, pattern="uniform", seed=2, dtype=np.int32)
+    want = int(np.sort(x)[1000 // 2 - 1])
+    assert int(ks.median(jnp.asarray(x))) == want
+    assert int(seq.median(x)) == want
+
+
+def test_backend_registry():
+    assert get_backend("seq") is seq
+    with pytest.raises(ValueError):
+        get_backend("cuda")
+
+
+def test_reference_defaults_config():
+    # the reference constants survive as defaults: N=1e8, k=250/150, c=500
+    from mpi_k_selection_tpu import config
+
+    assert config.REFERENCE_N == 100_000_000
+    assert config.REFERENCE_K_SEQ == 250
+    assert config.REFERENCE_K_CGM == 150
+    assert config.REFERENCE_C == 500

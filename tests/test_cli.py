@@ -1,0 +1,80 @@
+"""CLI driver: backends, algorithms, top-k mode, verify, JSON output."""
+
+import json
+
+import numpy as np
+import pytest
+
+from mpi_k_selection_tpu.cli import main
+
+
+def test_seq_backend_verify(capsys):
+    rc = main(["--backend", "seq", "--n", "10000", "--k", "250", "--verify"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "kth element=" in out and "exact match" in out
+
+
+def test_tpu_backend_json(capsys):
+    rc = main(
+        ["--backend", "tpu", "--n", "65536", "--verify", "--json", "--distribute", "never"]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    rec = json.loads(out.strip().splitlines()[-1])
+    assert rec["n"] == 65536
+    assert rec["k"] == 32768  # default: median (N/2)
+    assert rec["extra"]["exact_match"] is True
+
+
+def test_cgm_algorithm(capsys):
+    rc = main(
+        ["--backend", "tpu", "--algorithm", "cgm", "--n", "32768", "--verify", "--json"]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    rec = json.loads(out.strip().splitlines()[-1])
+    assert rec["extra"]["exact_match"] is True
+
+
+def test_topk_mode(capsys):
+    rc = main(
+        [
+            "--backend", "tpu", "--gen", "normal", "--dtype", "float32",
+            "--n", "4096", "--topk", "16", "--verify", "--json",
+        ]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    rec = json.loads(out.strip().splitlines()[-1])
+    assert rec["algorithm"] == "topk" and rec["extra"]["exact_match"] is True
+
+
+def test_batched_topk_mode(capsys):
+    rc = main(
+        [
+            "--backend", "tpu", "--gen", "funiform", "--dtype", "float32",
+            "--n", "1024", "--batch", "8", "--topk", "4", "--verify", "--json",
+        ]
+    )
+    assert rc == 0
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["extra"]["exact_match"] is True
+
+
+def test_k_out_of_range():
+    with pytest.raises(SystemExit):
+        main(["--backend", "seq", "--n", "100", "--k", "0"])
+
+
+def test_reference_operating_point(capsys):
+    # k=250 at small n, seq oracle — the kth-problem-seq.c:24 operating point
+    rc = main(["--backend", "seq", "--n", "100000", "--k", "250", "--json"])
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0
+    x = np.sort(
+        __import__("mpi_k_selection_tpu.utils.datagen", fromlist=["generate"]).generate(
+            100000, pattern="uniform", seed=0, dtype=np.int32
+        )
+    )
+    assert rec["answer"] == int(x[249])

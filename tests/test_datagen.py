@@ -1,0 +1,50 @@
+"""Seeded generators: determinism, dtype, pattern shape."""
+
+import numpy as np
+import pytest
+
+from mpi_k_selection_tpu.utils import datagen
+
+
+def test_deterministic():
+    a = datagen.generate(1000, pattern="uniform", seed=42)
+    b = datagen.generate(1000, pattern="uniform", seed=42)
+    np.testing.assert_array_equal(a, b)
+    c = datagen.generate(1000, pattern="uniform", seed=43)
+    assert not np.array_equal(a, c)
+
+
+@pytest.mark.parametrize("pattern", datagen.PATTERNS)
+def test_patterns_shape_dtype(pattern):
+    dtype = np.float32 if pattern in ("normal", "funiform") else np.int32
+    x = datagen.generate(512, pattern=pattern, seed=0, dtype=dtype)
+    assert x.shape == (512,)
+    assert x.dtype == dtype
+
+
+def test_uniform_matches_reference_range():
+    # rand() % 99999999 + 1 (TODO-kth-problem-cgm.c:15) -> values in [1, 99999999]
+    x = datagen.generate(100_000, pattern="uniform", seed=1)
+    assert x.min() >= 1 and x.max() <= 99_999_999
+
+
+def test_descending_sequential_equal():
+    d = datagen.generate(10, pattern="descending")
+    np.testing.assert_array_equal(d, np.arange(10, 0, -1))
+    s = datagen.generate(10, pattern="sequential")
+    np.testing.assert_array_equal(s, np.arange(1, 11))
+    e = datagen.generate(10, pattern="equal")
+    assert len(np.unique(e)) == 1
+
+
+def test_batched():
+    x = datagen.generate(64, pattern="normal", dtype=np.float32, batch=(4, 3))
+    assert x.shape == (4, 3, 64)
+
+
+def test_adversarial_fixtures():
+    fx = datagen.adversarial_fixtures(256, dtype=np.int32)
+    names = [n for n, _ in fx]
+    assert "equal" in names and "extremes" in names
+    for _, arr in fx:
+        assert arr.shape == (256,)

@@ -1,0 +1,105 @@
+"""Distributed radix + CGM selection on the 8-device virtual CPU mesh.
+
+The JAX analogue of the reference's local ``mpirun -np P`` testing
+(SURVEY.md §4): the full collective code path runs on
+xla_force_host_platform_device_count=8 CPU devices.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from mpi_k_selection_tpu.backends import seq
+from mpi_k_selection_tpu.parallel import (
+    distributed_cgm_select,
+    distributed_kselect,
+    distributed_radix_select,
+    make_mesh,
+)
+from mpi_k_selection_tpu.utils import datagen
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    assert len(jax.devices()) == 8, "conftest must provide 8 virtual devices"
+    return make_mesh(8)
+
+
+N = 1 << 16
+
+
+@pytest.mark.parametrize("pattern", ["uniform", "seqlike", "descending", "equal"])
+def test_distributed_radix_matches_oracle(mesh8, pattern):
+    x = datagen.generate(N, pattern=pattern, seed=21, dtype=np.int32)
+    for k in (1, N // 2, N):
+        got = int(distributed_radix_select(x, k, mesh=mesh8))
+        assert got == int(seq.kselect(x, k)), (pattern, k)
+
+
+@pytest.mark.parametrize("pattern", ["uniform", "seqlike", "descending", "equal"])
+def test_distributed_cgm_matches_oracle(mesh8, pattern):
+    x = datagen.generate(N, pattern=pattern, seed=22, dtype=np.int32)
+    for k in (1, N // 2, N):
+        got = int(distributed_cgm_select(x, k, mesh=mesh8))
+        assert got == int(seq.kselect(x, k)), (pattern, k)
+
+
+def test_cgm_terminates_and_reports_rounds(mesh8):
+    x = datagen.generate(N, pattern="uniform", seed=23, dtype=np.int32)
+    val, rounds = distributed_cgm_select(x, N // 3, mesh=mesh8, return_rounds=True)
+    assert int(val) == int(seq.kselect(x, N // 3))
+    # true-median pivots: convergence must be logarithmic, not linear
+    assert 1 <= int(rounds) <= 64
+
+
+def test_unpadded_n_not_divisible(mesh8):
+    # n % 8 != 0 exercises the sentinel padding path (pad_to_multiple)
+    n = N + 5
+    x = datagen.generate(n, pattern="uniform", seed=24, dtype=np.int32)
+    for k in (1, n // 2, n):
+        assert int(distributed_radix_select(x, k, mesh=mesh8)) == int(seq.kselect(x, k))
+        assert int(distributed_cgm_select(x, k, mesh=mesh8)) == int(seq.kselect(x, k))
+
+
+def test_distributed_float32(mesh8):
+    x = datagen.generate(N, pattern="normal", seed=25, dtype=np.float32)
+    k = N // 2
+    assert float(distributed_radix_select(x, k, mesh=mesh8)) == float(seq.kselect(x, k))
+    assert float(distributed_cgm_select(x, k, mesh=mesh8)) == float(seq.kselect(x, k))
+
+
+def test_distributed_duplicates(mesh8):
+    rng = np.random.default_rng(4)
+    x = rng.integers(0, 5, size=N, dtype=np.int32)
+    for k in (1, N // 2, N):
+        assert int(distributed_cgm_select(x, k, mesh=mesh8)) == int(seq.kselect(x, k))
+
+
+def test_distributed_kselect_dispatch(mesh8):
+    x = datagen.generate(1 << 12, pattern="uniform", seed=26, dtype=np.int32)
+    k = 1 << 11
+    want = int(seq.kselect(x, k))
+    assert int(distributed_kselect(x, k, algorithm="radix", mesh=mesh8)) == want
+    assert int(distributed_kselect(x, k, algorithm="cgm", mesh=mesh8)) == want
+    with pytest.raises(ValueError):
+        distributed_kselect(x, k, algorithm="quickselect", mesh=mesh8)
+
+
+def test_min_devices_guard():
+    # the reference aborts on world_size < 2 (TODO-…:56-59)
+    mesh1 = make_mesh(1)
+    x = datagen.generate(1024, pattern="uniform", seed=1, dtype=np.int32)
+    with pytest.raises(ValueError, match="devices"):
+        distributed_radix_select(x, 5, mesh=mesh1)
+
+
+def test_int64_distributed(mesh8):
+    from mpi_k_selection_tpu.utils import x64
+
+    with x64.enable_x64():
+        rng = np.random.default_rng(31)
+        x = rng.integers(-(2**62), 2**62, size=1 << 14, dtype=np.int64)
+        k = 1 << 13
+        assert int(distributed_radix_select(x, k, mesh=make_mesh(8))) == int(
+            seq.kselect(x, k)
+        )

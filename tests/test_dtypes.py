@@ -1,0 +1,71 @@
+"""Order-preserving key transforms: round-trip + ordering vs NumPy sort."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_k_selection_tpu.utils import dtypes as dt
+from mpi_k_selection_tpu.utils import x64
+
+DTYPES_32 = [np.int32, np.uint32, np.float32, np.int16, np.uint16, np.int8, np.uint8]
+
+
+def _sample(dtype, n=4097, seed=7):
+    rng = np.random.default_rng(seed)
+    dtype = np.dtype(dtype)
+    if dtype.kind in "iu":
+        info = np.iinfo(dtype)
+        x = rng.integers(info.min, info.max, size=n, endpoint=True, dtype=dtype)
+        # force extreme values in
+        x[:4] = [info.min, info.max, 0, info.max - 1 if dtype.kind == "u" else -1]
+        return x
+    x = rng.standard_normal(n).astype(dtype) * dtype.type(100)
+    x[:5] = [0.0, -0.0, np.finfo(dtype).max, np.finfo(dtype).min, 1.5]
+    return x
+
+
+@pytest.mark.parametrize("dtype", DTYPES_32)
+def test_roundtrip(dtype):
+    x = _sample(dtype)
+    u = dt.to_sortable_bits(jnp.asarray(x))
+    back = np.asarray(dt.from_sortable_bits(u, dtype))
+    np.testing.assert_array_equal(back, x)
+
+
+@pytest.mark.parametrize("dtype", DTYPES_32)
+def test_order_preserved(dtype):
+    x = _sample(dtype)
+    u = np.asarray(dt.to_sortable_bits(jnp.asarray(x)))
+    order_u = np.argsort(u, kind="stable")
+    xs = np.sort(x, kind="stable")
+    np.testing.assert_array_equal(x[order_u], xs)
+
+
+def test_bfloat16_roundtrip_and_order():
+    x = jnp.asarray(np.random.default_rng(3).standard_normal(513), dtype=jnp.bfloat16)
+    u = dt.to_sortable_bits(x)
+    back = dt.from_sortable_bits(u, jnp.bfloat16)
+    assert bool(jnp.all(back == x))
+    xs = np.asarray(jax.lax.sort(x).astype(jnp.float32))
+    xu = np.asarray(x.astype(jnp.float32))[np.argsort(np.asarray(u), kind="stable")]
+    np.testing.assert_array_equal(xu, xs)
+
+
+def test_int64_requires_x64():
+    assert not jax.config.jax_enable_x64
+    with pytest.raises(ValueError, match="64-bit"):
+        dt._require_x64(np.int64)
+
+
+def test_int64_roundtrip_under_x64():
+    with x64.enable_x64():
+        x = jnp.asarray(
+            np.random.default_rng(5).integers(-(2**62), 2**62, size=257, dtype=np.int64)
+        )
+        u = dt.to_sortable_bits(x)
+        assert u.dtype == jnp.uint64
+        back = np.asarray(dt.from_sortable_bits(u, np.int64))
+        np.testing.assert_array_equal(back, np.asarray(x))
+        order_u = np.argsort(np.asarray(u), kind="stable")
+        np.testing.assert_array_equal(np.asarray(x)[order_u], np.sort(np.asarray(x)))

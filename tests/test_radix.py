@@ -1,0 +1,100 @@
+"""radix_select vs the sequential oracle, across dtypes/patterns/k/methods."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_k_selection_tpu.backends import seq
+from mpi_k_selection_tpu.ops.radix import radix_select
+from mpi_k_selection_tpu.utils import datagen, x64
+
+N = 5000
+KS = [1, 2, N // 2, N - 1, N]
+
+
+@pytest.mark.parametrize("pattern", ["uniform", "seqlike", "descending", "equal"])
+@pytest.mark.parametrize("k", KS)
+def test_int32_matches_oracle(pattern, k):
+    x = datagen.generate(N, pattern=pattern, seed=9, dtype=np.int32)
+    want = seq.kselect(x, k)
+    got = radix_select(jnp.asarray(x), k)
+    assert int(got) == int(want)
+
+
+@pytest.mark.parametrize("dtype", [np.uint32, np.int16, np.uint8])
+def test_other_int_dtypes(dtype):
+    rng = np.random.default_rng(11)
+    info = np.iinfo(dtype)
+    x = rng.integers(info.min, info.max, size=3001, endpoint=True, dtype=dtype)
+    for k in (1, 1500, 3001):
+        assert int(radix_select(jnp.asarray(x), k)) == int(seq.kselect(x, k))
+
+
+def test_float32():
+    x = datagen.generate(4096, pattern="normal", seed=2, dtype=np.float32)
+    x[17] = 0.0
+    x[18] = -0.0
+    for k in (1, 5, 2048, 4096):
+        got = float(radix_select(jnp.asarray(x), k))
+        want = float(seq.kselect(x, k))
+        assert got == want
+
+
+def test_duplicates_heavy():
+    # the E > 1 equal-count path of the reference's exact-hit test (TODO-…:194)
+    rng = np.random.default_rng(3)
+    x = rng.integers(0, 7, size=4001, dtype=np.int32)
+    for k in (1, 1000, 2000, 4001):
+        assert int(radix_select(jnp.asarray(x), k)) == int(seq.kselect(x, k))
+
+
+@pytest.mark.parametrize("method", ["scatter", "onehot"])
+def test_hist_methods_agree(method):
+    x = datagen.generate(3333, pattern="uniform", seed=4, dtype=np.int32)
+    k = 1234
+    got = radix_select(jnp.asarray(x), k, hist_method=method, chunk=512)
+    assert int(got) == int(seq.kselect(x, k))
+
+
+@pytest.mark.parametrize("radix_bits", [4, 8, 16])
+def test_radix_bits(radix_bits):
+    x = datagen.generate(2048, pattern="uniform", seed=5, dtype=np.int32)
+    k = 777
+    got = radix_select(jnp.asarray(x), k, radix_bits=radix_bits)
+    assert int(got) == int(seq.kselect(x, k))
+
+
+def test_traced_k():
+    x = jnp.asarray(datagen.generate(1024, pattern="uniform", seed=6, dtype=np.int32))
+
+    @jax.jit
+    def f(x, k):
+        return radix_select(x, k)
+
+    xs = np.asarray(x)
+    for k in (1, 512, 1024):
+        assert int(f(x, jnp.asarray(k))) == int(seq.kselect(xs, k))
+
+
+def test_negative_values():
+    rng = np.random.default_rng(8)
+    x = rng.integers(-(2**31), 2**31 - 1, size=3000, dtype=np.int64).astype(np.int32)
+    for k in (1, 1500, 3000):
+        assert int(radix_select(jnp.asarray(x), k)) == int(seq.kselect(x, k))
+
+
+def test_int64_under_x64():
+    with x64.enable_x64():
+        rng = np.random.default_rng(13)
+        x = rng.integers(-(2**62), 2**62, size=2049, dtype=np.int64)
+        for k in (1, 1025, 2049):
+            got = radix_select(jnp.asarray(x), k)
+            assert got.dtype == jnp.int64
+            assert int(got) == int(seq.kselect(x, k))
+
+
+def test_extremes_fixture():
+    for name, x in datagen.adversarial_fixtures(1024, dtype=np.int32, seed=1):
+        k = 100
+        assert int(radix_select(jnp.asarray(x), k)) == int(seq.kselect(x, k)), name

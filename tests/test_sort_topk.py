@@ -1,0 +1,75 @@
+"""sort_select and topk/batched_topk vs the NumPy oracle."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_k_selection_tpu.backends import seq
+from mpi_k_selection_tpu.ops.sort import sort_select
+from mpi_k_selection_tpu.ops.topk import batched_topk, topk
+from mpi_k_selection_tpu.utils import datagen
+
+
+def test_sort_select_matches_oracle():
+    x = datagen.generate(4000, pattern="uniform", seed=1, dtype=np.int32)
+    for k in (1, 2000, 4000):
+        assert int(sort_select(jnp.asarray(x), k)) == int(seq.kselect_sort(x, k))
+
+
+def test_partition_vs_sort_oracle():
+    x = datagen.generate(5000, pattern="seqlike", seed=2, dtype=np.int32)
+    for k in (1, 17, 2500, 5000):
+        assert int(seq.kselect(x, k)) == int(seq.kselect_sort(x, k))
+
+
+@pytest.mark.parametrize("largest", [True, False])
+@pytest.mark.parametrize("dtype", [np.float32, np.int32, np.uint32])
+def test_topk_values(largest, dtype):
+    rng = np.random.default_rng(3)
+    if np.dtype(dtype).kind == "f":
+        x = rng.standard_normal(2000).astype(dtype)
+    else:
+        info = np.iinfo(dtype)
+        x = rng.integers(info.min, info.max, size=2000, endpoint=True, dtype=dtype)
+    vals, idx = topk(jnp.asarray(x), 37, largest=largest)
+    want_vals, _ = seq.topk(x, 37, largest=largest)
+    np.testing.assert_array_equal(np.asarray(vals), want_vals)
+    # indices must point at the returned values
+    np.testing.assert_array_equal(x[np.asarray(idx)], np.asarray(vals))
+
+
+def test_batched_topk():
+    x = datagen.generate(512, pattern="normal", seed=4, dtype=np.float32, batch=(8, 3))
+    vals, idx = batched_topk(jnp.asarray(x), 8)
+    want_vals, _ = seq.topk(x, 8)
+    np.testing.assert_array_equal(np.asarray(vals), want_vals)
+    np.testing.assert_array_equal(
+        np.take_along_axis(x, np.asarray(idx), axis=-1), np.asarray(vals)
+    )
+
+
+def test_topk_chunked_matches_flat():
+    x = datagen.generate(1 << 17, pattern="funiform", seed=5, dtype=np.float32)
+    vflat, _ = topk(jnp.asarray(x), 128, method="flat")
+    vchunk, ichunk = topk(jnp.asarray(x), 128, method="chunked")
+    np.testing.assert_array_equal(np.asarray(vflat), np.asarray(vchunk))
+    np.testing.assert_array_equal(x[np.asarray(ichunk)], np.asarray(vchunk))
+
+
+def test_topk_k_equals_d():
+    x = jnp.asarray([3.0, 1.0, 2.0], dtype=jnp.float32)
+    vals, idx = topk(x, 3)
+    np.testing.assert_array_equal(np.asarray(vals), [3.0, 2.0, 1.0])
+
+
+def test_topk_duplicates():
+    x = np.array([5, 5, 5, 1, 1, 9], dtype=np.int32)
+    vals, _ = topk(jnp.asarray(x), 4)
+    np.testing.assert_array_equal(np.asarray(vals), [9, 5, 5, 5])
+    vals, _ = topk(jnp.asarray(x), 4, largest=False)
+    np.testing.assert_array_equal(np.asarray(vals), [1, 1, 5, 5])
+
+
+def test_topk_out_of_range():
+    with pytest.raises(ValueError):
+        topk(jnp.arange(4, dtype=jnp.float32), 5)
