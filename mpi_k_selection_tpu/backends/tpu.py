@@ -18,15 +18,36 @@ from mpi_k_selection_tpu import api
 NAME = "tpu"
 
 
-def kselect(x, k: int, *, algorithm: str = "auto", distribute: str = "auto", **kwargs):
-    """Exact k-th smallest (1-indexed). ``distribute`` in {auto, never, always}."""
+def plan(n: int, algorithm: str = "auto", distribute: str = "auto"):
+    """Resolve (effective_algorithm, distributed) for a selection of size n.
+
+    Only the radix algorithm has a distributed path; an explicit
+    ``algorithm='sort'`` therefore always runs single-chip, and asking for
+    ``distribute='always'`` with it is an error rather than a silent switch.
+    """
     n_dev = len(jax.devices())
-    n = np.asarray(x).size if not hasattr(x, "size") else x.size
+    distributable = algorithm in ("auto", "radix")
     use_mesh = {
-        "auto": n_dev > 1 and n >= 1 << 20 and n % n_dev == 0,
+        "auto": distributable and n_dev > 1 and n >= 1 << 20 and n % n_dev == 0,
         "never": False,
         "always": n_dev > 1,
     }[distribute]
+    if use_mesh and not distributable:
+        raise ValueError(
+            f"algorithm={algorithm!r} has no distributed path; "
+            "use algorithm='radix' (or 'auto') with distribute='always'"
+        )
+    if use_mesh:
+        return "radix", True
+    if algorithm == "auto":
+        algorithm = "sort" if n <= 1 << 14 else "radix"
+    return algorithm, False
+
+
+def kselect(x, k: int, *, algorithm: str = "auto", distribute: str = "auto", **kwargs):
+    """Exact k-th smallest (1-indexed). ``distribute`` in {auto, never, always}."""
+    n = np.asarray(x).size if not hasattr(x, "size") else x.size
+    algorithm, use_mesh = plan(n, algorithm, distribute)
     if use_mesh:
         from mpi_k_selection_tpu.parallel import radix as pradix
 

@@ -86,9 +86,14 @@ def _run_kth(args, x):
         raise SystemExit(f"error: k={k} out of range [1, {n}]")
     backend = get_backend(args.backend)
     rounds = None
+    # record what actually runs, not what was asked for (seq always uses
+    # partition/nth_element; the tpu backend resolves "auto" and may distribute)
+    effective_algorithm = args.algorithm
     if args.backend == "seq":
+        effective_algorithm = "partition"
         fn = lambda: backend.kselect(x, k)
     elif args.backend == "mpi":
+        effective_algorithm = "cgm"
         fn = lambda: backend.kselect(x, k, num_procs=args.num_procs, c=args.c)
     else:
         import jax.numpy as jnp
@@ -100,6 +105,11 @@ def _run_kth(args, x):
             mesh = make_mesh(args.devices)
             fn = lambda: distributed_cgm_select(xd, k, mesh=mesh, return_rounds=True)
         else:
+            effective_algorithm, distributed = backend.plan(
+                n, args.algorithm, args.distribute
+            )
+            if distributed:
+                effective_algorithm = "radix-distributed"
             fn = lambda: backend.kselect(
                 xd, k, algorithm=args.algorithm, distribute=args.distribute
             )
@@ -113,7 +123,7 @@ def _run_kth(args, x):
         n=n,
         k=k,
         backend=args.backend,
-        algorithm=args.algorithm,
+        algorithm=effective_algorithm,
         dtype=args.dtype,
         seconds=seconds,
         n_devices=_device_count(args),

@@ -29,6 +29,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def _digit_and_mask(keys, shift, radix_bits, prefix):
@@ -76,10 +77,16 @@ def _hist_onehot(digits, mask, nbuckets, count_dtype, chunk):
     return hist
 
 
-def resolve_hist_method(method: str) -> str:
+def resolve_hist_method(method: str, key_dtype=None) -> str:
     if method != "auto":
         return method
-    return "onehot" if jax.default_backend() == "tpu" else "scatter"
+    if jax.default_backend() == "tpu":
+        # the Pallas kernel is the production path; TPU vector lanes are
+        # 32-bit, so 64-bit keys take the XLA one-hot path instead
+        if key_dtype is None or np.dtype(key_dtype).itemsize <= 4:
+            return "pallas"
+        return "onehot"
+    return "scatter"
 
 
 @functools.partial(
@@ -103,19 +110,9 @@ def masked_radix_histogram(
     """
     keys = keys.ravel()
     nbuckets = 1 << radix_bits
-    digits, mask = _digit_and_mask(keys, shift, radix_bits, prefix)
-    method = resolve_hist_method(method)
-    if method == "scatter":
-        return _hist_scatter(digits, mask, nbuckets, count_dtype)
-    if method == "onehot":
-        return _hist_onehot(digits, mask, nbuckets, count_dtype, chunk)
+    method = resolve_hist_method(method, keys.dtype)
     if method == "pallas":
-        try:
-            from mpi_k_selection_tpu.ops.pallas.histogram import pallas_radix_histogram
-        except ImportError as e:
-            raise NotImplementedError(
-                "the pallas histogram kernel is not available in this build"
-            ) from e
+        from mpi_k_selection_tpu.ops.pallas.histogram import pallas_radix_histogram
 
         return pallas_radix_histogram(
             keys,
@@ -124,4 +121,9 @@ def masked_radix_histogram(
             prefix=prefix,
             count_dtype=count_dtype,
         )
+    digits, mask = _digit_and_mask(keys, shift, radix_bits, prefix)
+    if method == "scatter":
+        return _hist_scatter(digits, mask, nbuckets, count_dtype)
+    if method == "onehot":
+        return _hist_onehot(digits, mask, nbuckets, count_dtype, chunk)
     raise ValueError(f"unknown histogram method {method!r}")

@@ -36,6 +36,16 @@ from mpi_k_selection_tpu.ops.histogram import masked_radix_histogram
 from mpi_k_selection_tpu.utils import dtypes as _dt
 
 
+def default_radix_bits(dtype, hist_method: str = "auto") -> int:
+    """4 on the TPU Pallas path (8 memory-bound passes beat 4 compute-bound
+    ones on the VPU — see ops/pallas/histogram.py), 8 elsewhere (fewer
+    passes; the scatter/onehot paths scale fine to 256 buckets)."""
+    from mpi_k_selection_tpu.ops.histogram import resolve_hist_method
+
+    method = resolve_hist_method(hist_method, _dt.key_dtype(dtype))
+    return 4 if method == "pallas" else 8
+
+
 def select_count_dtype(n: int):
     """int32 counts are exact for n < 2^31; beyond that int64 (requires x64)."""
     if n < 2**31:
@@ -53,7 +63,7 @@ def radix_select(
     x: jax.Array,
     k,
     *,
-    radix_bits: int = 8,
+    radix_bits: int | None = None,
     hist_method: str = "auto",
     chunk: int = 32768,
 ) -> jax.Array:
@@ -63,6 +73,8 @@ def radix_select(
     """
     x = x.ravel()
     n = x.shape[0]
+    if radix_bits is None:
+        radix_bits = default_radix_bits(x.dtype, hist_method)
     total_bits = _dt.key_bits(x.dtype)
     if total_bits % radix_bits:
         raise ValueError(f"radix_bits={radix_bits} must divide key bits {total_bits}")

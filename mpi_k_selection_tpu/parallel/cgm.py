@@ -45,6 +45,7 @@ duplicates, -0.0/0.0 and the full int range behave exactly.
 
 from __future__ import annotations
 
+import functools
 import math
 
 import jax
@@ -63,30 +64,11 @@ def _pvary(value, axis):
     return jax.lax.pvary(value, (axis,))
 
 
-def distributed_cgm_select(
-    x: jax.Array,
-    k,
-    *,
-    mesh=None,
-    max_rounds: int | None = None,
-    return_rounds: bool = False,
-):
-    """Exact k-th smallest (1-indexed) of sharded ``x`` via CGM weighted-median.
-
-    Returns a replicated scalar (and the round count if ``return_rounds``).
-    """
-    if mesh is None:
-        mesh = mesh_lib.make_mesh()
-    mesh_lib.require_distributed(mesh)
+@functools.lru_cache(maxsize=64)
+def _jitted_cgm(mesh, n, cdt, max_rounds):
+    """Cached jitted CGM program per (mesh, config) — avoids a retrace per call
+    (jit caches are per jit object; see parallel/radix.py)."""
     axis = mesh.axis_names[0]
-
-    x = jnp.ravel(jnp.asarray(x))
-    x, n = mesh_lib.pad_to_multiple(x, mesh.size)
-    cdt = select_count_dtype(n)
-    if max_rounds is None:
-        # true-median pivots discard >= 1/4 of the live set per round; the
-        # slack covers duplicate-heavy ties and the int range.
-        max_rounds = 64 + 8 * int(math.ceil(math.log2(n + 1)))
 
     def shard_fn(xs, kk0):
         keys = _dt.to_sortable_bits(xs.ravel())
@@ -148,8 +130,37 @@ def distributed_cgm_select(
         out_specs=(P(), P(), P()),
         check_vma=False,
     )
-    xs = jax.device_put(x, NamedSharding(mesh, P(axis)))
-    value, rounds, found = jax.jit(fn)(xs, jnp.asarray(k, cdt))
+    return jax.jit(fn)
+
+
+def distributed_cgm_select(
+    x: jax.Array,
+    k,
+    *,
+    mesh=None,
+    max_rounds: int | None = None,
+    return_rounds: bool = False,
+):
+    """Exact k-th smallest (1-indexed) of sharded ``x`` via CGM weighted-median.
+
+    Returns a replicated scalar (and the round count if ``return_rounds``).
+    """
+    if mesh is None:
+        mesh = mesh_lib.make_mesh()
+    mesh_lib.require_distributed(mesh)
+
+    x = jnp.ravel(jnp.asarray(x))
+    x, n = mesh_lib.pad_to_multiple(x, mesh.size)
+    # counts sized for the padded total (sentinels are counted too)
+    cdt = select_count_dtype(x.shape[0])
+    if max_rounds is None:
+        # true-median pivots discard >= 1/4 of the live set per round; the
+        # slack covers duplicate-heavy ties and the int range.
+        max_rounds = 64 + 8 * int(math.ceil(math.log2(n + 1)))
+
+    fn = _jitted_cgm(mesh, n, cdt, max_rounds)
+    xs = jax.device_put(x, NamedSharding(mesh, P(mesh.axis_names[0])))
+    value, rounds, found = fn(xs, jnp.asarray(k, cdt))
     if not bool(found):
         raise RuntimeError(
             f"CGM selection did not converge within {max_rounds} rounds — "

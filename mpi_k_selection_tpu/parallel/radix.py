@@ -31,36 +31,20 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from mpi_k_selection_tpu.ops.histogram import masked_radix_histogram
-from mpi_k_selection_tpu.ops.radix import select_count_dtype
+from mpi_k_selection_tpu.ops.radix import default_radix_bits, select_count_dtype
 from mpi_k_selection_tpu.parallel import mesh as mesh_lib
 from mpi_k_selection_tpu.utils import dtypes as _dt
 
 
-def _shard_map(fn, mesh, in_specs, out_specs):
-    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+@functools.lru_cache(maxsize=64)
+def _jitted_select(mesh, n, total_bits, cdt, radix_bits, hist_method, chunk):
+    """Build-and-cache the jitted sharded program for one (mesh, config).
 
-
-def distributed_radix_select(
-    x: jax.Array,
-    k,
-    *,
-    mesh=None,
-    radix_bits: int = 8,
-    hist_method: str = "auto",
-    chunk: int = 32768,
-):
-    """Exact k-th smallest (1-indexed) of sharded ``x``; replicated scalar out."""
-    if mesh is None:
-        mesh = mesh_lib.make_mesh()
-    mesh_lib.require_distributed(mesh)
+    Rebuilding shard_map + jit per call would force a retrace/recompile on
+    every invocation (jit caches are per jit *object*); caching here makes
+    repeat calls hit the XLA executable cache like any other jitted fn.
+    """
     axis = mesh.axis_names[0]
-
-    x = jnp.ravel(jnp.asarray(x))
-    x, n = mesh_lib.pad_to_multiple(x, mesh.size)
-    cdt = select_count_dtype(n)
-    total_bits = _dt.key_bits(x.dtype)
-    if total_bits % radix_bits:
-        raise ValueError(f"radix_bits={radix_bits} must divide {total_bits}")
 
     def shard_fn(xs, kk):
         u = _dt.to_sortable_bits(xs.ravel())
@@ -89,7 +73,36 @@ def distributed_radix_select(
                 prefix = jax.lax.shift_left(prefix, kdt.type(radix_bits)) | bkey
         return _dt.from_sortable_bits(prefix, xs.dtype)
 
-    fn = _shard_map(shard_fn, mesh, in_specs=(P(axis), P()), out_specs=P())
-    xs = jax.device_put(x, NamedSharding(mesh, P(axis)))
+    fn = jax.shard_map(shard_fn, mesh=mesh, in_specs=(P(axis), P()), out_specs=P())
+    return jax.jit(fn)
+
+
+def distributed_radix_select(
+    x: jax.Array,
+    k,
+    *,
+    mesh=None,
+    radix_bits: int | None = None,
+    hist_method: str = "auto",
+    chunk: int = 32768,
+):
+    """Exact k-th smallest (1-indexed) of sharded ``x``; replicated scalar out."""
+    if mesh is None:
+        mesh = mesh_lib.make_mesh()
+    mesh_lib.require_distributed(mesh)
+
+    x = jnp.ravel(jnp.asarray(x))
+    if radix_bits is None:
+        radix_bits = default_radix_bits(x.dtype, hist_method)
+    x, n = mesh_lib.pad_to_multiple(x, mesh.size)
+    # counts are sized for the padded total: sentinels are counted too, and
+    # padding can push the histogram total past the unpadded dtype boundary
+    cdt = select_count_dtype(x.shape[0])
+    total_bits = _dt.key_bits(x.dtype)
+    if total_bits % radix_bits:
+        raise ValueError(f"radix_bits={radix_bits} must divide {total_bits}")
+
+    fn = _jitted_select(mesh, n, total_bits, cdt, radix_bits, hist_method, chunk)
+    xs = jax.device_put(x, NamedSharding(mesh, P(mesh.axis_names[0])))
     kk = jnp.asarray(k, cdt)
-    return jax.jit(fn)(xs, kk)
+    return fn(xs, kk)

@@ -60,8 +60,6 @@ def generate(
         r1 = rng.integers(0, 2**31, size=shape, dtype=np.int64)
         r2 = rng.integers(0, 2**31, size=shape, dtype=np.int64)
         out = i + r1 - r2 % np.maximum(i, 1)
-        if dtype.kind in "iu":
-            out = np.clip(out, np.iinfo(dtype).min, np.iinfo(dtype).max)
     elif pattern == "descending":
         out = np.broadcast_to(np.arange(n, 0, -1, dtype=np.int64), shape)
     elif pattern == "sequential":
@@ -74,8 +72,13 @@ def generate(
         out = rng.uniform(-1.0, 1.0, size=shape)
     else:
         raise ValueError(f"unknown pattern {pattern!r}; choose from {PATTERNS}")
-    if dtype.kind in "iu" and np.dtype(np.result_type(out)).kind == "f":
-        out = np.rint(out)
+    if dtype.kind in "iu":
+        if np.dtype(np.result_type(out)).kind == "f":
+            out = np.rint(out)
+        # narrow-dtype casts clip rather than wrap (module policy: no
+        # silent modular sawtooth in "adversarial" monotone patterns)
+        info = np.iinfo(dtype)
+        out = np.clip(out, info.min, info.max)
     return np.ascontiguousarray(out.astype(dtype))
 
 

@@ -1,0 +1,44 @@
+"""tpu-backend plan(): algorithm/distribution resolution is explicit.
+
+Regression tests for the review finding that an explicit ``algorithm='sort'``
+was silently replaced by distributed radix when a mesh was available.
+"""
+
+import numpy as np
+import pytest
+
+from mpi_k_selection_tpu.backends import tpu as tpu_backend
+
+
+def test_plan_explicit_sort_never_distributes():
+    algo, dist = tpu_backend.plan(1 << 22, "sort", "auto")
+    assert algo == "sort" and not dist
+
+
+def test_plan_explicit_sort_with_always_is_error():
+    with pytest.raises(ValueError, match="no distributed path"):
+        tpu_backend.plan(1 << 22, "sort", "always")
+
+
+def test_plan_auto_large_distributes_on_mesh():
+    algo, dist = tpu_backend.plan(1 << 23, "auto", "auto")
+    assert algo == "radix" and dist  # conftest provides 8 virtual devices
+
+
+def test_plan_auto_small_single_chip():
+    algo, dist = tpu_backend.plan(1 << 10, "auto", "never")
+    assert algo == "sort" and not dist
+
+
+def test_explicit_sort_runs_sort(rng):
+    x = rng.integers(0, 1000, size=1 << 20, dtype=np.int32)
+    got = int(tpu_backend.kselect(x, 1234, algorithm="sort"))
+    assert got == int(np.sort(x)[1233])
+
+
+def test_datagen_narrow_dtype_clips_not_wraps():
+    from mpi_k_selection_tpu.utils import datagen
+
+    x = datagen.generate(100_000, pattern="sequential", dtype=np.int16)
+    assert x.max() == np.iinfo(np.int16).max  # clipped, no sawtooth
+    assert np.all(np.diff(x.astype(np.int64)) >= 0)  # still monotone

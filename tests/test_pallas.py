@@ -1,0 +1,71 @@
+"""Pallas histogram kernel vs the scatter oracle (interpret mode on CPU).
+
+SURVEY.md §4 test plan: "unit tests for ... each Pallas kernel vs NumPy".
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_k_selection_tpu.ops.histogram import masked_radix_histogram
+from mpi_k_selection_tpu.ops.pallas.histogram import pallas_radix_histogram
+from mpi_k_selection_tpu.ops.radix import radix_select
+
+
+def _oracle(keys, shift, radix_bits, prefix):
+    keys = np.asarray(keys, np.uint64)
+    nb = 1 << radix_bits
+    digits = (keys >> np.uint64(shift)) & np.uint64(nb - 1)
+    active = np.ones(keys.shape, bool)
+    if prefix is not None:
+        active = (keys >> np.uint64(shift + radix_bits)) == np.uint64(prefix)
+    return np.bincount(digits[active].astype(np.int64), minlength=nb)
+
+
+@pytest.mark.parametrize("n", [128, 1000, 12345, 1 << 17])
+@pytest.mark.parametrize(
+    "shift,radix_bits,prefix",
+    [(28, 4, None), (24, 4, 7), (0, 4, 2**27 - 5), (24, 8, None), (16, 8, 129)],
+)
+def test_pallas_histogram_matches_oracle(rng, n, shift, radix_bits, prefix):
+    keys = jnp.asarray(rng.integers(0, 2**32, size=n, dtype=np.uint32))
+    got = np.asarray(
+        pallas_radix_histogram(keys, shift=shift, radix_bits=radix_bits, prefix=prefix)
+    )
+    want = _oracle(keys, shift, radix_bits, prefix)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_pallas_histogram_small_block_multigrid(rng):
+    # force several grid steps + a ragged tail in one shot
+    n = 4 * 256 * 128 + 77
+    keys = jnp.asarray(rng.integers(0, 2**32, size=n, dtype=np.uint32))
+    got = np.asarray(
+        pallas_radix_histogram(keys, shift=8, radix_bits=4, prefix=3, block_rows=256)
+    )
+    np.testing.assert_array_equal(got, _oracle(keys, 8, 4, 3))
+
+
+def test_pallas_histogram_rejects_64bit():
+    from mpi_k_selection_tpu.utils.x64 import maybe_x64
+
+    with maybe_x64(True):
+        keys = jnp.arange(8, dtype=jnp.uint64)
+        with pytest.raises(ValueError, match="32-bit"):
+            pallas_radix_histogram(keys, shift=0, radix_bits=4)
+
+
+def test_masked_histogram_pallas_method_dispatch(rng):
+    keys = jnp.asarray(rng.integers(0, 2**32, size=4096, dtype=np.uint32))
+    got = np.asarray(
+        masked_radix_histogram(keys, shift=16, radix_bits=8, prefix=jnp.uint32(3), method="pallas")
+    )
+    np.testing.assert_array_equal(got, _oracle(keys, 16, 8, 3))
+
+
+@pytest.mark.parametrize("radix_bits", [4, 8, 16])
+def test_radix_select_explicit_radix_bits(rng, radix_bits):
+    x = jnp.asarray(rng.integers(-(2**31), 2**31, size=20001, dtype=np.int32))
+    k = 777
+    got = int(radix_select(x, k, radix_bits=radix_bits))
+    assert got == int(np.sort(np.asarray(x))[k - 1])
