@@ -28,6 +28,7 @@ def main() -> int:
     import jax.numpy as jnp
     import numpy as np
 
+    from mpi_k_selection_tpu.backends import seq
     from mpi_k_selection_tpu.ops.radix import radix_select
     from mpi_k_selection_tpu.utils import datagen
 
@@ -37,9 +38,10 @@ def main() -> int:
     k = n // 2
     x = datagen.generate(n, pattern="uniform", seed=0, dtype=np.int32)
 
-    # --- baseline: the reference algorithm (sort-then-index) on the host ---
+    # --- baseline: the reference algorithm (sort-then-index) on the host,
+    # via the same oracle implementation the test suite verifies against ---
     t0 = time.perf_counter()
-    want = int(np.sort(x, kind="stable")[k - 1])
+    want = int(seq.kselect_sort(x, k))
     baseline_s = time.perf_counter() - t0
 
     xd = jax.device_put(jnp.asarray(x))
@@ -62,9 +64,12 @@ def main() -> int:
     def timed(run):
         _ = np.asarray(run(xd, kd))  # compile
         best = float("inf")
-        for _i in range(3):
+        for i in range(1, 4):
+            # distinct k0 per repeat: identical repeated calls can be served
+            # from a result cache by the remote-execution layer
+            k0 = jnp.asarray(k - i, jnp.int32)
             t0 = time.perf_counter()
-            _ = np.asarray(run(xd, kd))
+            _ = np.asarray(run(xd, k0))
             best = min(best, time.perf_counter() - t0)
         return best
 

@@ -27,16 +27,18 @@ def plan(n: int, algorithm: str = "auto", distribute: str = "auto"):
     """
     n_dev = len(jax.devices())
     distributable = algorithm in ("auto", "radix")
+    if distribute == "always" and not distributable:
+        # validated independently of the host's device count, so the error
+        # surfaces in single-device CI too
+        raise ValueError(
+            f"algorithm={algorithm!r} has no distributed path; "
+            "use algorithm='radix' (or 'auto') with distribute='always'"
+        )
     use_mesh = {
         "auto": distributable and n_dev > 1 and n >= 1 << 20 and n % n_dev == 0,
         "never": False,
         "always": n_dev > 1,
     }[distribute]
-    if use_mesh and not distributable:
-        raise ValueError(
-            f"algorithm={algorithm!r} has no distributed path; "
-            "use algorithm='radix' (or 'auto') with distribute='always'"
-        )
     if use_mesh:
         return "radix", True
     if algorithm == "auto":

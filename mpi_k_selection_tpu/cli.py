@@ -93,8 +93,12 @@ def _run_kth(args, x):
         effective_algorithm = "partition"
         fn = lambda: backend.kselect(x, k)
     elif args.backend == "mpi":
+        from mpi_k_selection_tpu.native import cgm_driver
+
         effective_algorithm = "cgm"
-        fn = lambda: backend.kselect(x, k, num_procs=args.num_procs, c=args.c)
+        fn = lambda: cgm_driver.kselect_full(x, k, num_procs=args.num_procs, c=args.c)[
+            :2
+        ]
     else:
         import jax.numpy as jnp
 

@@ -1,0 +1,5 @@
+"""Native (C++) runtime: sequential selection engine + multi-process CGM
+collectives — the compiled layer mirroring the reference's gcc/MPICH
+binaries (`seq`, `todo`). See kselect_native.cpp."""
+
+from mpi_k_selection_tpu.native import cgm_driver, loader  # noqa: F401

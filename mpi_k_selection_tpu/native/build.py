@@ -1,0 +1,53 @@
+"""Build the native runtime: ``python -m mpi_k_selection_tpu.native.build``.
+
+One g++ invocation producing ``_build/libkselect_native.so`` next to the
+sources. The loader (loader.py) calls :func:`build` lazily on first use, so
+an explicit build is only needed to rebuild after editing the C++.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import shutil
+import subprocess
+import sys
+
+_DIR = pathlib.Path(__file__).resolve().parent
+SOURCES = [_DIR / "kselect_native.cpp"]
+LIB_PATH = _DIR / "_build" / "libkselect_native.so"
+
+
+def build(force: bool = False, quiet: bool = True) -> pathlib.Path:
+    """Compile the shared library if missing/stale; return its path."""
+    if (
+        not force
+        and LIB_PATH.exists()
+        and all(LIB_PATH.stat().st_mtime >= s.stat().st_mtime for s in SOURCES)
+    ):
+        return LIB_PATH
+    gxx = shutil.which("g++") or shutil.which("clang++")
+    if gxx is None:
+        raise RuntimeError("no C++ compiler found (need g++ or clang++)")
+    LIB_PATH.parent.mkdir(parents=True, exist_ok=True)
+    cmd = [
+        gxx,
+        "-O3",
+        "-std=c++17",
+        "-shared",
+        "-fPIC",
+        "-pthread",
+        "-Wall",
+        *[str(s) for s in SOURCES],
+        "-o",
+        str(LIB_PATH),
+    ]
+    res = subprocess.run(cmd, capture_output=True, text=True)
+    if res.returncode != 0:
+        raise RuntimeError(f"native build failed:\n{res.stderr}")
+    if not quiet:
+        print(f"built {LIB_PATH}")
+    return LIB_PATH
+
+
+if __name__ == "__main__":
+    build(force="--force" in sys.argv, quiet=False)

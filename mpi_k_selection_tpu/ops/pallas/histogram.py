@@ -96,6 +96,10 @@ def pallas_radix_histogram(
     prefix`` (all active when ``prefix`` is None). Returns ``(2**radix_bits,)``
     counts in ``count_dtype``.
     """
+    if pltpu is None:
+        raise NotImplementedError(
+            "the pallas histogram kernel is not available in this jax build"
+        )
     keys = keys.ravel()
     if keys.dtype.itemsize > 4:
         raise ValueError("the pallas histogram kernel supports <=32-bit keys")
