@@ -23,6 +23,7 @@ Public API::
 """
 
 from mpi_k_selection_tpu.version import __version__
+from mpi_k_selection_tpu.buffer import DeviceVector
 from mpi_k_selection_tpu.ops.sort import sort_select
 from mpi_k_selection_tpu.ops.radix import radix_select
 from mpi_k_selection_tpu.ops.topk import topk, batched_topk
@@ -35,6 +36,7 @@ from mpi_k_selection_tpu.parallel import (
 
 __all__ = [
     "__version__",
+    "DeviceVector",
     "kselect",
     "median",
     "sort_select",

@@ -1,0 +1,230 @@
+"""DeviceVector — the TPU-native counterpart of the reference's L1 layer.
+
+The reference's only data structure is the ``IntVector`` growable int array
+(``vector.h:7-11``: ``{int size; int capacity; int *data}``) with an ADT API
+(``vector.h:13-34``). XLA arrays have static shapes, so the TPU equivalent
+keeps a fixed physical ``capacity`` and carries the logical ``size`` as a
+traced scalar: a pytree of ``(data[capacity], size)`` that flows through jit,
+with every operation masking on ``iota < size``. Elements past ``size`` are
+dead storage, exactly like the C struct's unused capacity.
+
+API correspondence (reference ``file:line`` -> here):
+
+=====================================  =====================================
+``VecNew``            vector.c:53-70   ``DeviceVector.new`` / ``from_array``
+``VecAdd``            vector.c:73-91   ``add`` (see note on growth)
+``VecDelete``         vector.c:96-105  garbage collection (no-op needed)
+``VecErase``          vector.c:108-121 ``erase`` — faithful O(1)
+                                       swap-with-last, order-destroying
+``MinFind``/``MaxFind`` vector.c:123-159 ``min``/``max`` (masked reductions)
+``AverageFind``       vector.c:162-171 ``sum`` — the reference function is
+                                       misnamed and returns the sum
+                                       (SURVEY.md §2.1); ``mean`` is the
+                                       repaired version
+``VecGetCapacity`` …  vector.c:175-192 ``capacity`` attr, ``size``,
+                                       ``is_full``
+``VecSet``/``VecGet`` vector.c:194-218 ``set``/``get`` (bounds-checked)
+``VecSearch``         vector.c:220-235 ``search`` (masked argmax, not a
+                                       serial scan)
+``VecQuickSort``      vector.c:239-241 ``sort`` (``lax.sort`` with dead
+                                       slots keyed to the order-maximum)
+``VecQuickSort2``     vector.c:23-50   same ``sort`` — the hand-rolled
+                                       quicksort's partition primitive lives
+                                       on as the radix kernels (ops/)
+``VecBinarySearch``   vector.c:249-258 ``binary_search`` (searchsorted)
+``VecBinarySearch2``  vector.c:261-287 same (its linear fallback on miss is
+                                       a reference quirk, not a capability)
+``compact``           (repair)         ordered masked compaction — what the
+                                       CGM discard phase should have used
+                                       instead of ``VecErase`` (SURVEY §2.3)
+=====================================  =====================================
+
+Growth note: ``VecAdd`` reallocs ×2 when full (``vector.c:79-84``), but the
+reference always preallocates exactly and never grows (SURVEY.md §2.1). Here
+``add`` on a full vector grows the buffer ×2 *outside* jit (a concrete-size
+Python-level operation, like realloc) and raises under tracing, where shapes
+must be static.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mpi_k_selection_tpu.utils import dtypes as _dt
+
+
+def _is_traced(*vals) -> bool:
+    return any(isinstance(v, jax.core.Tracer) for v in vals)
+
+
+def _order_max_key(kdt):
+    """All-ones key of the (unsigned) key dtype, computed host-side."""
+    return np.array(~np.uint64(0)).astype(np.dtype(kdt))
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass(frozen=True)
+class DeviceVector:
+    """Fixed-capacity device array with a traced logical size. Immutable:
+    every mutator returns a new DeviceVector (functional JAX style)."""
+
+    data: jax.Array
+    size: jax.Array  # int32 scalar, 0 <= size <= capacity
+
+    # -- pytree protocol -------------------------------------------------
+    def tree_flatten(self):
+        return (self.data, self.size), None
+
+    @classmethod
+    def tree_unflatten(cls, _aux, children):
+        return cls(*children)
+
+    # -- constructors (VecNew, vector.c:53-70) ---------------------------
+    @classmethod
+    def new(cls, capacity: int, dtype=jnp.int32) -> "DeviceVector":
+        return cls(jnp.zeros((capacity,), dtype), jnp.zeros((), jnp.int32))
+
+    @classmethod
+    def from_array(cls, x) -> "DeviceVector":
+        x = jnp.asarray(x).ravel()
+        return cls(x, jnp.asarray(x.shape[0], jnp.int32))
+
+    # -- accessors (vector.c:175-192) ------------------------------------
+    @property
+    def capacity(self) -> int:
+        return self.data.shape[0]
+
+    def is_full(self):
+        return self.size >= self.capacity
+
+    def _mask(self):
+        return jnp.arange(self.capacity) < self.size
+
+    def to_array(self) -> jax.Array:
+        """Live prefix as a plain array (concrete size only)."""
+        if _is_traced(self.size):
+            raise ValueError("to_array needs a concrete size; use .data/.size")
+        return self.data[: int(self.size)]
+
+    # -- append (VecAdd, vector.c:73-91) ---------------------------------
+    def add(self, value) -> "DeviceVector":
+        if not _is_traced(self.size) and int(self.size) >= self.capacity:
+            # realloc x2 growth path (vector.c:79-84) — concrete sizes only
+            grown = jnp.concatenate(
+                [self.data, jnp.zeros((max(1, self.capacity),), self.data.dtype)]
+            )
+            return DeviceVector(grown, self.size)._append(value)
+        return self._append(value)
+
+    def _append(self, value) -> "DeviceVector":
+        # traced append: writing past capacity is a silent clamp (XLA
+        # dynamic_update_slice semantics); callers preallocate like the
+        # reference does (kth-problem-seq.c:19)
+        idx = jnp.clip(self.size, 0, self.capacity - 1)
+        data = self.data.at[idx].set(jnp.asarray(value, self.data.dtype))
+        return DeviceVector(data, jnp.minimum(self.size + 1, self.capacity))
+
+    # -- erase (VecErase, vector.c:108-121) ------------------------------
+    def erase(self, pos) -> "DeviceVector":
+        """Faithful O(1) swap-with-last delete — destroys element order,
+        exactly like the reference (used by its CGM discard sweeps,
+        TODO-kth-problem-cgm.c:208/219; consequence in SURVEY.md §2.3)."""
+        pos = jnp.asarray(pos, jnp.int32)
+        last = jnp.clip(self.size - 1, 0, self.capacity - 1)
+        valid = jnp.logical_and(pos >= 0, pos < self.size)
+        data = self.data.at[jnp.where(valid, pos, last)].set(self.data[last])
+        return DeviceVector(data, jnp.where(valid, self.size - 1, self.size))
+
+    # -- ordered compaction (the TPU-native repair of the discard phase) --
+    def compact(self, keep_mask) -> "DeviceVector":
+        """Keep elements where ``keep_mask`` is True, preserving order —
+        the static-shape replacement for the reference's VecErase discard
+        sweeps: dead slots move to the tail, size shrinks."""
+        keep = jnp.logical_and(jnp.asarray(keep_mask), self._mask())
+        # stable argsort of (!keep) floats kept elements to the front in order
+        order = jnp.argsort(jnp.logical_not(keep), stable=True)
+        return DeviceVector(self.data[order], jnp.sum(keep, dtype=jnp.int32))
+
+    # -- reductions (MinFind/MaxFind vector.c:123-159; AverageFind :162-171)
+    def min(self):
+        """Minimum of live elements (MinFind). Empty -> dtype max, a clean
+        identity instead of the reference's -1-as-error-value conflation."""
+        kdt = _dt.key_dtype(self.data.dtype)
+        big = _dt.from_sortable_bits(jnp.asarray(_order_max_key(kdt)), self.data.dtype)
+        return jnp.min(jnp.where(self._mask(), self.data, big))
+
+    def max(self):
+        small = _dt.from_sortable_bits(
+            jnp.zeros((), _dt.key_dtype(self.data.dtype)), self.data.dtype
+        )
+        return jnp.max(jnp.where(self._mask(), self.data, small))
+
+    def sum(self):
+        """Sum of live elements — what the reference's ``AverageFind``
+        actually computes (it never divides; SURVEY.md §2.1 bug note)."""
+        zero = jnp.zeros((), self.data.dtype)
+        return jnp.sum(jnp.where(self._mask(), self.data, zero))
+
+    def mean(self):
+        """The repaired AverageFind: a real mean over live elements."""
+        n = jnp.maximum(self.size, 1)
+        return self.sum() / n.astype(jnp.float32)
+
+    # -- element access (VecSet/VecGet, vector.c:194-218) ----------------
+    def get(self, i):
+        """Bounds-checked read. Concrete out-of-range -> IndexError (the
+        reference returns the -2 error code, conflating it with data)."""
+        if not _is_traced(i, self.size):
+            if not 0 <= int(i) < int(self.size):
+                raise IndexError(f"get({i}) out of range [0, {int(self.size)})")
+        return self.data[jnp.clip(jnp.asarray(i, jnp.int32), 0, self.capacity - 1)]
+
+    def set(self, i, value) -> "DeviceVector":
+        if not _is_traced(i, self.size):
+            if not 0 <= int(i) < int(self.size):
+                raise IndexError(f"set({i}) out of range [0, {int(self.size)})")
+        i = jnp.clip(jnp.asarray(i, jnp.int32), 0, self.capacity - 1)
+        return DeviceVector(
+            self.data.at[i].set(jnp.asarray(value, self.data.dtype)), self.size
+        )
+
+    # -- search (VecSearch vector.c:220-235) -----------------------------
+    def search(self, element, start_pos=0):
+        """Index of the first live occurrence of ``element`` at or after
+        ``start_pos``; -1 when absent. One masked argmax, not a serial scan."""
+        idx = jnp.arange(self.capacity)
+        hit = (
+            (self.data == jnp.asarray(element, self.data.dtype))
+            & self._mask()
+            & (idx >= jnp.asarray(start_pos, jnp.int32))
+        )
+        first = jnp.argmax(hit)
+        return jnp.where(jnp.any(hit), first.astype(jnp.int32), jnp.int32(-1))
+
+    # -- sort (VecQuickSort vector.c:239-241 / VecQuickSort2 :23-50) -----
+    def sort(self) -> "DeviceVector":
+        """Ascending sort of the live prefix. Dead slots are keyed to the
+        order-maximum so they sink to the tail; one ``lax.sort`` replaces
+        both the libc-qsort wrapper and the hand-rolled quicksort."""
+        keys = _dt.to_sortable_bits(self.data)
+        keys = jnp.where(self._mask(), keys, _order_max_key(keys.dtype))
+        _, data = jax.lax.sort_key_val(keys, self.data)
+        return DeviceVector(data, self.size)
+
+    # -- binary search (VecBinarySearch vector.c:249-258 / :261-287) -----
+    def binary_search(self, element):
+        """Index of ``element`` in a sorted live prefix; -1 when absent.
+        (The reference's fallback-to-linear-scan on miss, vector.c:286, is a
+        quirk, not a capability — searchsorted covers both.)"""
+        keys = _dt.to_sortable_bits(self.data)
+        keys = jnp.where(self._mask(), keys, _order_max_key(keys.dtype))
+        e = _dt.to_sortable_bits(jnp.asarray(element, self.data.dtype))
+        pos = jnp.searchsorted(keys, e)
+        pos_c = jnp.clip(pos, 0, self.capacity - 1)
+        found = jnp.logical_and(pos < self.size, keys[pos_c] == e)
+        return jnp.where(found, pos.astype(jnp.int32), jnp.int32(-1))

@@ -1,0 +1,122 @@
+"""DeviceVector ADT vs the reference IntVector semantics (vector.c)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_k_selection_tpu.buffer import DeviceVector
+
+
+def test_new_add_get_size():
+    v = DeviceVector.new(8)
+    assert v.capacity == 8 and int(v.size) == 0
+    for i in [5, 3, 9]:
+        v = v.add(i)
+    assert int(v.size) == 3
+    assert [int(v.get(i)) for i in range(3)] == [5, 3, 9]
+
+
+def test_add_grows_like_realloc():
+    v = DeviceVector.new(2)
+    for i in range(5):
+        v = v.add(i)
+    assert int(v.size) == 5 and v.capacity >= 5
+    assert [int(v.get(i)) for i in range(5)] == list(range(5))
+
+
+def test_get_set_bounds_checked():
+    v = DeviceVector.from_array(np.array([1, 2, 3], np.int32))
+    v2 = v.set(1, 42)
+    assert int(v2.get(1)) == 42 and int(v.get(1)) == 2  # immutable
+    with pytest.raises(IndexError):
+        v.get(3)
+    with pytest.raises(IndexError):
+        v.set(-1, 0)
+
+
+def test_erase_swap_with_last():
+    # faithful VecErase semantics (vector.c:108-121): O(1), order-destroying
+    v = DeviceVector.from_array(np.array([10, 20, 30, 40], np.int32))
+    v = v.erase(1)
+    assert int(v.size) == 3
+    assert sorted(int(v.get(i)) for i in range(3)) == [10, 30, 40]
+    assert int(v.get(1)) == 40  # last element swapped into the hole
+
+
+def test_erase_out_of_range_is_noop():
+    v = DeviceVector.from_array(np.array([1, 2], np.int32))
+    v = v.erase(5)
+    assert int(v.size) == 2
+
+
+def test_compact_preserves_order():
+    x = np.array([7, 1, 8, 2, 9, 3], np.int32)
+    v = DeviceVector.from_array(x)
+    v = v.compact(x > 5)
+    assert int(v.size) == 3
+    assert [int(v.get(i)) for i in range(3)] == [7, 8, 9]
+
+
+def test_min_max_sum_mean():
+    x = np.array([4, -2, 7, 1], np.int32)
+    v = DeviceVector.from_array(x)
+    assert int(v.min()) == -2 and int(v.max()) == 7
+    assert int(v.sum()) == 10  # AverageFind's actual behavior (vector.c:162)
+    assert float(v.mean()) == pytest.approx(2.5)
+
+
+def test_reductions_ignore_dead_slots():
+    v = DeviceVector.new(8).add(5).add(3)
+    assert int(v.min()) == 3 and int(v.max()) == 5 and int(v.sum()) == 8
+
+
+def test_search():
+    v = DeviceVector.from_array(np.array([5, 3, 5, 1], np.int32))
+    assert int(v.search(5)) == 0
+    assert int(v.search(5, start_pos=1)) == 2
+    assert int(v.search(99)) == -1
+
+
+def test_sort_and_binary_search():
+    rng = np.random.default_rng(0)
+    x = rng.integers(-100, 100, size=37, dtype=np.int32)
+    v = DeviceVector.new(64, jnp.int32)
+    for e in x:
+        v = v.add(int(e))
+    v = v.sort()
+    got = [int(v.get(i)) for i in range(37)]
+    assert got == sorted(int(e) for e in x)
+    probe = int(x[7])
+    assert got[int(v.binary_search(probe))] == probe
+    assert int(v.binary_search(101)) == -1
+
+
+def test_sort_float_negatives():
+    x = np.array([0.5, -1.5, -0.0, 2.5, 0.0], np.float32)
+    v = DeviceVector.from_array(x).sort()
+    assert [float(v.get(i)) for i in range(5)] == sorted(x.tolist())
+
+
+def test_jittable_pipeline():
+    # the ADT flows through jit: mask-discard then reduce, all traced
+    @jax.jit
+    def pipeline(v: DeviceVector, pivot):
+        kept = v.compact(v.data < pivot)
+        return kept.size, kept.sum()
+
+    x = np.arange(16, dtype=np.int32)
+    n, s = pipeline(DeviceVector.from_array(x), 10)
+    assert int(n) == 10 and int(s) == 45
+
+
+def test_traced_append_under_scan():
+    # VecAdd usable inside lax control flow (the generation loop analogue,
+    # kth-problem-seq.c:26-28)
+    def body(v, e):
+        return v.add(e), None
+
+    v0 = DeviceVector.new(8)
+    xs = jnp.arange(5, dtype=jnp.int32)
+    v, _ = jax.lax.scan(body, v0, xs)
+    assert int(v.size) == 5 and [int(v.get(i)) for i in range(5)] == list(range(5))
