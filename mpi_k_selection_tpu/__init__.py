@@ -32,6 +32,7 @@ from mpi_k_selection_tpu.parallel import (
     distributed_kselect,
     distributed_radix_select,
     distributed_cgm_select,
+    distributed_topk,
 )
 
 __all__ = [
@@ -46,4 +47,5 @@ __all__ = [
     "distributed_kselect",
     "distributed_radix_select",
     "distributed_cgm_select",
+    "distributed_topk",
 ]

@@ -3,6 +3,7 @@
 from mpi_k_selection_tpu.parallel.cgm import distributed_cgm_select
 from mpi_k_selection_tpu.parallel.mesh import make_mesh, require_distributed, shard_1d
 from mpi_k_selection_tpu.parallel.radix import distributed_radix_select
+from mpi_k_selection_tpu.parallel.topk import distributed_topk
 
 DISTRIBUTED_ALGORITHMS = ("radix", "cgm")
 
@@ -24,6 +25,7 @@ __all__ = [
     "distributed_kselect",
     "distributed_radix_select",
     "distributed_cgm_select",
+    "distributed_topk",
     "make_mesh",
     "require_distributed",
     "shard_1d",

@@ -1,0 +1,96 @@
+"""Distributed top-k over a device mesh.
+
+The reference returns only the single k-th order statistic; top-k is the
+north-star extension (BASELINE.md configs). The distributed form follows the
+same communication shape as the rest of the framework (SURVEY.md §3.2 —
+"O(p) scalars per round, no element redistribution"): each shard computes
+its local top-k on-device, then one ``all_gather`` moves just ``k`` candidate
+values per device (not the data), and a replicated final top-k over the
+``P*k`` candidates yields the exact global result — valid because the global
+top-k is a subset of the union of per-shard top-k sets.
+
+Communication: one all-gather of ``P*k`` elements total, independent of N —
+the analogue of the reference's medians gather (``TODO-kth-problem-cgm.c:
+135-136``), generalized from 1 scalar to k per rank.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import numpy as np
+
+from mpi_k_selection_tpu.ops.topk import topk as local_topk
+from mpi_k_selection_tpu.parallel import mesh as mesh_lib
+from mpi_k_selection_tpu.utils import dtypes as _dt
+
+
+def _pad_with_losers(x, multiple: int, largest: bool):
+    """Pad to a shard multiple with order-extreme *losers* (order-minimum for
+    largest-k, order-maximum for smallest-k), so sentinels can never displace
+    a real element from any shard's local top-k."""
+    n = x.shape[0]
+    rem = n % multiple
+    if rem == 0:
+        return x, n
+    kdt = np.dtype(_dt.key_dtype(x.dtype))
+    key = np.array(0 if largest else ~np.uint64(0)).astype(kdt)
+    sentinel = _dt.from_sortable_bits(jnp.full((multiple - rem,), key, kdt), x.dtype)
+    return jnp.concatenate([x, sentinel]), n
+
+
+@functools.lru_cache(maxsize=64)
+def _jitted_topk(mesh, k, largest, method):
+    """Cached jitted sharded program per (mesh, config) — see parallel/radix.py."""
+    axis = mesh.axis_names[0]
+
+    def shard_fn(xs):
+        vals, idx = local_topk(xs.ravel(), k, largest=largest, method=method)
+        shard = jax.lax.axis_index(axis).astype(jnp.int32)
+        # global index = shard offset + local index (balanced equal shards)
+        gidx = idx.astype(jnp.int64 if jax.config.jax_enable_x64 else jnp.int32)
+        gidx = gidx + shard.astype(gidx.dtype) * xs.shape[0]
+        cand_v = jax.lax.all_gather(vals, axis).reshape(-1)  # (P*k,)
+        cand_i = jax.lax.all_gather(gidx, axis).reshape(-1)
+        top_v, pos = local_topk(cand_v, k, largest=largest)
+        return top_v, cand_i[pos]
+
+    # check_vma=False: outputs derive only from all_gather results so they
+    # are replicated by construction, but the jitted local_topk inside the
+    # body defeats static replication inference (same situation as cgm.py)
+    fn = jax.shard_map(
+        shard_fn, mesh=mesh, in_specs=(P(axis),), out_specs=(P(), P()), check_vma=False
+    )
+    return jax.jit(fn)
+
+
+def distributed_topk(x, k: int, *, largest: bool = True, mesh=None, method: str = "auto"):
+    """Exact global top-k of sharded 1-D ``x``. Returns replicated
+    ``(values, global_indices)`` sorted by rank.
+
+    Values are always exact. When n is not a multiple of the mesh size AND
+    the input contains the dtype's order-extreme value (e.g. INT_MIN for
+    largest=False), a tie with a padding sentinel can make a returned *index*
+    point at a padding slot (>= n); the paired value is still exact.
+    """
+    if mesh is None:
+        mesh = mesh_lib.make_mesh()
+    mesh_lib.require_distributed(mesh)
+    x = jnp.ravel(jnp.asarray(x))
+    n = x.shape[0]
+    if not 1 <= k <= n:
+        raise ValueError(f"k={k} out of range [1, {n}]")
+    if k > n // mesh.size:
+        # per-shard top-k cannot exceed the shard size; tiny inputs are not
+        # worth distributing anyway
+        raise ValueError(
+            f"k={k} exceeds the shard size {n // mesh.size}; "
+            "use the single-chip ops.topk for k this large"
+        )
+    x, _ = _pad_with_losers(x, mesh.size, largest)
+    xs = jax.device_put(x, NamedSharding(mesh, P(mesh.axis_names[0])))
+    return _jitted_topk(mesh, int(k), bool(largest), method)(xs)

@@ -73,3 +73,32 @@ def test_topk_duplicates():
 def test_topk_out_of_range():
     with pytest.raises(ValueError):
         topk(jnp.arange(4, dtype=jnp.float32), 5)
+
+
+@pytest.mark.parametrize("method", ["threshold", "tournament"])
+@pytest.mark.parametrize("largest", [True, False])
+def test_topk_large_1d_methods(method, largest):
+    rng = np.random.default_rng(6)
+    for n in ((1 << 18) + 777, 1 << 18):
+        for dtype in (np.float32, np.int32):
+            x = (rng.standard_normal(n) * 100).astype(dtype)  # duplicate-heavy ints
+            for k in (1, 8, 128):
+                vals, idx = topk(jnp.asarray(x), k, largest=largest, method=method)
+                want_vals, _ = seq.topk(x, k, largest=largest)
+                np.testing.assert_array_equal(np.asarray(vals), want_vals)
+                np.testing.assert_array_equal(x[np.asarray(idx)], np.asarray(vals))
+
+
+@pytest.mark.parametrize("method", ["threshold", "tournament"])
+def test_topk_1d_methods_all_equal(method):
+    x = np.full((1 << 16) + 3, -7, np.int32)
+    vals, idx = topk(jnp.asarray(x), 16, method=method)
+    assert np.all(np.asarray(vals) == -7)
+    i = np.asarray(idx)
+    assert i.max() < x.size and len(set(i.tolist())) == 16
+
+
+@pytest.mark.parametrize("method", ["threshold", "tournament"])
+def test_topk_1d_methods_reject_2d(method):
+    with pytest.raises(ValueError, match="1-D"):
+        topk(jnp.zeros((4, 1 << 18), jnp.float32), 2, method=method)
