@@ -71,9 +71,28 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--topk", type=int, default=None, help="return top-k instead of k-th")
     p.add_argument("--smallest", action="store_true", help="top-k smallest instead of largest")
     p.add_argument("--batch", type=int, default=None, help="batch dimension for top-k")
+    p.add_argument(
+        "--topk-method",
+        choices=("auto", "flat", "chunked", "threshold", "tournament"),
+        default="auto",
+        help="top-k algorithm (see ops/topk.py)",
+    )
     p.add_argument("--repeats", type=int, default=1)
     p.add_argument("--verify", action="store_true", help="check against the seq oracle")
+    p.add_argument(
+        "--check",
+        action="store_true",
+        help="verify the answer's rank certificate (O(n) count, no oracle sort)",
+    )
     p.add_argument("--json", action="store_true", help="emit a JSON result record")
+    p.add_argument(
+        "--profile", action="store_true", help="print per-phase wall timing"
+    )
+    p.add_argument(
+        "--trace-dir",
+        default=None,
+        help="write a jax.profiler device trace (TensorBoard format) here",
+    )
     return p
 
 
@@ -156,7 +175,7 @@ def _run_topk(args, x):
         from mpi_k_selection_tpu.ops.topk import topk as _topk
 
         xd = jnp.asarray(x)
-        fn = lambda: _topk(xd, k, largest=not args.smallest)[0]
+        fn = lambda: _topk(xd, k, largest=not args.smallest, method=args.topk_method)[0]
     seconds, values = time_fn(fn, repeats=args.repeats, warmup=1 if args.backend != "seq" else 0)
     values = np.asarray(values)
     record = ResultRecord(
@@ -197,18 +216,42 @@ def main(argv=None) -> int:
     if args.topk is not None and args.backend == "mpi":
         raise SystemExit("error: the mpi backend does not support --topk")
     x64_needed = args.dtype in ("int64", "float64")
+    from mpi_k_selection_tpu.utils import profiling
+
+    timer = profiling.PhaseTimer()
     try:
         with maybe_x64(x64_needed):
-            batch = (args.batch,) if args.batch else ()
-            x = datagen.generate(
-                args.n, pattern=args.gen, seed=args.seed, dtype=args.dtype, batch=batch
+            with timer.phase("generate"):
+                batch = (args.batch,) if args.batch else ()
+                x = datagen.generate(
+                    args.n, pattern=args.gen, seed=args.seed, dtype=args.dtype,
+                    batch=batch,
+                )
+            import contextlib
+
+            tracer = (
+                profiling.trace(args.trace_dir)
+                if args.trace_dir
+                else contextlib.nullcontext()
             )
-            if args.topk is not None:
-                record, ok = _run_topk(args, x)
-            else:
-                record, ok = _run_kth(args, x)
+            with tracer, timer.phase("solve"):
+                if args.topk is not None:
+                    record, ok = _run_topk(args, x)
+                else:
+                    record, ok = _run_kth(args, x)
+            if args.check and args.topk is None:
+                with timer.phase("check"):
+                    from mpi_k_selection_tpu.utils import debug
+
+                    less, leq = debug.rank_certificate(x, record.answer)
+                    cert_ok = int(less) < record.k <= int(leq)
+                    record.extra["rank_certificate"] = [int(less), int(leq)]
+                    record.extra["certificate_ok"] = cert_ok
+                    ok = ok and cert_ok
     except (ValueError, RuntimeError) as e:
         raise SystemExit(f"error: {e}") from e
+    if args.profile:
+        record.extra["phases"] = timer.as_dict()
     if args.json:
         print(record.to_json())
     else:
@@ -216,6 +259,11 @@ def main(argv=None) -> int:
         if args.verify:
             status = "exact match" if ok else "MISMATCH"
             print(f"oracle check: {status}")
+        if args.check:
+            status = "ok" if record.extra.get("certificate_ok") else "FAILED"
+            print(f"rank certificate: {status}")
+        if args.profile:
+            print(timer.report())
     return 0 if ok else 1
 
 

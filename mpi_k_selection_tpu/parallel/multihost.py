@@ -1,0 +1,86 @@
+"""Multi-host distributed runtime (SURVEY.md §5 "distributed communication
+backend", scaled past one host).
+
+The reference scales with ``mpirun -np P`` on one machine or a cluster —
+MPICH handles process bootstrap and transports. The JAX equivalents:
+
+- process bootstrap -> :func:`initialize` (``jax.distributed.initialize``):
+  every host starts the same SPMD program with a coordinator address; after
+  init, ``jax.devices()`` spans all hosts' chips.
+- transports        -> XLA collectives ride ICI within a slice and DCN
+  across slices/hosts automatically, chosen per mesh axis.
+- rank/world        -> :func:`process_index` / :func:`process_count`.
+
+Mesh policy for selection workloads: communication per radix pass is one
+``psum`` of bucket counts — a few hundred bytes — so unlike model
+parallelism there is no locality-sensitive axis layout to get right; a flat
+1-D ``'data'`` axis over every chip in the job is optimal
+(:func:`make_global_mesh`). The hybrid helper
+(:func:`make_hybrid_mesh`) still exposes an explicit (dcn, ici) factorization
+for workloads that want per-host sub-reductions first.
+
+Single-shot batch jobs need no elastic recovery (the reference's only
+failure handling is the world-size abort, ``TODO-kth-problem-cgm.c:56-59``,
+mirrored by ``require_distributed``); a failed host fails the job and the
+job re-runs.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from mpi_k_selection_tpu.parallel.mesh import DATA_AXIS
+
+
+def initialize(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+    **kwargs,
+) -> None:
+    """Join the multi-host job (``jax.distributed.initialize``). On single
+    host or under managed launchers (GKE/Cloud TPU) all arguments are
+    auto-detected and may be omitted."""
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        **kwargs,
+    )
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def make_global_mesh(axis_name: str = DATA_AXIS) -> Mesh:
+    """Flat 1-D mesh over every chip in the job (all hosts)."""
+    return Mesh(np.array(jax.devices()), (axis_name,))
+
+
+def make_hybrid_mesh(
+    dcn_axis: str = "hosts", ici_axis: str = DATA_AXIS
+) -> Mesh:
+    """2-D (hosts, chips-per-host) mesh: reductions over ``ici_axis`` stay on
+    ICI within each host/slice; the small cross-host combine rides DCN."""
+    devices = jax.devices()
+    nproc = jax.process_count()
+    per_host = len(devices) // max(1, nproc)
+    if per_host * nproc != len(devices):
+        raise ValueError(
+            f"{len(devices)} devices do not divide evenly over {nproc} hosts"
+        )
+    grid = np.array(devices).reshape(nproc, per_host)
+    return Mesh(grid, (dcn_axis, ici_axis))
+
+
+def host_local_result(value):
+    """Fetch a replicated scalar result on every host (the analogue of the
+    reference printing from rank 0 only — under SPMD every host holds it)."""
+    return jax.device_get(value)

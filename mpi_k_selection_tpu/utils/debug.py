@@ -1,0 +1,86 @@
+"""Validation / sanitizer subsystem (SURVEY.md §5 "race detection").
+
+JAX's functional model structurally excludes the data races the reference is
+exposed to (its C has a latent use-after-free around the final Gatherv,
+``TODO-kth-problem-cgm.c:250-270``). What remains worth checking is *input*
+sanity — NaNs that break total ordering, out-of-range k, non-finite floats —
+and *result* sanity (the selected value really has rank k). This module is
+that checkable layer:
+
+- :func:`validate_input` — host-side checks before a selection runs.
+- :func:`checked_kselect` — selection + O(n) rank certificate: counts
+  (#less, #less-or-equal) around the answer and asserts ``#less < k <=
+  #less-or-equal`` — the same exactness predicate the reference's hit test
+  uses (``TODO-…:194``), applied as a post-condition.
+- :func:`checkify_kselect` — the jax.experimental.checkify-wrapped kernel
+  for use under jit where host asserts cannot run.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def validate_input(x, k: int, *, allow_nan: bool = False) -> None:
+    """Raise ValueError on inputs that would make selection ill-defined."""
+    x = np.asarray(x)
+    if x.size == 0:
+        raise ValueError("selection requires a non-empty input")
+    if not 1 <= int(k) <= x.size:
+        raise ValueError(f"k={k} out of range [1, {x.size}] (k is 1-indexed)")
+    if not allow_nan and x.dtype.kind == "f" and np.isnan(x).any():
+        raise ValueError(
+            "input contains NaN: NaNs break total ordering; pass "
+            "allow_nan=True to rank them with the IEEE-bits order "
+            "(utils/dtypes.py) instead"
+        )
+
+
+def rank_certificate(x, value):
+    """(#elements < value, #elements <= value) — the L / L+E of the exact-hit
+    test, computed directly as a certificate."""
+    from mpi_k_selection_tpu.utils import dtypes as _dt
+
+    x = jnp.asarray(x).ravel()
+    u = _dt.to_sortable_bits(x)
+    v = _dt.to_sortable_bits(jnp.asarray(value, x.dtype))
+    less = jnp.sum(u < v, dtype=jnp.int64 if jax.config.jax_enable_x64 else jnp.int32)
+    leq = jnp.sum(u <= v, dtype=less.dtype)
+    return less, leq
+
+
+def checked_kselect(x, k: int, **kwargs):
+    """kselect + rank certificate. Raises AssertionError if the returned
+    value is not the exact k-th order statistic."""
+    from mpi_k_selection_tpu import api
+
+    validate_input(x, k, allow_nan=kwargs.pop("allow_nan", False))
+    value = api.kselect(jnp.asarray(x), k, **kwargs)
+    less, leq = rank_certificate(x, value)
+    less, leq = int(less), int(leq)
+    if not less < k <= leq:
+        raise AssertionError(
+            f"selection certificate failed: value {value} has rank range "
+            f"({less}, {leq}] but k={k} — please report this"
+        )
+    return value
+
+
+def checkify_kselect(x, k, **kwargs):
+    """Selection under jax.experimental.checkify: returns (error, value);
+    ``error.throw()`` re-raises any failed in-kernel check on the host."""
+    from jax.experimental import checkify
+
+    from mpi_k_selection_tpu import api
+
+    def run(x, k):
+        checkify.check(k >= 1, "k must be >= 1, got {k}", k=k)
+        checkify.check(
+            k <= x.size, "k must be <= n={n}, got {k}", k=k, n=jnp.asarray(x.size)
+        )
+        return api.kselect(x, k, **kwargs)
+
+    checked = checkify.checkify(run)
+    return checked(jnp.asarray(x), jnp.asarray(k))

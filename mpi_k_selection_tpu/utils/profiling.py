@@ -1,0 +1,113 @@
+"""Tracing / profiling subsystem (SURVEY.md §5).
+
+The reference's entire observability story is one wall-clock pair per run
+(``clock()`` at ``kth-problem-seq.c:30,35``; ``MPI_Wtime()`` at
+``TODO-kth-problem-cgm.c:76,279``). This module is the framework-grade
+replacement:
+
+- :class:`PhaseTimer` — named per-phase wall timing with device sync, the
+  "per-round timing" SURVEY.md §5 calls for; renders a report and a dict.
+- :func:`trace` — context manager around ``jax.profiler`` producing a
+  TensorBoard-loadable device trace (XLA op/kernel level), when available.
+- :func:`device_memory_stats` — HBM usage snapshot per device.
+
+Used by the CLI via ``--profile`` / ``--trace-dir``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from dataclasses import dataclass, field
+
+import jax
+
+
+def _sync(value=None):
+    if value is not None:
+        jax.tree_util.tree_map(
+            lambda a: a.block_until_ready() if hasattr(a, "block_until_ready") else a,
+            value,
+        )
+    return value
+
+
+@dataclass
+class PhaseTimer:
+    """Accumulates named phase durations: ``with timer.phase('sort'): ...``"""
+
+    phases: dict = field(default_factory=dict)
+    counts: dict = field(default_factory=dict)
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self.phases[name] = self.phases.get(name, 0.0) + dt
+            self.counts[name] = self.counts.get(name, 0) + 1
+
+    def record(self, name: str, seconds: float) -> None:
+        self.phases[name] = self.phases.get(name, 0.0) + seconds
+        self.counts[name] = self.counts.get(name, 0) + 1
+
+    @property
+    def total(self) -> float:
+        return sum(self.phases.values())
+
+    def as_dict(self) -> dict:
+        return {
+            name: {"seconds": s, "calls": self.counts[name]}
+            for name, s in self.phases.items()
+        }
+
+    def report(self) -> str:
+        total = self.total or 1.0
+        lines = ["phase timing:"]
+        for name, s in sorted(self.phases.items(), key=lambda kv: -kv[1]):
+            lines.append(
+                f"  {name:<24} {s * 1e3:10.3f} ms  {100 * s / total:5.1f}%"
+                f"  ({self.counts[name]}x)"
+            )
+        lines.append(f"  {'total':<24} {total * 1e3:10.3f} ms")
+        return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Device-level trace via jax.profiler (TensorBoard format). No-op if the
+    profiler is unavailable on this platform."""
+    try:
+        jax.profiler.start_trace(log_dir)
+        started = True
+    except Exception:  # pragma: no cover - platform-dependent
+        started = False
+    try:
+        yield
+    finally:
+        if started:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:  # pragma: no cover
+                pass
+
+
+def device_memory_stats() -> list[dict]:
+    """Per-device memory snapshot (bytes in use / limit when reported)."""
+    out = []
+    for d in jax.devices():
+        stats = {}
+        try:
+            stats = dict(d.memory_stats() or {})
+        except Exception:  # pragma: no cover - backend-dependent
+            pass
+        out.append(
+            {
+                "device": str(d),
+                "bytes_in_use": stats.get("bytes_in_use"),
+                "bytes_limit": stats.get("bytes_limit"),
+            }
+        )
+    return out

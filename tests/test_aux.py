@@ -1,0 +1,89 @@
+"""Aux subsystems (SURVEY.md §5): profiling, validation, multihost mesh."""
+
+import numpy as np
+import pytest
+
+from mpi_k_selection_tpu.utils import debug, profiling
+
+
+def test_phase_timer_accumulates():
+    t = profiling.PhaseTimer()
+    with t.phase("a"):
+        pass
+    with t.phase("a"):
+        pass
+    with t.phase("b"):
+        pass
+    assert t.counts["a"] == 2 and t.counts["b"] == 1
+    assert t.total >= 0 and "phase timing" in t.report()
+    assert set(t.as_dict()) == {"a", "b"}
+
+
+def test_device_memory_stats_shape():
+    stats = profiling.device_memory_stats()
+    assert len(stats) == 8 and all("device" in s for s in stats)
+
+
+def test_validate_input():
+    debug.validate_input(np.arange(4), 2)
+    with pytest.raises(ValueError, match="non-empty"):
+        debug.validate_input(np.array([]), 1)
+    with pytest.raises(ValueError, match="out of range"):
+        debug.validate_input(np.arange(4), 5)
+    with pytest.raises(ValueError, match="NaN"):
+        debug.validate_input(np.array([1.0, np.nan]), 1)
+    debug.validate_input(np.array([1.0, np.nan]), 1, allow_nan=True)
+
+
+def test_rank_certificate_and_checked_kselect():
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 50, size=10_001, dtype=np.int32)  # duplicate-heavy
+    for k in (1, 5_000, 10_001):
+        v = debug.checked_kselect(x, k)
+        less, leq = debug.rank_certificate(x, v)
+        assert int(less) < k <= int(leq)
+        assert int(v) == int(np.sort(x)[k - 1])
+
+
+def test_checkify_kselect_reports_bad_k():
+    import jax.numpy as jnp
+
+    err, _ = debug.checkify_kselect(jnp.arange(16, dtype=jnp.int32), jnp.int32(0))
+    with pytest.raises(Exception, match="k must be"):
+        err.throw()
+    err, v = debug.checkify_kselect(jnp.arange(1, 17, dtype=jnp.int32), jnp.int32(3))
+    err.throw()
+    assert int(v) == 3
+
+
+def test_multihost_single_process_meshes():
+    from mpi_k_selection_tpu.parallel import multihost
+
+    assert multihost.process_count() == 1
+    assert multihost.process_index() == 0
+    m = multihost.make_global_mesh()
+    assert m.size == 8
+    h = multihost.make_hybrid_mesh()
+    assert h.shape["hosts"] == 1 and h.shape["data"] == 8
+
+
+def test_cli_check_and_profile_flags(capsys):
+    from mpi_k_selection_tpu import cli
+
+    rc = cli.main(
+        ["--backend", "seq", "--n", "5000", "--k", "77", "--check", "--profile"]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "rank certificate: ok" in out and "phase timing" in out
+
+
+def test_cli_topk_method_flag(capsys):
+    from mpi_k_selection_tpu import cli
+
+    rc = cli.main(
+        ["--backend", "tpu", "--n", "300000", "--topk", "8", "--dtype", "float32",
+         "--gen", "normal", "--topk-method", "threshold", "--verify"]
+    )
+    assert rc == 0
+    assert "exact match" in capsys.readouterr().out
