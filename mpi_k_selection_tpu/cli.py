@@ -215,6 +215,10 @@ def main(argv=None) -> int:
         raise SystemExit("error: --batch only applies to --topk mode")
     if args.topk is not None and args.backend == "mpi":
         raise SystemExit("error: the mpi backend does not support --topk")
+    if args.check and args.topk is not None:
+        raise SystemExit(
+            "error: --check applies to k-th selection; use --verify for top-k"
+        )
     x64_needed = args.dtype in ("int64", "float64")
     from mpi_k_selection_tpu.utils import profiling
 
@@ -239,7 +243,7 @@ def main(argv=None) -> int:
                     record, ok = _run_topk(args, x)
                 else:
                     record, ok = _run_kth(args, x)
-            if args.check and args.topk is None:
+            if args.check:
                 with timer.phase("check"):
                     from mpi_k_selection_tpu.utils import debug
 

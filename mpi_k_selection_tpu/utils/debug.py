@@ -30,24 +30,29 @@ def validate_input(x, k: int, *, allow_nan: bool = False) -> None:
         raise ValueError("selection requires a non-empty input")
     if not 1 <= int(k) <= x.size:
         raise ValueError(f"k={k} out of range [1, {x.size}] (k is 1-indexed)")
-    if not allow_nan and x.dtype.kind == "f" and np.isnan(x).any():
-        raise ValueError(
-            "input contains NaN: NaNs break total ordering; pass "
-            "allow_nan=True to rank them with the IEEE-bits order "
-            "(utils/dtypes.py) instead"
-        )
+    # jnp.issubdtype, not dtype.kind == 'f': ml_dtypes' bfloat16 has kind 'V'
+    if not allow_nan and jnp.issubdtype(x.dtype, jnp.floating):
+        probe = x if x.dtype.kind == "f" else x.astype(np.float32)
+        if np.isnan(probe).any():
+            raise ValueError(
+                "input contains NaN: NaNs break total ordering; pass "
+                "allow_nan=True to rank them with the IEEE-bits order "
+                "(utils/dtypes.py) instead"
+            )
 
 
 def rank_certificate(x, value):
     """(#elements < value, #elements <= value) — the L / L+E of the exact-hit
     test, computed directly as a certificate."""
+    from mpi_k_selection_tpu.ops.radix import select_count_dtype
     from mpi_k_selection_tpu.utils import dtypes as _dt
 
     x = jnp.asarray(x).ravel()
     u = _dt.to_sortable_bits(x)
     v = _dt.to_sortable_bits(jnp.asarray(value, x.dtype))
-    less = jnp.sum(u < v, dtype=jnp.int64 if jax.config.jax_enable_x64 else jnp.int32)
-    leq = jnp.sum(u <= v, dtype=less.dtype)
+    cdt = select_count_dtype(x.size)  # loud error at n >= 2^31 without x64
+    less = jnp.sum(u < v, dtype=cdt)
+    leq = jnp.sum(u <= v, dtype=cdt)
     return less, leq
 
 

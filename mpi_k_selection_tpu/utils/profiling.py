@@ -5,7 +5,8 @@ The reference's entire observability story is one wall-clock pair per run
 ``TODO-kth-problem-cgm.c:76,279``). This module is the framework-grade
 replacement:
 
-- :class:`PhaseTimer` — named per-phase wall timing with device sync, the
+- :class:`PhaseTimer` — named per-phase wall timing (callers sync devices
+  with ``block_until_ready``/``np.asarray`` where relevant), the
   "per-round timing" SURVEY.md §5 calls for; renders a report and a dict.
 - :func:`trace` — context manager around ``jax.profiler`` producing a
   TensorBoard-loadable device trace (XLA op/kernel level), when available.
@@ -21,15 +22,6 @@ import time
 from dataclasses import dataclass, field
 
 import jax
-
-
-def _sync(value=None):
-    if value is not None:
-        jax.tree_util.tree_map(
-            lambda a: a.block_until_ready() if hasattr(a, "block_until_ready") else a,
-            value,
-        )
-    return value
 
 
 @dataclass
