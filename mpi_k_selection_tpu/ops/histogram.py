@@ -81,11 +81,11 @@ def resolve_hist_method(method: str, key_dtype=None) -> str:
     if method != "auto":
         return method
     if jax.default_backend() == "tpu":
-        # the Pallas kernel is the production path; TPU vector lanes are
-        # 32-bit, so 64-bit keys take the XLA one-hot path instead
+        # the Pallas kernels are the production path; TPU vector lanes are
+        # 32-bit, so 64-bit keys run as two u32 planes ("pallas64")
         if key_dtype is None or np.dtype(key_dtype).itemsize <= 4:
             return "pallas"
-        return "onehot"
+        return "pallas64"
     return "scatter"
 
 
@@ -121,6 +121,20 @@ def masked_radix_histogram(
             prefix=prefix,
             count_dtype=count_dtype,
         )
+    if method == "pallas64":
+        if prefix is not None or shift + radix_bits == 64:
+            from mpi_k_selection_tpu.ops.pallas.histogram import (
+                pallas_radix_histogram64,
+            )
+
+            return pallas_radix_histogram64(
+                keys,
+                shift=shift,
+                radix_bits=radix_bits,
+                prefix=prefix,
+                count_dtype=count_dtype,
+            )
+        method = "onehot"  # prefix-free mid-key shape: rare, XLA fallback
     digits, mask = _digit_and_mask(keys, shift, radix_bits, prefix)
     if method == "scatter":
         return _hist_scatter(digits, mask, nbuckets, count_dtype)

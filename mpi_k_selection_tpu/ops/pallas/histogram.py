@@ -27,8 +27,11 @@ Kernel design (per the TPU architecture, not the reference's C loops):
   dynamic shapes. With ``radix_bits=4`` that is ~34 ops/element/pass,
   streaming near HBM bandwidth.
 
-Only 32-bit-and-narrower keys go through the kernel (TPU vector lanes are
-32-bit); 64-bit keys fall back to the XLA one-hot path in ops/histogram.py.
+TPU vector lanes are 32-bit, so 64-bit keys run as two u32 *planes*
+(``pallas_radix_histogram64``): radix descent resolves the high 32 bits
+first — those passes read only the hi plane through the 32-bit kernel — and
+the low-bit passes use a two-plane kernel whose active test fuses
+``hi == prefix_hi`` into the digit compare with one select.
 """
 
 from __future__ import annotations
@@ -128,17 +131,22 @@ def pallas_radix_histogram(
     kernel = functools.partial(
         _hist_kernel, shift=shift, radix_bits=radix_bits, has_prefix=has_prefix
     )
-    lane_hist = pl.pallas_call(
-        kernel,
-        grid=(grid,),
-        in_specs=[
-            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
-            pl.BlockSpec((block_rows, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec((nb, LANES), lambda i: (0, 0), memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((nb, LANES), jnp.int32),
-        interpret=interpret,
-    )(zref, k2d)
+    # trace the kernel with x64 off: the kernel is int32-only, and Mosaic
+    # fails to legalize programs traced in x64 mode (int64 grid indices)
+    with jax.enable_x64(False):
+        lane_hist = pl.pallas_call(
+            kernel,
+            grid=(grid,),
+            in_specs=[
+                pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+                pl.BlockSpec(
+                    (block_rows, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM
+                ),
+            ],
+            out_specs=pl.BlockSpec((nb, LANES), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((nb, LANES), jnp.int32),
+            interpret=interpret,
+        )(zref, k2d)
     hist = jnp.sum(lane_hist, axis=1, dtype=count_dtype)
 
     pad = pad_to - n
@@ -149,5 +157,130 @@ def pallas_radix_histogram(
             correction = jnp.where(pref == 0, count_dtype(pad), count_dtype(0))
         else:
             correction = count_dtype(pad)
+        hist = hist.at[0].add(-correction)
+    return hist
+
+
+def _hist_kernel64(phi_ref, zlo_ref, hi_ref, lo_ref, out_ref, *, shift, radix_bits):
+    """Low-bit pass over 64-bit keys: digit from the lo plane, activity =
+    (hi plane == prefix_hi) AND (lo high bits == prefix_lo), the latter fused
+    into the digit compare by xor (see _hist_kernel)."""
+    i = pl.program_id(0)
+    hi = hi_ref[:]
+    lo = lo_ref[:]
+    z = jax.lax.shift_right_logical(lo, jnp.int32(shift)) ^ zlo_ref[0, 0]
+    # any hi mismatch forces z out of every bucket; one select, no mask ANDs
+    z = jnp.where(hi == phi_ref[0, 0], z, jnp.int32(1 << (radix_bits + 1)))
+
+    @pl.when(i == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    out_ref[:] += jnp.stack(
+        [
+            jnp.sum(z == jnp.int32(b), axis=0, dtype=jnp.int32)
+            for b in range(1 << radix_bits)
+        ]
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("shift", "radix_bits", "block_rows", "interpret", "count_dtype"),
+)
+def pallas_radix_histogram64(
+    keys: jax.Array,
+    *,
+    shift: int,
+    radix_bits: int,
+    prefix=None,
+    count_dtype=jnp.int32,
+    block_rows: int = 1024,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """64-bit-key variant of :func:`pallas_radix_histogram` (same contract).
+
+    ``prefix=None`` is supported only on the top pass (``shift + radix_bits
+    == 64``) — exactly how radix descent calls it; other prefix-free shapes
+    take the XLA fallback in ops/histogram.py.
+    """
+    if pltpu is None:
+        raise NotImplementedError(
+            "the pallas histogram kernel is not available in this jax build"
+        )
+    keys = keys.ravel()
+    if keys.dtype != jnp.uint64:
+        raise ValueError(f"pallas_radix_histogram64 wants uint64 keys, got {keys.dtype}")
+    if prefix is None and shift + radix_bits != 64:
+        raise ValueError(
+            "prefix=None needs shift + radix_bits == 64 on the 64-bit kernel"
+        )
+    planes = jax.lax.bitcast_convert_type(keys, jnp.uint32)  # (n, 2) LE: lo, hi
+    lo, hi = planes[:, 0], planes[:, 1]
+    if shift >= 32:
+        # digit and the whole prefix live in the hi plane: 32-bit kernel
+        pref32 = None if prefix is None else jnp.asarray(prefix, jnp.uint64).astype(jnp.uint32)
+        return pallas_radix_histogram(
+            hi,
+            shift=shift - 32,
+            radix_bits=radix_bits,
+            prefix=pref32,
+            count_dtype=count_dtype,
+            block_rows=block_rows,
+            interpret=interpret,
+        )
+    if shift + radix_bits > 32:
+        raise ValueError(
+            f"digit at shift={shift} straddles the 32-bit plane boundary; "
+            f"use a radix_bits that divides 32"
+        )
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    n = keys.shape[0]
+    nb = 1 << radix_bits
+
+    pref = jnp.asarray(prefix, jnp.uint64)
+    lo_prefix_bits = 32 - shift - radix_bits  # prefix bits living in the lo plane
+    phi = jax.lax.shift_right_logical(pref, jnp.uint64(lo_prefix_bits)).astype(jnp.uint32)
+    plo = (pref & jnp.uint64((1 << lo_prefix_bits) - 1)).astype(jnp.uint32)
+    zlo = jax.lax.shift_left(plo, jnp.uint32(radix_bits))
+    phi = jax.lax.bitcast_convert_type(phi, jnp.int32).reshape(1, 1)
+    zlo = jax.lax.bitcast_convert_type(zlo, jnp.int32).reshape(1, 1)
+
+    grid = -(-n // (block_rows * LANES))
+    pad_to = grid * block_rows * LANES
+    hi2 = jax.lax.bitcast_convert_type(
+        jnp.pad(hi, (0, pad_to - n)).reshape(grid * block_rows, LANES), jnp.int32
+    )
+    lo2 = jax.lax.bitcast_convert_type(
+        jnp.pad(lo, (0, pad_to - n)).reshape(grid * block_rows, LANES), jnp.int32
+    )
+
+    kernel = functools.partial(_hist_kernel64, shift=shift, radix_bits=radix_bits)
+    # x64 off while tracing: the kernel is int32-only (see 32-bit variant)
+    with jax.enable_x64(False):
+        lane_hist = pl.pallas_call(
+            kernel,
+            grid=(grid,),
+            in_specs=[
+                pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+                pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+                pl.BlockSpec(
+                    (block_rows, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM
+                ),
+                pl.BlockSpec(
+                    (block_rows, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM
+                ),
+            ],
+            out_specs=pl.BlockSpec((nb, LANES), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((nb, LANES), jnp.int32),
+            interpret=interpret,
+        )(phi, zlo, hi2, lo2)
+    hist = jnp.sum(lane_hist, axis=1, dtype=count_dtype)
+
+    pad = pad_to - n
+    if pad:
+        # zero pad keys count in bucket 0 only when the full prefix is zero
+        correction = jnp.where(pref == 0, count_dtype(pad), count_dtype(0))
         hist = hist.at[0].add(-correction)
     return hist

@@ -43,7 +43,7 @@ def default_radix_bits(dtype, hist_method: str = "auto") -> int:
     from mpi_k_selection_tpu.ops.histogram import resolve_hist_method
 
     method = resolve_hist_method(hist_method, _dt.key_dtype(dtype))
-    return 4 if method == "pallas" else 8
+    return 4 if method in ("pallas", "pallas64") else 8
 
 
 def select_count_dtype(n: int):

@@ -69,3 +69,32 @@ def test_radix_select_explicit_radix_bits(rng, radix_bits):
     k = 777
     got = int(radix_select(x, k, radix_bits=radix_bits))
     assert got == int(np.sort(np.asarray(x))[k - 1])
+
+
+@pytest.mark.parametrize(
+    "shift,radix_bits,prefix",
+    [(60, 4, None), (56, 4, 9), (32, 4, 3**10), (28, 4, 11), (0, 4, 2**50 + 17),
+     (24, 8, 77), (48, 8, 5)],
+)
+def test_pallas64_matches_oracle(rng, shift, radix_bits, prefix):
+    from mpi_k_selection_tpu.ops.pallas.histogram import pallas_radix_histogram64
+    from mpi_k_selection_tpu.utils.x64 import enable_x64
+
+    with enable_x64():
+        keys = jnp.asarray(rng.integers(0, 2**64, size=54321, dtype=np.uint64))
+        got = np.asarray(
+            pallas_radix_histogram64(
+                keys, shift=shift, radix_bits=radix_bits, prefix=prefix
+            )
+        )
+        np.testing.assert_array_equal(got, _oracle(keys, shift, radix_bits, prefix))
+
+
+def test_pallas64_prefix_free_midkey_rejected(rng):
+    from mpi_k_selection_tpu.ops.pallas.histogram import pallas_radix_histogram64
+    from mpi_k_selection_tpu.utils.x64 import enable_x64
+
+    with enable_x64():
+        keys = jnp.asarray(rng.integers(0, 2**64, size=128, dtype=np.uint64))
+        with pytest.raises(ValueError, match="prefix=None"):
+            pallas_radix_histogram64(keys, shift=16, radix_bits=4)
