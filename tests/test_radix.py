@@ -98,3 +98,32 @@ def test_extremes_fixture():
     for name, x in datagen.adversarial_fixtures(1024, dtype=np.int32, seed=1):
         k = 100
         assert int(radix_select(jnp.asarray(x), k)) == int(seq.kselect(x, k)), name
+
+
+@pytest.mark.parametrize("pattern", ["uniform", "descending", "equal", "seqlike"])
+def test_early_exit_budget_matches_oracle(pattern):
+    # opt-in cutover path (lax.cond pass skipping + survivor collection)
+    n = 200_001
+    x = datagen.generate(n, pattern=pattern, seed=13, dtype=np.int32)
+    want = np.sort(x)
+    for k in (1, n // 2, n):
+        got = radix_select(jnp.asarray(x), k, early_exit_budget=4096)
+        assert int(got) == int(want[k - 1]), (pattern, k)
+
+
+def test_early_exit_duplicates_straddling_budget():
+    rng = np.random.default_rng(17)
+    x = np.repeat(rng.integers(0, 50, size=100, dtype=np.int32), 5000)
+    rng.shuffle(x)
+    want = np.sort(x)
+    for k in (1, x.size // 2, x.size):
+        got = radix_select(jnp.asarray(x), k, early_exit_budget=4096)
+        assert int(got) == int(want[k - 1]), k
+
+
+def test_early_exit_float32():
+    rng = np.random.default_rng(19)
+    x = rng.standard_normal(100_001).astype(np.float32)
+    k = 31_337
+    got = radix_select(jnp.asarray(x), k, early_exit_budget=4096)
+    assert float(got) == float(np.sort(x)[k - 1])
