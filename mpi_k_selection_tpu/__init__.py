@@ -27,7 +27,7 @@ from mpi_k_selection_tpu.buffer import DeviceVector
 from mpi_k_selection_tpu.ops.sort import sort_select
 from mpi_k_selection_tpu.ops.radix import radix_select
 from mpi_k_selection_tpu.ops.topk import topk, batched_topk
-from mpi_k_selection_tpu.api import kselect, median
+from mpi_k_selection_tpu.api import batched_kselect, batched_median, kselect, median
 from mpi_k_selection_tpu.parallel import (
     distributed_kselect,
     distributed_radix_select,
@@ -40,6 +40,8 @@ __all__ = [
     "DeviceVector",
     "kselect",
     "median",
+    "batched_kselect",
+    "batched_median",
     "sort_select",
     "radix_select",
     "topk",

@@ -41,3 +41,30 @@ def median(x, **kwargs):
     ``TODO-kth-problem-cgm.c~:48``)."""
     x = jnp.asarray(x)
     return kselect(x, max(1, x.size // 2), **kwargs)
+
+
+def batched_kselect(x, k):
+    """Per-row exact k-th smallest along the last axis (1-indexed k).
+
+    ``k`` may be a scalar or an array broadcastable to the batch shape
+    (one rank per row). Batched full sort: ``lax.sort`` over rows is the
+    efficient TPU shape (batch parallelism), and unlike the 1-D case the
+    per-row histogram trick has no batch advantage to exploit.
+    """
+    x = jnp.asarray(x)
+    if x.ndim < 2:
+        raise ValueError("batched_kselect wants a (..., d) batch; use kselect for 1-D")
+    d = x.shape[-1]
+    if isinstance(k, (int, np.integer)) and not 1 <= int(k) <= d:
+        raise ValueError(f"k={k} out of range [1, {d}] (k is 1-indexed)")
+    k = jnp.asarray(k)
+    s = jnp.sort(x, axis=-1)
+    idx = jnp.clip(k.astype(jnp.int32) - 1, 0, d - 1)
+    idx = jnp.broadcast_to(idx, x.shape[:-1])
+    return jnp.take_along_axis(s, idx[..., None], axis=-1)[..., 0]
+
+
+def batched_median(x):
+    """Per-row lower median along the last axis."""
+    x = jnp.asarray(x)
+    return batched_kselect(x, max(1, x.shape[-1] // 2))
