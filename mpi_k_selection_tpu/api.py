@@ -8,10 +8,10 @@ function; the CLI (cli.py) and the backends are thin wrappers over this.
 from __future__ import annotations
 
 import jax.numpy as jnp
-import numpy as np
 
 from mpi_k_selection_tpu.ops.radix import radix_select
 from mpi_k_selection_tpu.ops.sort import sort_select
+from mpi_k_selection_tpu.utils.debug import check_concrete_k
 
 ALGORITHMS = ("auto", "radix", "sort")
 
@@ -22,9 +22,8 @@ def kselect(x, k, *, algorithm: str = "auto", **kwargs):
     x = jnp.asarray(x)
     if x.size == 0:
         raise ValueError("kselect requires a non-empty input")
-    if isinstance(k, (int, np.integer)) and not 1 <= int(k) <= x.size:
-        # concrete k is validated here; traced k is clamped inside the ops
-        raise ValueError(f"k={k} out of range [1, {x.size}] (k is 1-indexed)")
+    # concrete k raises here; traced k is clamped inside the ops
+    check_concrete_k(k, x.size)
     if algorithm == "auto":
         # sort is competitive only for small inputs; radix is O(n) passes.
         algorithm = "sort" if x.size <= 1 << 14 else "radix"
@@ -55,8 +54,7 @@ def batched_kselect(x, k):
     if x.ndim < 2:
         raise ValueError("batched_kselect wants a (..., d) batch; use kselect for 1-D")
     d = x.shape[-1]
-    if isinstance(k, (int, np.integer)) and not 1 <= int(k) <= d:
-        raise ValueError(f"k={k} out of range [1, {d}] (k is 1-indexed)")
+    check_concrete_k(k, d)
     k = jnp.asarray(k)
     s = jnp.sort(x, axis=-1)
     idx = jnp.clip(k.astype(jnp.int32) - 1, 0, d - 1)

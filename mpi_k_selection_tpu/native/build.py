@@ -7,6 +7,7 @@ an explicit build is only needed to rebuild after editing the C++.
 
 from __future__ import annotations
 
+import hashlib
 import pathlib
 import shutil
 import subprocess
@@ -15,35 +16,50 @@ import sys
 _DIR = pathlib.Path(__file__).resolve().parent
 SOURCES = [_DIR / "kselect_native.cpp"]
 LIB_PATH = _DIR / "_build" / "libkselect_native.so"
+STAMP_PATH = LIB_PATH.with_suffix(".so.srchash")
+COMPILE_FLAGS = ["-O3", "-std=c++17", "-shared", "-fPIC", "-pthread", "-Wall"]
+
+
+def _source_hash() -> str:
+    """Content hash of all sources + the compile flags.
+
+    Used for staleness instead of mtimes: git does not preserve mtimes, so an
+    mtime check can declare a stale (or foreign) binary fresh on checkout.
+    Hashing the flags too means a flag change also triggers a rebuild.
+    """
+    h = hashlib.sha256()
+    h.update(" ".join(COMPILE_FLAGS).encode())
+    for s in SOURCES:
+        h.update(s.name.encode())
+        h.update(s.read_bytes())
+    return h.hexdigest()
 
 
 def build(force: bool = False, quiet: bool = True) -> pathlib.Path:
-    """Compile the shared library if missing/stale; return its path."""
+    """Compile the shared library if missing/stale; return its path.
+
+    Staleness is judged by source *content hash* (stamp file next to the
+    .so), never by mtime, so the library is always rebuilt from the sources
+    actually present — a binary that did not come from this exact source is
+    never loaded.
+    """
+    want = _source_hash()
     if (
         not force
         and LIB_PATH.exists()
-        and all(LIB_PATH.stat().st_mtime >= s.stat().st_mtime for s in SOURCES)
+        and STAMP_PATH.exists()
+        and STAMP_PATH.read_text().strip() == want
     ):
         return LIB_PATH
     gxx = shutil.which("g++") or shutil.which("clang++")
     if gxx is None:
         raise RuntimeError("no C++ compiler found (need g++ or clang++)")
     LIB_PATH.parent.mkdir(parents=True, exist_ok=True)
-    cmd = [
-        gxx,
-        "-O3",
-        "-std=c++17",
-        "-shared",
-        "-fPIC",
-        "-pthread",
-        "-Wall",
-        *[str(s) for s in SOURCES],
-        "-o",
-        str(LIB_PATH),
-    ]
+    cmd = [gxx, *COMPILE_FLAGS, *[str(s) for s in SOURCES], "-o", str(LIB_PATH)]
     res = subprocess.run(cmd, capture_output=True, text=True)
     if res.returncode != 0:
         raise RuntimeError(f"native build failed:\n{res.stderr}")
+    STAMP_PATH.write_text(want + "\n")
     if not quiet:
         print(f"built {LIB_PATH}")
     return LIB_PATH

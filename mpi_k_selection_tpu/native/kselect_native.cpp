@@ -248,8 +248,9 @@ int cgm_kselect_i32(const int32_t* data, int64_t n, int64_t k, int num_procs,
   for (int r = 0; r < num_procs; r++) {
     pid_t pid = fork();
     if (pid < 0) {
-      rc = 2;  // fork failed: kill already-spawned ranks
+      rc = 2;  // fork failed: kill and reap already-spawned ranks
       for (pid_t q : pids) kill(q, SIGKILL);
+      for (pid_t q : pids) waitpid(q, nullptr, 0);
       break;
     }
     if (pid == 0) {
@@ -259,11 +260,31 @@ int cgm_kselect_i32(const int32_t* data, int64_t n, int64_t k, int num_procs,
     pids.push_back(pid);
   }
   if (rc == 0) {
-    for (pid_t pid : pids) {
-      int status = 0;
-      if (waitpid(pid, &status, 0) < 0 || !WIFEXITED(status) ||
-          WEXITSTATUS(status) != 0)
-        rc = 2;
+    // Reap with WNOHANG polling (never waitpid(-1): the hosting process may
+    // own unrelated children). If any rank dies abnormally mid-protocol the
+    // survivors are stuck in pthread_barrier_wait forever — kill the rest so
+    // the call returns rc=2 instead of hanging in waitpid.
+    std::vector<bool> done(pids.size(), false);
+    size_t remaining = pids.size();
+    bool killed = false;
+    while (remaining > 0) {
+      bool progressed = false;
+      for (size_t i = 0; i < pids.size(); i++) {
+        if (done[i]) continue;
+        int status = 0;
+        const pid_t w = waitpid(pids[i], &status, WNOHANG);
+        if (w == 0) continue;
+        done[i] = true;
+        remaining--;
+        progressed = true;
+        if (w < 0 || !WIFEXITED(status) || WEXITSTATUS(status) != 0) rc = 2;
+      }
+      if (rc != 0 && !killed) {
+        killed = true;
+        for (size_t i = 0; i < pids.size(); i++)
+          if (!done[i]) kill(pids[i], SIGKILL);
+      }
+      if (remaining > 0 && !progressed) usleep(1000);
     }
   }
   if (rc == 0 && ctl->error != 0) rc = ctl->error;

@@ -54,7 +54,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from mpi_k_selection_tpu.ops.radix import select_count_dtype
 from mpi_k_selection_tpu.parallel import mesh as mesh_lib
-from mpi_k_selection_tpu.utils import dtypes as _dt
+from mpi_k_selection_tpu.utils import debug as _debug, dtypes as _dt
 
 
 def _pvary(value, axis):
@@ -150,6 +150,7 @@ def distributed_cgm_select(
     mesh_lib.require_distributed(mesh)
 
     x = jnp.ravel(jnp.asarray(x))
+    _debug.check_concrete_k(k, x.shape[0])
     x, n = mesh_lib.pad_to_multiple(x, mesh.size)
     # counts sized for the padded total (sentinels are counted too)
     cdt = select_count_dtype(x.shape[0])

@@ -33,7 +33,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from mpi_k_selection_tpu.ops.histogram import masked_radix_histogram
 from mpi_k_selection_tpu.ops.radix import default_radix_bits, select_count_dtype
 from mpi_k_selection_tpu.parallel import mesh as mesh_lib
-from mpi_k_selection_tpu.utils import dtypes as _dt
+from mpi_k_selection_tpu.utils import debug as _debug, dtypes as _dt
 
 
 @functools.lru_cache(maxsize=64)
@@ -92,6 +92,7 @@ def distributed_radix_select(
     mesh_lib.require_distributed(mesh)
 
     x = jnp.ravel(jnp.asarray(x))
+    _debug.check_concrete_k(k, x.shape[0])
     if radix_bits is None:
         radix_bits = default_radix_bits(x.dtype, hist_method)
     x, n = mesh_lib.pad_to_multiple(x, mesh.size)

@@ -26,7 +26,7 @@ import numpy as np
 
 from mpi_k_selection_tpu.ops.topk import topk as local_topk
 from mpi_k_selection_tpu.parallel import mesh as mesh_lib
-from mpi_k_selection_tpu.utils import dtypes as _dt
+from mpi_k_selection_tpu.utils import debug as _debug, dtypes as _dt
 
 
 def _pad_with_losers(x, multiple: int, largest: bool):
@@ -68,22 +68,53 @@ def _jitted_topk(mesh, k, largest, method):
     return jax.jit(fn)
 
 
+def _remap_sentinel_indices(x, n, vals, idx):
+    """Repair indices pointing at padding slots (>= n).
+
+    A padding sentinel can enter the result only by *tying* a real element at
+    the dtype's order-extreme value (it is a loser otherwise), and since
+    n >= k there are always at least as many real occurrences of that value
+    as result slots holding it — so each bad slot can be remapped to a
+    distinct real occurrence. Rare path: runs on host, O(n) scan.
+    """
+    idx_np = np.asarray(idx).copy()
+    bad = np.flatnonzero(idx_np >= n)
+    if bad.size == 0:
+        return idx
+    vals_np = np.asarray(vals)
+    xh = np.asarray(x)
+    # Match on raw bit patterns, not ==: a sentinel tie means *key* equality,
+    # and to_sortable_bits is a bit-level bijection, so key equality is raw
+    # bit equality. For float dtypes the sentinel's payload is a NaN, where
+    # == would never match; bit matching handles every dtype uniformly.
+    udt = {1: np.uint8, 2: np.uint16, 4: np.uint32, 8: np.uint64}[xh.dtype.itemsize]
+    xb = xh.view(udt)
+    vb = vals_np.view(udt)
+    for v in np.unique(vb[bad]):
+        occ = np.flatnonzero(xb == v)
+        taken = set(idx_np[(vb == v) & (idx_np < n)].tolist())
+        free = iter(i for i in occ.tolist() if i not in taken)
+        fallback = int(occ[0]) if occ.size else n - 1
+        for slot in bad[vb[bad] == v]:
+            idx_np[slot] = next(free, fallback)
+    return jnp.asarray(idx_np, dtype=idx.dtype)
+
+
 def distributed_topk(x, k: int, *, largest: bool = True, mesh=None, method: str = "auto"):
     """Exact global top-k of sharded 1-D ``x``. Returns replicated
     ``(values, global_indices)`` sorted by rank.
 
-    Values are always exact. When n is not a multiple of the mesh size AND
-    the input contains the dtype's order-extreme value (e.g. INT_MIN for
-    largest=False), a tie with a padding sentinel can make a returned *index*
-    point at a padding slot (>= n); the paired value is still exact.
+    Exact in both values and indices: when n is not a multiple of the mesh
+    size and the input contains the dtype's order-extreme value, a padding
+    sentinel can tie a real element into the result — such indices are
+    remapped to a real occurrence of the tied value before returning.
     """
     if mesh is None:
         mesh = mesh_lib.make_mesh()
     mesh_lib.require_distributed(mesh)
     x = jnp.ravel(jnp.asarray(x))
     n = x.shape[0]
-    if not 1 <= k <= n:
-        raise ValueError(f"k={k} out of range [1, {n}]")
+    _debug.check_concrete_k(k, n)
     if k > n // mesh.size:
         # per-shard top-k cannot exceed the shard size; tiny inputs are not
         # worth distributing anyway
@@ -91,6 +122,9 @@ def distributed_topk(x, k: int, *, largest: bool = True, mesh=None, method: str 
             f"k={k} exceeds the shard size {n // mesh.size}; "
             "use the single-chip ops.topk for k this large"
         )
-    x, _ = _pad_with_losers(x, mesh.size, largest)
-    xs = jax.device_put(x, NamedSharding(mesh, P(mesh.axis_names[0])))
-    return _jitted_topk(mesh, int(k), bool(largest), method)(xs)
+    xp, _ = _pad_with_losers(x, mesh.size, largest)
+    xs = jax.device_put(xp, NamedSharding(mesh, P(mesh.axis_names[0])))
+    vals, idx = _jitted_topk(mesh, int(k), bool(largest), method)(xs)
+    if xp.shape[0] != n:
+        idx = _remap_sentinel_indices(x, n, vals, idx)
+    return vals, idx

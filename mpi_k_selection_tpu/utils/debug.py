@@ -23,6 +23,24 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def check_concrete_k(k, n: int) -> None:
+    """Raise ValueError when a *concrete* k is outside [1, n].
+
+    Traced k passes through (it is clamped inside the ops — a traced value
+    cannot raise at trace time). This is the one validation contract shared
+    by every public entry point, matching the oracle's hard 1 <= k <= N
+    semantics (``kth-problem-seq.c:24,33``).
+    """
+    if isinstance(k, jax.core.Tracer):
+        return
+    try:
+        kv = int(k)
+    except (TypeError, ValueError):  # non-scalar / non-integer-like: not ours
+        return
+    if not 1 <= kv <= n:
+        raise ValueError(f"k={kv} out of range [1, {n}] (k is 1-indexed)")
+
+
 def validate_input(x, k: int, *, allow_nan: bool = False) -> None:
     """Raise ValueError on inputs that would make selection ill-defined."""
     x = np.asarray(x)
@@ -85,7 +103,9 @@ def checkify_kselect(x, k, **kwargs):
         checkify.check(
             k <= x.size, "k must be <= n={n}, got {k}", k=k, n=jnp.asarray(x.size)
         )
-        return api.kselect(x, k, **kwargs)
+        # clamp so execution proceeds past a failed check (the error is
+        # carried in the checkify state and raised by err.throw())
+        return api.kselect(x, jnp.clip(k, 1, x.size), **kwargs)
 
     checked = checkify.checkify(run)
     return checked(jnp.asarray(x), jnp.asarray(k))

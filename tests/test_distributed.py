@@ -103,3 +103,22 @@ def test_int64_distributed(mesh8):
         assert int(distributed_radix_select(x, k, mesh=make_mesh(8))) == int(
             seq.kselect(x, k)
         )
+
+
+def test_concrete_k_raises_everywhere(mesh8):
+    """Unified validation contract: concrete out-of-range k raises ValueError
+    from all four public entry points (oracle semantics, kth-problem-seq.c:24,33)."""
+    from mpi_k_selection_tpu import api
+    from mpi_k_selection_tpu.parallel import distributed_topk
+
+    x = datagen.generate(1 << 12, pattern="uniform", seed=3, dtype=np.int32)
+    n = x.size
+    for bad_k in (0, -5, n + 1):
+        with pytest.raises(ValueError, match="out of range"):
+            api.kselect(x, bad_k)
+        with pytest.raises(ValueError, match="out of range"):
+            distributed_radix_select(x, bad_k, mesh=mesh8)
+        with pytest.raises(ValueError, match="out of range"):
+            distributed_cgm_select(x, bad_k, mesh=mesh8)
+        with pytest.raises(ValueError, match="out of range"):
+            distributed_topk(x, bad_k, mesh=mesh8)

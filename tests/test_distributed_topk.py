@@ -55,3 +55,42 @@ def test_distributed_topk_k_too_large(mesh8):
         distributed_topk(x, 1 << 9, mesh=mesh8)
     with pytest.raises(ValueError, match="out of range"):
         distributed_topk(x, 0, mesh=mesh8)
+
+
+def test_distributed_topk_sentinel_tie_indices(mesh8):
+    """Order-extreme values + ragged n: a padding sentinel ties real elements;
+    returned indices must still point at *real* occurrences (< n)."""
+    n = N + 5  # ragged -> 3 padding sentinels appended
+    for largest in (True, False):
+        extreme = np.int32(np.iinfo(np.int32).min if largest else np.iinfo(np.int32).max)
+        x = np.full(n, extreme, dtype=np.int32)
+        rng = np.random.default_rng(9)
+        lucky = rng.choice(n, size=7, replace=False)
+        x[lucky] = rng.integers(-100, 100, size=7).astype(np.int32)
+        vals, idx = distributed_topk(x, 32, largest=largest, mesh=mesh8)
+        idx = np.asarray(idx)
+        vals = np.asarray(vals)
+        want_v, _ = seq.topk(x, 32, largest=largest)
+        np.testing.assert_array_equal(vals, want_v)
+        assert (idx < n).all(), f"index points at padding slot: {idx}"
+        np.testing.assert_array_equal(x[idx], vals)
+        assert len(set(idx.tolist())) == len(idx), "indices must be distinct"
+
+
+def test_distributed_topk_float_nan_sentinel_indices(mesh8):
+    """Float dtypes: the padding sentinel's payload is a NaN bit pattern, so
+    the remap must match ties bitwise (== never matches NaN)."""
+    n = N + 5
+    # order-minimum float32 key is the -NaN pattern 0xFFFFFFFF (largest=True
+    # sentinel); fill the array with it so sentinels tie into the top-k
+    x = np.full(n, -1, dtype=np.int32).view(np.float32).copy()
+    rng = np.random.default_rng(10)
+    lucky = rng.choice(n, size=7, replace=False)
+    x[lucky] = rng.uniform(-1, 1, size=7).astype(np.float32)
+    vals, idx = distributed_topk(x, 32, largest=True, mesh=mesh8)
+    idx, vals = np.asarray(idx), np.asarray(vals)
+    assert (idx < n).all(), f"index points at padding slot: {idx}"
+    np.testing.assert_array_equal(
+        x[idx].view(np.uint32), vals.view(np.uint32)
+    )  # bitwise: NaN-safe
+    assert len(set(idx.tolist())) == len(idx), "indices must be distinct"
