@@ -3,8 +3,8 @@
 Reproduces the reference's CGM weighted-median k-selection
 (``TODO-kth-problem-cgm.c:35-296``) as P local OS processes communicating
 through the framework's native shared-memory collectives runtime
-(native/shmcoll.cpp), the in-tree equivalent of the MPICH ``libmpi.so.12``
-the reference links. Lands with the native runtime build.
+(native/kselect_native.cpp), the in-tree equivalent of the MPICH
+``libmpi.so.12`` the reference links.
 """
 
 from __future__ import annotations

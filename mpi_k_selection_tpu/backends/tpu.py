@@ -21,18 +21,34 @@ NAME = "tpu"
 def plan(n: int, algorithm: str = "auto", distribute: str = "auto"):
     """Resolve (effective_algorithm, distributed) for a selection of size n.
 
-    Only the radix algorithm has a distributed path; an explicit
+    The radix and cgm algorithms have distributed paths; an explicit
     ``algorithm='sort'`` therefore always runs single-chip, and asking for
     ``distribute='always'`` with it is an error rather than a silent switch.
+    CGM is the reference's multi-rank protocol (``TODO-kth-problem-cgm.c``) —
+    it is *only* distributed, so ``distribute='never'`` with it is an error
+    (mirroring the reference's world_size >= 2 abort at ``:56-59``).
     """
+    if distribute not in ("auto", "never", "always"):
+        raise ValueError(
+            f"distribute={distribute!r} must be one of 'auto', 'never', 'always'"
+        )
     n_dev = len(jax.devices())
+    if algorithm == "cgm":
+        if distribute == "never":
+            raise ValueError(
+                "algorithm='cgm' is the distributed parity protocol and has "
+                "no single-chip path (the reference aborts below 2 ranks, "
+                "TODO-kth-problem-cgm.c:56-59); use algorithm='radix' or "
+                "'sort' single-chip"
+            )
+        return "cgm", True
     distributable = algorithm in ("auto", "radix")
     if distribute == "always" and not distributable:
         # validated independently of the host's device count, so the error
         # surfaces in single-device CI too
         raise ValueError(
             f"algorithm={algorithm!r} has no distributed path; "
-            "use algorithm='radix' (or 'auto') with distribute='always'"
+            "use algorithm='radix', 'cgm' (or 'auto') with distribute='always'"
         )
     use_mesh = {
         "auto": distributable and n_dev > 1 and n >= 1 << 20 and n % n_dev == 0,
@@ -51,8 +67,10 @@ def kselect(x, k: int, *, algorithm: str = "auto", distribute: str = "auto", **k
     n = np.asarray(x).size if not hasattr(x, "size") else x.size
     algorithm, use_mesh = plan(n, algorithm, distribute)
     if use_mesh:
-        from mpi_k_selection_tpu.parallel import radix as pradix
+        from mpi_k_selection_tpu.parallel import cgm as pcgm, radix as pradix
 
+        if algorithm == "cgm":
+            return pcgm.distributed_cgm_select(jnp.asarray(x), k, **kwargs)
         return pradix.distributed_radix_select(jnp.asarray(x), k, **kwargs)
     return api.kselect(jnp.asarray(x), k, algorithm=algorithm, **kwargs)
 

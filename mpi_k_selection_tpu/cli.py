@@ -34,7 +34,16 @@ from mpi_k_selection_tpu.utils import datagen
 from mpi_k_selection_tpu.utils.timing import ResultRecord, time_fn
 from mpi_k_selection_tpu.utils.x64 import maybe_x64
 
-DTYPES = ("int32", "int64", "uint32", "float32", "float64", "int16", "bfloat16")
+DTYPES = (
+    "int32",
+    "int64",
+    "uint32",
+    "float32",
+    "float64",
+    "float16",
+    "int16",
+    "bfloat16",
+)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -122,15 +131,18 @@ def _run_kth(args, x):
         import jax.numpy as jnp
 
         xd = jnp.asarray(x)
-        if args.algorithm == "cgm":
+        effective_algorithm, distributed = backend.plan(
+            n, args.algorithm, args.distribute
+        )
+        if effective_algorithm == "cgm":
+            # CGM resolves through the same planner as radix; it carries a
+            # per-run round count worth recording, so invoke it directly
             from mpi_k_selection_tpu.parallel import distributed_cgm_select, make_mesh
 
             mesh = make_mesh(args.devices)
             fn = lambda: distributed_cgm_select(xd, k, mesh=mesh, return_rounds=True)
+            effective_algorithm = "cgm-distributed"
         else:
-            effective_algorithm, distributed = backend.plan(
-                n, args.algorithm, args.distribute
-            )
             if distributed:
                 effective_algorithm = "radix-distributed"
             fn = lambda: backend.kselect(
@@ -210,6 +222,29 @@ def _device_count(args) -> int:
 
 
 def main(argv=None) -> int:
+    # Honor JAX_PLATFORMS even on hosts whose site customization pins
+    # jax_platforms at interpreter startup (config wins over the env var):
+    # `JAX_PLATFORMS=cpu` + xla_force_host_platform_device_count is the
+    # supported way to drive the distributed paths on a virtual mesh — the
+    # analogue of running the reference under local mpirun (SURVEY.md §4).
+    import os
+
+    env_platforms = os.environ.get("JAX_PLATFORMS")
+    if env_platforms:
+        import jax
+
+        if jax.config.jax_platforms != env_platforms:
+            jax.config.update("jax_platforms", env_platforms)
+        if jax.default_backend() not in env_platforms.split(","):
+            # config.update is a silent no-op once the backend initialized
+            # (e.g. a programmatic caller touched jax.devices() first)
+            print(
+                f"warning: JAX_PLATFORMS={env_platforms} requested but the "
+                f"jax backend is already initialized on "
+                f"{jax.default_backend()!r}; running there",
+                file=sys.stderr,
+            )
+
     args = build_parser().parse_args(argv)
     if args.batch and args.topk is None:
         raise SystemExit("error: --batch only applies to --topk mode")

@@ -66,7 +66,10 @@ class ResultRecord:
         return json.dumps(d, default=str)
 
     def print_reference_style(self) -> None:
-        # Mirrors the reference's output contract:
-        # "Solution found solution=%d \ntime: %f\n"  (kth-problem-seq.c:37)
-        # "kth element=%d \ntime: %f\n"              (TODO-kth-problem-cgm.c:280)
-        print(f"kth element={self.answer} \ntime: {self.seconds:f}")
+        # Mirrors the reference's per-program output contracts:
+        # seq backend: "Solution found solution=%d \ntime: %f\n" (kth-problem-seq.c:37)
+        # others:      "kth element=%d \ntime: %f\n"  (TODO-kth-problem-cgm.c:280)
+        if self.backend == "seq":
+            print(f"Solution found solution={self.answer} \ntime: {self.seconds:f}")
+        else:
+            print(f"kth element={self.answer} \ntime: {self.seconds:f}")

@@ -42,3 +42,21 @@ def test_datagen_narrow_dtype_clips_not_wraps():
     x = datagen.generate(100_000, pattern="sequential", dtype=np.int16)
     assert x.max() == np.iinfo(np.int16).max  # clipped, no sawtooth
     assert np.all(np.diff(x.astype(np.int64)) >= 0)  # still monotone
+
+
+def test_plan_cgm_is_distributed():
+    algo, dist = tpu_backend.plan(1 << 22, "cgm", "auto")
+    assert algo == "cgm" and dist
+    algo, dist = tpu_backend.plan(1 << 10, "cgm", "always")
+    assert algo == "cgm" and dist
+
+
+def test_plan_cgm_never_is_error():
+    with pytest.raises(ValueError, match="no single-chip path"):
+        tpu_backend.plan(1 << 22, "cgm", "never")
+
+
+def test_backend_kselect_cgm_dispatch(rng):
+    x = rng.integers(0, 10_000, size=1 << 14, dtype=np.int32)
+    got = int(tpu_backend.kselect(x, 4321, algorithm="cgm"))
+    assert got == int(np.sort(x)[4320])

@@ -12,7 +12,16 @@ def test_seq_backend_verify(capsys):
     rc = main(["--backend", "seq", "--n", "10000", "--k", "250", "--verify"])
     out = capsys.readouterr().out
     assert rc == 0
-    assert "kth element=" in out and "exact match" in out
+    # the sequential program's distinct output contract (kth-problem-seq.c:37)
+    assert "Solution found solution=" in out and "exact match" in out
+
+
+def test_tpu_backend_reference_output(capsys):
+    rc = main(["--backend", "tpu", "--n", "20000", "--k", "100", "--distribute", "never"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    # the CGM program's output contract (TODO-kth-problem-cgm.c:280)
+    assert "kth element=" in out
 
 
 def test_tpu_backend_json(capsys):
@@ -78,3 +87,13 @@ def test_reference_operating_point(capsys):
         )
     )
     assert rec["answer"] == int(x[249])
+
+
+def test_float16_dtype(capsys):
+    rc = main(
+        ["--backend", "tpu", "--gen", "funiform", "--dtype", "float16",
+         "--n", "20000", "--k", "500", "--verify", "--json", "--distribute", "never"]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert json.loads(out.strip().splitlines()[-1])["extra"]["exact_match"] is True
