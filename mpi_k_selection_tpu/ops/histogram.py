@@ -111,7 +111,7 @@ def masked_radix_histogram(
     keys = keys.ravel()
     nbuckets = 1 << radix_bits
     method = resolve_hist_method(method, keys.dtype)
-    if method == "pallas":
+    if method in ("pallas", "pallas_compare"):
         from mpi_k_selection_tpu.ops.pallas.histogram import pallas_radix_histogram
 
         return pallas_radix_histogram(
@@ -120,8 +120,9 @@ def masked_radix_histogram(
             radix_bits=radix_bits,
             prefix=prefix,
             count_dtype=count_dtype,
+            packed=method == "pallas",
         )
-    if method == "pallas64":
+    if method in ("pallas64", "pallas64_compare"):
         if prefix is not None or shift + radix_bits == 64:
             from mpi_k_selection_tpu.ops.pallas.histogram import (
                 pallas_radix_histogram64,
@@ -133,6 +134,7 @@ def masked_radix_histogram(
                 radix_bits=radix_bits,
                 prefix=prefix,
                 count_dtype=count_dtype,
+                packed=method == "pallas64",
             )
         method = "onehot"  # prefix-free mid-key shape: rare, XLA fallback
     digits, mask = _digit_and_mask(keys, shift, radix_bits, prefix)

@@ -59,6 +59,39 @@ def select_count_dtype(n: int):
     return jnp.int64
 
 
+def cutover_passes(n: int, total_bits: int, radix_bits: int, budget: int) -> int | None:
+    """Number of full histogram passes to run before the collect-and-sort
+    cutover, or None when the fixed schedule is better.
+
+    Chosen so the *expected* surviving population (``n >> resolved_bits`` for
+    uniform keys) is <= budget/8 — an 8x safety margin for mild skew. Skewed
+    or duplicate-heavy data that still overflows the budget takes the
+    fallback branch (the remaining fixed passes), so the worst case costs
+    the fixed schedule plus one cond, never more. This is the reference
+    CGM's ``< n/(c*p)`` sequential-finish cutover (``TODO-kth-problem-cgm.c:
+    122, 236-280``) rebuilt without data movement until the final collect.
+
+    The cutover only pays when the skipped passes outweigh the collect
+    (one extra scan + a rank-slot gather + a small sort, ~2.5 ms measured on
+    v5e): with the packed histogram kernel at ~4 ps per element-pass the
+    break-even is ``(skipped_passes - 1) * n > ~6e8`` — int32 at the 134M
+    headline config stays on the fixed 8-pass schedule, while 1B-class
+    int32 and every int64/float64 config (16 passes) cut over.
+    """
+    if n < (1 << 20):  # small inputs: pass cost is trivial, skip the cond
+        return None
+    npasses = total_bits // radix_bits
+    r = radix_bits
+    while r < total_bits and (n >> r) > (budget >> 3):
+        r += radix_bits
+    ncut = r // radix_bits
+    if ncut >= npasses:
+        return None
+    if (npasses - ncut - 1) * n <= 600_000_000:  # collect costs ~1 pass + 2.5ms
+        return None
+    return ncut
+
+
 def _collect_prefix_matches(u, resolved_bits, prefix, budget: int, block: int = 1024):
     """Values (in key space) of up to ``budget`` elements whose top
     ``resolved_bits`` bits equal ``prefix`` (both traced), in position order,
@@ -69,16 +102,18 @@ def _collect_prefix_matches(u, resolved_bits, prefix, budget: int, block: int = 
     total_bits = np.dtype(kdt).itemsize * 8
     cdt = jnp.int32 if n < 2**31 else jnp.int64
     nb_ = -(-n // block)
-    up = jnp.pad(u, (0, nb_ * block - n))
+    padded = nb_ * block != n
+    up = jnp.pad(u, (0, nb_ * block - n)) if padded else u
     u2 = up.reshape(nb_, block)
-    mshift = (total_bits - resolved_bits).astype(kdt)  # >= 1 pass ran, so < total
+    mshift = jnp.asarray(total_bits - resolved_bits).astype(kdt)  # >= 1 pass ran
     match2 = jax.lax.shift_right_logical(u2, mshift) == prefix
-    valid = (
-        jax.lax.broadcasted_iota(cdt, (nb_, block), 0) * block
-        + jax.lax.broadcasted_iota(cdt, (nb_, block), 1)
-        < n
-    )
-    match2 = jnp.logical_and(match2, valid)
+    if padded:
+        valid = (
+            jax.lax.broadcasted_iota(cdt, (nb_, block), 0) * block
+            + jax.lax.broadcasted_iota(cdt, (nb_, block), 1)
+            < n
+        )
+        match2 = jnp.logical_and(match2, valid)
     cnt = jnp.sum(match2, axis=1, dtype=cdt)
     off = jnp.cumsum(cnt)
     pop = off[-1]
@@ -89,8 +124,9 @@ def _collect_prefix_matches(u, resolved_bits, prefix, budget: int, block: int = 
     r = target - prev  # 1-based rank within block b
     rows = u2[b]  # (budget, block)
     rmatch = jax.lax.shift_right_logical(rows, mshift) == prefix
-    cols = jax.lax.broadcasted_iota(cdt, (budget, block), 1)
-    rmatch = jnp.logical_and(rmatch, cols < (n - b[:, None] * block))
+    if padded:
+        cols = jax.lax.broadcasted_iota(cdt, (budget, block), 1)
+        rmatch = jnp.logical_and(rmatch, cols < (n - b[:, None] * block))
     within = jnp.cumsum(rmatch.astype(cdt), axis=1)
     local = jnp.argmax(jnp.logical_and(within == r[:, None], rmatch), axis=1)
     vals = rows[jnp.arange(budget), local]
@@ -100,7 +136,14 @@ def _collect_prefix_matches(u, resolved_bits, prefix, budget: int, block: int = 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("radix_bits", "hist_method", "chunk", "early_exit_budget"),
+    static_argnames=(
+        "radix_bits",
+        "hist_method",
+        "chunk",
+        "early_exit_budget",
+        "cutover",
+        "cutover_budget",
+    ),
 )
 def radix_select(
     x: jax.Array,
@@ -110,21 +153,30 @@ def radix_select(
     hist_method: str = "auto",
     chunk: int = 32768,
     early_exit_budget: int | None = None,
+    cutover: int | str | None = "auto",
+    cutover_budget: int = 16384,
 ) -> jax.Array:
     """Exact k-th smallest element of ``x`` (k is 1-indexed, reference semantics).
 
     ``x`` may have any shape (flattened); ``k`` may be a traced scalar.
 
-    ``early_exit_budget``: once the population matching the resolved prefix
-    drops to the budget, remaining histogram passes are skipped (lax.cond)
-    and the survivors are collected and sort-selected directly — the radix
-    analogue of the reference CGM's ``< n/(c*p)`` sequential cutover
-    (``TODO-kth-problem-cgm.c:122, 236-280``), with the budget playing the
-    coarseness role. Adversarial duplicate-heavy inputs simply never
-    trigger it and run all passes. Default ``None`` (fixed pass count):
-    measured on v5e, the per-pass lax.cond wrappers cost more than the
-    skipped passes save (26.8ms vs 11.4ms at N=134M), so the fixed
-    schedule is the production path until XLA handles the conds better.
+    ``cutover`` (the production fast path): after a *static* number of
+    histogram passes, one ``lax.cond`` on the surviving population (free —
+    it is the chosen bucket's count from the pass just run) picks between
+    (a) collecting the <= ``cutover_budget`` survivors and sort-indexing
+    them directly, skipping every remaining pass, or (b) the remaining
+    fixed passes. The radix analogue of the reference CGM's ``< n/(c*p)``
+    sequential-finish cutover (``TODO-kth-problem-cgm.c:122, 236-280``).
+    Unlike the per-pass ``early_exit_budget`` scheme below, the schedule is
+    static and there is exactly one cond, so skewed/duplicate-heavy data
+    that overflows the budget pays only that cond on top of the fixed
+    schedule. ``cutover='auto'`` resolves via :func:`cutover_passes`;
+    an int forces that pass count; None disables.
+
+    ``early_exit_budget`` (kept for research/comparison): per-pass conds
+    skip remaining passes as soon as the population fits. Measured on v5e:
+    the 7 cond wrappers cost more than the skipped passes save (26.8ms vs
+    11.4ms at N=134M) — use ``cutover`` instead.
     """
     x = x.ravel()
     n = x.shape[0]
@@ -158,9 +210,44 @@ def radix_select(
         prefix = bkey if p == 0 else jax.lax.shift_left(prefix, kdt.type(radix_bits)) | bkey
         return prefix, kk, hist[bucket]
 
+    npasses = total_bits // radix_bits
+    if early:
+        ncut = None  # research path below
+    elif cutover == "auto":
+        ncut = cutover_passes(n, total_bits, radix_bits, cutover_budget)
+    elif cutover is None:
+        ncut = None
+    else:
+        ncut = int(cutover)
+        if not 1 <= ncut < npasses:
+            raise ValueError(f"cutover={ncut} out of range [1, {npasses - 1}]")
+
+    if ncut is not None:
+        prefix = jnp.zeros((), kdt)
+        pop = jnp.asarray(n, cdt)
+        for p in range(ncut):
+            prefix, kk, pop = one_pass(p, prefix, kk)
+        resolved = jnp.asarray(ncut * radix_bits, jnp.int32)
+
+        def finish_small(args):
+            prefix, kk = args
+            cand, _pop = _collect_prefix_matches(
+                u, resolved, prefix, cutover_budget, block=128
+            )
+            return jax.lax.sort(cand)[jnp.clip(kk - 1, 0, cutover_budget - 1)]
+
+        def finish_full(args):
+            prefix, kk = args
+            for p in range(ncut, npasses):
+                prefix, kk, _ = one_pass(p, prefix, kk)
+            return prefix
+
+        ans = jax.lax.cond(pop <= cutover_budget, finish_small, finish_full, (prefix, kk))
+        return _dt.from_sortable_bits(ans, x.dtype)
+
     if not early:
         prefix = jnp.zeros((), kdt)
-        for p in range(total_bits // radix_bits):
+        for p in range(npasses):
             prefix, kk, _ = one_pass(p, prefix, kk)
         return _dt.from_sortable_bits(prefix, x.dtype)
 
@@ -169,7 +256,7 @@ def radix_select(
     prefix, kk, pop = one_pass(0, jnp.zeros((), kdt), kk)
     resolved = jnp.asarray(radix_bits, jnp.int32)
     state = (prefix, kk, pop, resolved)
-    for p in range(1, total_bits // radix_bits):
+    for p in range(1, npasses):
         def run(state, p=p):
             prefix, kk, _, resolved = state
             prefix, kk, pop = one_pass(p, prefix, kk)
