@@ -77,6 +77,29 @@ def _hist_onehot(digits, mask, nbuckets, count_dtype, chunk):
     return hist
 
 
+def maybe_split_planes(hist_method: str, keys: jax.Array):
+    """``(hi, lo)`` planes of ``keys`` when the resolved method wants them.
+
+    Pass-loop callers (ops/radix.py, parallel/radix.py) call this once up
+    front and thread the result through ``masked_radix_histogram(...,
+    planes=...)`` — deinterleaving per call re-materializes the strided
+    split every pass (~5x the kernel cost on v5e). Returns None when the
+    resolved method is not a pallas64 variant or ``keys`` is not uint64
+    (e.g. an explicitly forced ``hist_method='pallas64'`` on 32-bit data,
+    which then fails in the kernel with its own clear dtype error).
+    """
+    if keys.dtype != jnp.uint64:
+        return None
+    if resolve_hist_method(hist_method, keys.dtype) not in (
+        "pallas64",
+        "pallas64_compare",
+    ):
+        return None
+    from mpi_k_selection_tpu.ops.pallas.histogram import split_planes
+
+    return split_planes(keys)
+
+
 def resolve_hist_method(method: str, key_dtype=None) -> str:
     if method != "auto":
         return method
@@ -101,12 +124,17 @@ def masked_radix_histogram(
     method: str = "auto",
     count_dtype=jnp.int32,
     chunk: int = 32768,
+    planes: tuple[jax.Array, jax.Array] | None = None,
 ) -> jax.Array:
     """Histogram of the ``radix_bits``-wide digit at ``shift`` over active keys.
 
     ``keys`` must be unsigned (see utils/dtypes.py). An element is active when
     ``keys >> (shift + radix_bits) == prefix``; ``prefix=None`` means all
     elements are active (the first radix pass).
+
+    ``planes=(hi, lo)`` (uint32, from ``pallas.histogram.split_planes``) lets
+    pass-loop callers of 64-bit keys deinterleave once instead of per call;
+    ignored by the non-pallas64 methods, which read ``keys`` directly.
     """
     keys = keys.ravel()
     nbuckets = 1 << radix_bits
@@ -129,12 +157,13 @@ def masked_radix_histogram(
             )
 
             return pallas_radix_histogram64(
-                keys,
+                keys if planes is None else None,
                 shift=shift,
                 radix_bits=radix_bits,
                 prefix=prefix,
                 count_dtype=count_dtype,
                 packed=method == "pallas64",
+                planes=planes,
             )
         method = "onehot"  # prefix-free mid-key shape: rare, XLA fallback
     digits, mask = _digit_and_mask(keys, shift, radix_bits, prefix)

@@ -189,6 +189,11 @@ def radix_select(
     u = _dt.to_sortable_bits(x)
     kdt = u.dtype
 
+    # 64-bit pallas path: deinterleave the u32 planes ONCE for all passes
+    from mpi_k_selection_tpu.ops.histogram import maybe_split_planes
+
+    planes = maybe_split_planes(hist_method, u)
+
     kk = jnp.clip(jnp.asarray(k, cdt), 1, n)
     early = early_exit_budget is not None and n > early_exit_budget
 
@@ -202,6 +207,7 @@ def radix_select(
             method=hist_method,
             count_dtype=cdt,
             chunk=chunk,
+            planes=planes,
         )
         cum = jnp.cumsum(hist)
         bucket = jnp.argmax(cum >= kk)

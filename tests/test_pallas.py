@@ -1,6 +1,15 @@
 """Pallas histogram kernel vs the scatter oracle (interpret mode on CPU).
 
 SURVEY.md §4 test plan: "unit tests for ... each Pallas kernel vs NumPy".
+
+Most cases pass a small explicit ``block_rows``: interpret mode evaluates the
+kernel per grid step in Python, so the production default (4096 rows — tuned
+for v5e HBM streaming) would make each case walk a mostly-padded half-million
+element block; small blocks are faster AND cover multi-step grids + ragged
+tails. The production-default geometry is covered once by the adversarial
+skew test below (which is also the regression test for the SWAR byte-field
+overflow: all elements in one bucket at block_rows > 1920 overflowed the
+8-bit fields before the periodic drain in ``_packed_count``).
 """
 
 import jax.numpy as jnp
@@ -30,7 +39,9 @@ def _oracle(keys, shift, radix_bits, prefix):
 def test_pallas_histogram_matches_oracle(rng, n, shift, radix_bits, prefix):
     keys = jnp.asarray(rng.integers(0, 2**32, size=n, dtype=np.uint32))
     got = np.asarray(
-        pallas_radix_histogram(keys, shift=shift, radix_bits=radix_bits, prefix=prefix)
+        pallas_radix_histogram(
+            keys, shift=shift, radix_bits=radix_bits, prefix=prefix, block_rows=256
+        )
     )
     want = _oracle(keys, shift, radix_bits, prefix)
     np.testing.assert_array_equal(got, want)
@@ -46,6 +57,35 @@ def test_pallas_histogram_small_block_multigrid(rng):
     np.testing.assert_array_equal(got, _oracle(keys, 8, 4, 3))
 
 
+@pytest.mark.parametrize(
+    "radix_bits,block_rows",
+    [(4, 4096), (8, 2048)],  # rb=4: the production default geometry;
+    # rb=8 at the minimal drain-triggering size (interpret-mode trace cost
+    # scales with ngroups*nreg, and the drain fires at any block > 2040 rows)
+)
+def test_pallas_histogram_default_block_adversarial_skew(rng, radix_bits, block_rows):
+    # every element in ONE bucket: the SWAR byte-field overflow case
+    # (counts per field >> 255 without the periodic drain at flushes==17);
+    # rb=8 exercises the multi-register (nreg=32) extract() indexing too
+    n = 300_000
+    keys = jnp.asarray(np.full(n, 0x12345678, dtype=np.uint32))
+    got = np.asarray(
+        pallas_radix_histogram(
+            keys,
+            shift=24 - radix_bits + 4,
+            radix_bits=radix_bits,
+            prefix=jnp.uint32(1),
+            block_rows=block_rows,
+        )
+    )
+    nb = 1 << radix_bits
+    key = 0x12345678 >> (24 - radix_bits + 4)
+    want = np.zeros(nb, np.int64)
+    assert (key >> radix_bits) == 1  # prefix matches
+    want[key & (nb - 1)] = n
+    np.testing.assert_array_equal(got, want)
+
+
 def test_pallas_histogram_rejects_64bit():
     from mpi_k_selection_tpu.utils.x64 import maybe_x64
 
@@ -58,9 +98,9 @@ def test_pallas_histogram_rejects_64bit():
 def test_masked_histogram_pallas_method_dispatch(rng):
     keys = jnp.asarray(rng.integers(0, 2**32, size=4096, dtype=np.uint32))
     got = np.asarray(
-        masked_radix_histogram(keys, shift=16, radix_bits=8, prefix=jnp.uint32(3), method="pallas")
+        masked_radix_histogram(keys, shift=16, radix_bits=4, prefix=jnp.uint32(3), method="pallas")
     )
-    np.testing.assert_array_equal(got, _oracle(keys, 16, 8, 3))
+    np.testing.assert_array_equal(got, _oracle(keys, 16, 4, 3))
 
 
 @pytest.mark.parametrize("radix_bits", [4, 8, 16])
@@ -84,7 +124,34 @@ def test_pallas64_matches_oracle(rng, shift, radix_bits, prefix):
         keys = jnp.asarray(rng.integers(0, 2**64, size=54321, dtype=np.uint64))
         got = np.asarray(
             pallas_radix_histogram64(
-                keys, shift=shift, radix_bits=radix_bits, prefix=prefix
+                keys, shift=shift, radix_bits=radix_bits, prefix=prefix, block_rows=256
+            )
+        )
+        np.testing.assert_array_equal(got, _oracle(keys, shift, radix_bits, prefix))
+
+
+@pytest.mark.parametrize(
+    "shift,radix_bits,prefix", [(60, 4, None), (56, 4, 9), (28, 4, 11), (0, 4, 17)]
+)
+def test_pallas64_planes_path_matches_keys_path(rng, shift, radix_bits, prefix):
+    # split-once planes (the pass-loop fast path) == per-call deinterleave
+    from mpi_k_selection_tpu.ops.pallas.histogram import (
+        pallas_radix_histogram64,
+        split_planes,
+    )
+    from mpi_k_selection_tpu.utils.x64 import enable_x64
+
+    with enable_x64():
+        keys = jnp.asarray(rng.integers(0, 2**64, size=12345, dtype=np.uint64))
+        planes = split_planes(keys)
+        got = np.asarray(
+            pallas_radix_histogram64(
+                None,
+                shift=shift,
+                radix_bits=radix_bits,
+                prefix=prefix,
+                planes=planes,
+                block_rows=256,
             )
         )
         np.testing.assert_array_equal(got, _oracle(keys, shift, radix_bits, prefix))
