@@ -1,19 +1,23 @@
-"""Benchmark harness — prints ONE JSON line for the driver.
+"""Benchmark harness — prints one JSON line per BASELINE.md config.
 
-Headline metric (BASELINE.json): k-select throughput in elems/sec/chip with
-exact-match verification against the sequential oracle. The baseline is the
-reference's own algorithm — sort-then-index (``kth-problem-seq.c:32-33``) —
-measured on this host via NumPy over the identical seeded input, so
-``vs_baseline`` is the speedup of the TPU radix path over the reference
-approach at the reference's operating point (N=1e8-class int32, k=N/2
-median; ``kth-problem-seq.c~:24``).
+The FIRST line is the driver's headline metric (BASELINE.json): k-select
+throughput in elems/sec/chip with exact-match verification against the
+sequential oracle. The baseline is the reference's own algorithm —
+sort-then-index (``kth-problem-seq.c:32-33``) — measured on this host via
+NumPy over the identical seeded input, so ``vs_baseline`` is the speedup of
+the TPU radix path over the reference approach at the reference's operating
+point (N=1e8-class int32, k=N/2 median; ``kth-problem-seq.c~:24``).
+
+Subsequent lines cover the remaining BASELINE.md configs: single-chip top-k
+(N=64M float32, k=128), batched top-k (4096 x 32768 float32, k=8), the
+CGM/MPI parity backend at 4 ranks, and the seq-oracle config.
 
 Timing method: the TPU is reached through a tunnel with ~100 ms round-trip
 latency, and identical repeated calls can be served from a result cache, so
 single-call wall times measure the tunnel, not the chip. Instead we time two
-jitted chains of R1 and R2 *data-dependent* selections (iteration i's k
-depends on iteration i-1's answer, so no iteration can be elided) and report
-the differential (t2 - t1) / (R2 - R1): pure device-side solve time.
+jitted chains of R1 and R2 *data-dependent* iterations (iteration i depends
+on iteration i-1, so no iteration can be elided) and report the differential
+(t2 - t1) / (R2 - R1): pure device-side solve time.
 """
 
 from __future__ import annotations
@@ -23,7 +27,30 @@ import sys
 import time
 
 
-def main() -> int:
+def _emit(rec):
+    print(json.dumps(rec), flush=True)
+
+
+def _timed_chain(build_chain, xd, seed0, reps):
+    """Best-of-3 differential timing of build_chain(reps) jitted chains."""
+    import numpy as np
+
+    r1, r2 = reps
+
+    def t(run):
+        _ = np.asarray(run(xd, seed0(0)))  # compile
+        best = float("inf")
+        for i in range(1, 4):
+            t0 = time.perf_counter()
+            _ = np.asarray(run(xd, seed0(i)))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t1, t2 = t(build_chain(r1)), t(build_chain(r2))
+    return max((t2 - t1) / (r2 - r1), 1e-9)
+
+
+def bench_kselect_headline(on_tpu: bool):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -32,14 +59,13 @@ def main() -> int:
     from mpi_k_selection_tpu.ops.radix import radix_select
     from mpi_k_selection_tpu.utils import datagen
 
-    on_tpu = jax.default_backend() not in ("cpu",)
     # TPU: reference-class N (2^27 = 134M ≈ the reference's 1e8). CPU CI: small.
     n = 1 << 27 if on_tpu else 1 << 22
     k = n // 2
     x = datagen.generate(n, pattern="uniform", seed=0, dtype=np.int32)
 
-    # --- baseline: the reference algorithm (sort-then-index) on the host,
-    # via the same oracle implementation the test suite verifies against ---
+    # baseline: the reference algorithm (sort-then-index) on the host, via
+    # the same oracle implementation the test suite verifies against
     t0 = time.perf_counter()
     want = int(seq.kselect_sort(x, k))
     baseline_s = time.perf_counter() - t0
@@ -49,52 +75,252 @@ def main() -> int:
     got = int(np.asarray(radix_select(xd, kd)))  # compile + correctness check
     exact = got == want
 
-    def chain(reps: int):
+    def chain(reps):
         @jax.jit
         def run(xs, k0):
             def body(_, kk):
                 ans = radix_select(xs, kk)
-                # serialize: next k depends on this answer (defeats caching/CSE)
+                # serialize: next k depends on this answer (defeats caching)
                 return k0 + jnp.abs(ans).astype(jnp.int32) % 7
 
             return jax.lax.fori_loop(0, reps, body, k0)
 
         return run
 
-    def timed(run):
-        _ = np.asarray(run(xd, kd))  # compile
-        best = float("inf")
-        for i in range(1, 4):
-            # distinct k0 per repeat: identical repeated calls can be served
-            # from a result cache by the remote-execution layer
-            k0 = jnp.asarray(k - i, jnp.int32)
-            t0 = time.perf_counter()
-            _ = np.asarray(run(xd, k0))
-            best = min(best, time.perf_counter() - t0)
-        return best
-
-    r1, r2 = (1, 9) if on_tpu else (1, 3)
-    t1, t2 = timed(chain(r1)), timed(chain(r2))
-    per = max((t2 - t1) / (r2 - r1), 1e-9)
-
+    per = _timed_chain(
+        chain,
+        xd,
+        lambda i: jnp.asarray(k - i, jnp.int32),
+        (5, 45) if on_tpu else (1, 3),
+    )
     throughput = n / per if exact else 0.0
-    print(
-        json.dumps(
-            {
-                "metric": "kselect_throughput_1chip",
-                "value": round(throughput, 1),
-                "unit": "elems/sec/chip",
-                "vs_baseline": round(baseline_s / per, 3) if exact else 0.0,
-                "n": n,
-                "k": k,
-                "seconds": round(per, 6),
-                "baseline_seconds": round(baseline_s, 6),
-                "exact_match": exact,
-                "backend": jax.default_backend(),
-            }
+    _emit(
+        {
+            "metric": "kselect_throughput_1chip",
+            "value": round(throughput, 1),
+            "unit": "elems/sec/chip",
+            "vs_baseline": round(baseline_s / per, 3) if exact else 0.0,
+            "n": n,
+            "k": k,
+            "seconds": round(per, 6),
+            "baseline_seconds": round(baseline_s, 6),
+            "exact_match": exact,
+            "backend": "tpu" if on_tpu else "cpu",
+        }
+    )
+    return exact
+
+
+def bench_topk_single(on_tpu: bool):
+    """BASELINE config: single-chip top-k, N=64M float32, k=128 (MoE logits)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mpi_k_selection_tpu.ops.topk import topk
+
+    n = 1 << 26 if on_tpu else 1 << 21
+    k = 128
+    x = np.random.default_rng(1).standard_normal(n).astype(np.float32)
+    want = np.sort(x)[::-1][:k]
+
+    xd = jax.device_put(jnp.asarray(x))
+    vals, idx = topk(xd, k)
+    got = np.asarray(vals)
+    exact = bool(np.array_equal(got, want)) and bool(
+        np.array_equal(np.sort(np.asarray(x)[np.asarray(idx)])[::-1], want)
+    )
+
+    # lax.top_k reference on the same chip, for the speedup column
+    t_ref = _timed_chain(
+        lambda reps: _perturb_chain(lambda xs: jax.lax.top_k(xs, k)[0], reps),
+        xd,
+        lambda i: jnp.uint32(i + 1),
+        (2, 8) if on_tpu else (1, 3),
+    )
+    per = _timed_chain(
+        lambda reps: _perturb_chain(lambda xs: topk(xs, k)[0], reps),
+        xd,
+        lambda i: jnp.uint32(i + 1),
+        (2, 12) if on_tpu else (1, 3),
+    )
+    _emit(
+        {
+            "metric": "topk_64m_f32_k128",
+            "value": round(n / per, 1),
+            "unit": "elems/sec/chip",
+            "vs_baseline": round(t_ref / per, 3),  # speedup over lax.top_k
+            "n": n,
+            "k": k,
+            "seconds": round(per, 6),
+            "lax_topk_seconds": round(t_ref, 6),
+            "exact_match": exact,
+        }
+    )
+    return exact
+
+
+def _perturb_chain(fn, reps):
+    """Chain fn(xs) with a data-dependent single-element perturbation per
+    iteration (in-place on the loop carry — O(1) per step, so the measured
+    time is fn's own). The write is real (value depends on the previous
+    iteration's output), so neither XLA nor a result cache can elide any
+    iteration; exact-match is verified separately on the pristine input."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def run(xs, s0):
+        def body(_, carry):
+            xs, s = carry
+            shape = xs.shape
+            i = (s % jnp.uint32(shape[-1])).astype(jnp.int32)
+            x2 = xs.reshape(-1, shape[-1])
+            delta = ((s & jnp.uint32(1)).astype(xs.dtype) - xs.dtype.type(0.5)) * xs.dtype.type(1e-7)
+            x2 = x2.at[0, i].set(x2[0, i] + delta)
+            xs = x2.reshape(shape)
+            out = fn(xs)
+            bump = jax.lax.bitcast_convert_type(
+                out.ravel()[0].astype(jnp.float32), jnp.uint32
+            )
+            return xs, s + (bump & jnp.uint32(3)) + jnp.uint32(1)
+
+        _, s = jax.lax.fori_loop(0, reps, body, (xs, s0))
+        return s
+
+    return run
+
+
+def bench_topk_batched(on_tpu: bool):
+    """BASELINE config: batched top-k, B=4096 x D=32768 float32, k=8."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mpi_k_selection_tpu.ops.topk import batched_topk
+
+    b, d = (4096, 32768) if on_tpu else (64, 4096)
+    k = 8
+    x = np.random.default_rng(2).standard_normal((b, d)).astype(np.float32)
+    want = -np.sort(-x, axis=-1)[:, :k]
+
+    xd = jax.device_put(jnp.asarray(x))
+    vals, idx = batched_topk(xd, k)
+    exact = bool(np.array_equal(np.asarray(vals), want)) and bool(
+        np.array_equal(
+            -np.sort(-np.take_along_axis(x, np.asarray(idx), axis=-1), axis=-1),
+            want,
         )
     )
-    return 0 if exact else 1
+
+    t_ref = _timed_chain(
+        lambda reps: _perturb_chain(lambda xs: jax.lax.top_k(xs, k)[0], reps),
+        xd,
+        lambda i: jnp.uint32(i + 1),
+        (2, 8) if on_tpu else (1, 3),
+    )
+    per = _timed_chain(
+        lambda reps: _perturb_chain(lambda xs: batched_topk(xs, k)[0], reps),
+        xd,
+        lambda i: jnp.uint32(i + 1),
+        (2, 12) if on_tpu else (1, 3),
+    )
+    _emit(
+        {
+            "metric": "batched_topk_4096x32768_k8",
+            "value": round(b * d / per, 1),
+            "unit": "elems/sec/chip",
+            "vs_baseline": round(t_ref / per, 3),  # speedup over lax.top_k
+            "batch": b,
+            "d": d,
+            "k": k,
+            "seconds": round(per, 6),
+            "lax_topk_seconds": round(t_ref, 6),
+            "exact_match": exact,
+        }
+    )
+    return exact
+
+
+def bench_cgm_native():
+    """BASELINE config: CGM/MPI parity backend, 4 ranks, N=16M, k=N/2.
+
+    Single-shot wall time (includes fork + shm setup — the analogue of one
+    `mpirun -np 4` launch of the reference, `TODO-kth-problem-cgm.c`)."""
+    import numpy as np
+
+    from mpi_k_selection_tpu.utils import datagen
+
+    try:
+        from mpi_k_selection_tpu.backends import mpi as mpi_backend
+
+        n = 1 << 24
+        k = n // 2
+        x = datagen.generate(n, pattern="uniform", seed=3, dtype=np.int32)
+        want = int(np.sort(x, kind="stable")[k - 1])
+        t0 = time.perf_counter()
+        got = int(mpi_backend.kselect(x, k, num_procs=4))
+        dt = time.perf_counter() - t0
+        exact = got == want
+        _emit(
+            {
+                "metric": "cgm_mpi_16m_4ranks",
+                "value": round(n / dt, 1),
+                "unit": "elems/sec",
+                "vs_baseline": 1.0,  # this IS the reference-protocol backend
+                "n": n,
+                "k": k,
+                "seconds": round(dt, 6),
+                "exact_match": exact,
+            }
+        )
+        return exact
+    except Exception as e:
+        _emit({"metric": "cgm_mpi_16m_4ranks", "value": 0.0, "unit": "elems/sec",
+               "vs_baseline": 0.0, "error": str(e)[:200]})
+        # only a missing native toolchain is tolerable; a crash in the
+        # backend itself must fail the bench exit code
+        return "requires the native" in str(e)
+
+
+def bench_seq_oracle():
+    """BASELINE config: the seq program's own workload (N=1M int32, k=N/2)."""
+    import numpy as np
+
+    from mpi_k_selection_tpu.backends import seq
+    from mpi_k_selection_tpu.utils import datagen
+
+    n = 1 << 20
+    k = n // 2
+    x = datagen.generate(n, pattern="uniform", seed=4, dtype=np.int32)
+    t0 = time.perf_counter()
+    _ = int(seq.kselect_sort(x, k))
+    dt = time.perf_counter() - t0
+    _emit(
+        {
+            "metric": "seq_oracle_1m",
+            "value": round(n / dt, 1),
+            "unit": "elems/sec",
+            "vs_baseline": 1.0,  # this IS the reference algorithm
+            "n": n,
+            "k": k,
+            "seconds": round(dt, 6),
+            "exact_match": True,
+        }
+    )
+    return True
+
+
+def main() -> int:
+    import jax
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    ok = bench_kselect_headline(on_tpu)
+    ok &= bench_topk_single(on_tpu)
+    ok &= bench_topk_batched(on_tpu)
+    ok &= bench_cgm_native()
+    ok &= bench_seq_oracle()
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":

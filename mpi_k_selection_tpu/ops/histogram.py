@@ -77,27 +77,37 @@ def _hist_onehot(digits, mask, nbuckets, count_dtype, chunk):
     return hist
 
 
-def maybe_split_planes(hist_method: str, keys: jax.Array):
-    """``(hi, lo)`` planes of ``keys`` when the resolved method wants them.
+def prepare_keys(hist_method: str, keys: jax.Array):
+    """``(tiles, n)`` for the resolved pallas method, or ``(None, None)``.
 
     Pass-loop callers (ops/radix.py, parallel/radix.py) call this once up
     front and thread the result through ``masked_radix_histogram(...,
-    planes=...)`` — deinterleaving per call re-materializes the strided
-    split every pass (~5x the kernel cost on v5e). Returns None when the
-    resolved method is not a pallas64 variant or ``keys`` is not uint64
-    (e.g. an explicitly forced ``hist_method='pallas64'`` on 32-bit data,
-    which then fails in the kernel with its own clear dtype error).
-    """
-    if keys.dtype != jnp.uint64:
-        return None
-    if resolve_hist_method(hist_method, keys.dtype) not in (
-        "pallas64",
-        "pallas64_compare",
-    ):
-        return None
-    from mpi_k_selection_tpu.ops.pallas.histogram import split_planes
+    tiles=..., orig_n=...)``. Preparing per call costs twice: the 64-bit
+    plane deinterleave re-materializes every pass (~5x the kernel cost on
+    v5e), and at 1B-element scale the per-pass pad/reshape views make XLA
+    hold/remat several extra full-size temporaries — enough to blow a 16 GB
+    HBM on their own. ``tiles`` is a 1-tuple (32-bit) or 2-tuple (64-bit
+    hi/lo) of ``(rows, 128)`` uint32 arrays (the kernels enforce uint32 —
+    see prepare_tiles32 for why the dtype is load-bearing); ``n`` is the
+    unpadded length.
 
-    return split_planes(keys)
+    Returns ``(None, None)`` when the resolved method is not a pallas
+    variant or the dtype does not match it (e.g. an explicitly forced
+    ``hist_method='pallas64'`` on 32-bit data, which then fails in the
+    kernel with its own clear dtype error).
+    """
+    method = resolve_hist_method(hist_method, keys.dtype)
+    if method in ("pallas", "pallas_compare") and keys.dtype.itemsize <= 4:
+        from mpi_k_selection_tpu.ops.pallas.histogram import prepare_tiles32
+
+        tiles, n = prepare_tiles32(keys)
+        return (tiles,), n
+    if method in ("pallas64", "pallas64_compare") and keys.dtype == jnp.uint64:
+        from mpi_k_selection_tpu.ops.pallas.histogram import prepare_tiles64
+
+        hi2, lo2, n = prepare_tiles64(keys)
+        return (hi2, lo2), n
+    return None, None
 
 
 def resolve_hist_method(method: str, key_dtype=None) -> str:
@@ -113,7 +123,8 @@ def resolve_hist_method(method: str, key_dtype=None) -> str:
 
 
 @functools.partial(
-    jax.jit, static_argnames=("shift", "radix_bits", "method", "count_dtype", "chunk")
+    jax.jit,
+    static_argnames=("shift", "radix_bits", "method", "count_dtype", "chunk", "orig_n"),
 )
 def masked_radix_histogram(
     keys: jax.Array,
@@ -124,7 +135,8 @@ def masked_radix_histogram(
     method: str = "auto",
     count_dtype=jnp.int32,
     chunk: int = 32768,
-    planes: tuple[jax.Array, jax.Array] | None = None,
+    tiles=None,
+    orig_n: int | None = None,
 ) -> jax.Array:
     """Histogram of the ``radix_bits``-wide digit at ``shift`` over active keys.
 
@@ -132,9 +144,9 @@ def masked_radix_histogram(
     ``keys >> (shift + radix_bits) == prefix``; ``prefix=None`` means all
     elements are active (the first radix pass).
 
-    ``planes=(hi, lo)`` (uint32, from ``pallas.histogram.split_planes``) lets
-    pass-loop callers of 64-bit keys deinterleave once instead of per call;
-    ignored by the non-pallas64 methods, which read ``keys`` directly.
+    ``tiles``/``orig_n`` (from :func:`prepare_keys`) let pass-loop callers
+    build the pallas kernels' tiled views once instead of per call; ignored
+    by the non-pallas methods, which read ``keys`` directly.
     """
     keys = keys.ravel()
     nbuckets = 1 << radix_bits
@@ -143,12 +155,14 @@ def masked_radix_histogram(
         from mpi_k_selection_tpu.ops.pallas.histogram import pallas_radix_histogram
 
         return pallas_radix_histogram(
-            keys,
+            keys if tiles is None else None,
             shift=shift,
             radix_bits=radix_bits,
             prefix=prefix,
             count_dtype=count_dtype,
             packed=method == "pallas",
+            tiles=None if tiles is None else tiles[0],
+            orig_n=orig_n,
         )
     if method in ("pallas64", "pallas64_compare"):
         if prefix is not None or shift + radix_bits == 64:
@@ -157,13 +171,14 @@ def masked_radix_histogram(
             )
 
             return pallas_radix_histogram64(
-                keys if planes is None else None,
+                keys if tiles is None else None,
                 shift=shift,
                 radix_bits=radix_bits,
                 prefix=prefix,
                 count_dtype=count_dtype,
                 packed=method == "pallas64",
-                planes=planes,
+                tiles=None if tiles is None else (tiles[0], tiles[1]),
+                orig_n=orig_n,
             )
         method = "onehot"  # prefix-free mid-key shape: rare, XLA fallback
     digits, mask = _digit_and_mask(keys, shift, radix_bits, prefix)

@@ -57,13 +57,60 @@ def split_planes(keys: jax.Array) -> tuple[jax.Array, jax.Array]:
     planes inside each call re-materializes the strided split every pass
     (XLA does not hoist the large intermediate out of the unrolled pass
     loop) — measured ~5x the kernel's own runtime on v5e. Pass-loop callers
-    (ops/radix.py, parallel/radix.py) split once up front and thread the
-    planes through ``masked_radix_histogram(..., planes=...)`` instead.
+    split + tile once up front via ``ops/histogram.py:prepare_keys`` and
+    thread the result through ``masked_radix_histogram(..., tiles=...,
+    orig_n=...)``.
     """
     keys = keys.ravel()
     hi = jax.lax.shift_right_logical(keys, jnp.uint64(32)).astype(jnp.uint32)
     lo = keys.astype(jnp.uint32)  # truncation: low 32 bits
     return hi, lo
+
+
+def prepare_tiles32(keys: jax.Array, block_rows: int = 4096):
+    """``(tiles, n)``: keys raveled, zero-padded to whole ``(block_rows,
+    LANES)`` blocks, in the kernel's 2-D layout, kept in uint32.
+
+    Pass-loop callers prepare ONCE and thread ``tiles`` through every pass
+    (and the cutover collect): at 1B-element scale, letting each pass
+    re-derive the tiled view makes XLA hold/remat several extra full-size
+    temporaries — enough to exceed a 16 GB HBM by itself. Prepared, the
+    program's big buffers are exactly the input and this one view. The
+    tiles stay uint32 so the collect path can consume the very same buffer
+    (an int32 view would make XLA cancel the bitcast pair and materialize
+    both dtypes' pipelines); the kernels bitcast to int32 per block in VMEM.
+    """
+    keys = keys.ravel()
+    if keys.dtype.itemsize > 4:
+        raise ValueError("prepare_tiles32 wants <=32-bit keys")
+    if keys.dtype != jnp.uint32:
+        keys = keys.astype(jnp.uint32)
+    n = keys.shape[0]
+    grid = -(-n // (block_rows * LANES))
+    pad_to = grid * block_rows * LANES
+    kp = jnp.pad(keys, (0, pad_to - n))
+    return kp.reshape(grid * block_rows, LANES), n
+
+
+def prepare_tiles64(keys: jax.Array, block_rows: int = 4096):
+    """``(hi_tiles, lo_tiles, n)`` for the two-plane 64-bit kernel; the hi
+    tiles also serve the ``shift >= 32`` passes through the 32-bit kernel."""
+    hi, lo = split_planes(keys)
+    hi2, n = prepare_tiles32(hi, block_rows)
+    lo2, _ = prepare_tiles32(lo, block_rows)
+    return hi2, lo2, n
+
+
+def _cap_block_rows(block_rows: int, radix_bits: int) -> int:
+    """Largest safe block height for the kernel's scoped-VMEM budget.
+
+    radix_bits > 4 multiplies the SWAR register footprint (nreg =
+    nbuckets/8 block-sized mask arrays), blowing the 16 MB scoped VMEM at
+    4096 rows; 1024 is the measured-safe height there. The cap always
+    divides the 4096-row prepared tiling, so capped calls still consume
+    prepared tiles (the grid just gets finer).
+    """
+    return min(block_rows, 4096 if radix_bits <= 4 else 1024)
 
 
 def _packed_count(z, out_ref, radix_bits, group=8):
@@ -138,7 +185,8 @@ def _hist_kernel_packed(zref_ref, keys_ref, out_ref, *, shift, radix_bits, has_p
     per-bucket kernel; measured 1.8x end-to-end on v5e (6.2ms vs 11.4ms for
     the 8-pass 134M select). Prefix fusion identical to ``_hist_kernel``."""
     i = pl.program_id(0)
-    k = keys_ref[:]  # (block_rows, LANES) int32 bit pattern of the uint key
+    # tiles arrive uint32 (see prepare_tiles32); work on the int32 bit pattern
+    k = jax.lax.bitcast_convert_type(keys_ref[:], jnp.int32)
     s = jax.lax.shift_right_logical(k, jnp.int32(shift))
     if has_prefix:
         z = s ^ zref_ref[0, 0]
@@ -157,8 +205,8 @@ def _hist_kernel64_packed(phi_ref, zlo_ref, hi_ref, lo_ref, out_ref, *, shift, r
     from the lo plane via the xor trick, hi-plane mismatch pushed out of
     every register gate with one select (see ``_hist_kernel64``)."""
     i = pl.program_id(0)
-    hi = hi_ref[:]
-    lo = lo_ref[:]
+    hi = jax.lax.bitcast_convert_type(hi_ref[:], jnp.int32)
+    lo = jax.lax.bitcast_convert_type(lo_ref[:], jnp.int32)
     z = jax.lax.shift_right_logical(lo, jnp.int32(shift)) ^ zlo_ref[0, 0]
     z = jnp.where(hi == phi_ref[0, 0], z, jnp.int32(1 << (radix_bits + 1)))
 
@@ -179,7 +227,8 @@ def _hist_kernel(zref_ref, keys_ref, out_ref, *, shift, radix_bits, has_prefix):
     is active regardless of its high bits, so ``z`` is just the masked digit.
     """
     i = pl.program_id(0)
-    k = keys_ref[:]  # (block_rows, LANES) int32 bit-pattern of the uint key
+    # tiles arrive uint32 (see prepare_tiles32); work on the int32 bit pattern
+    k = jax.lax.bitcast_convert_type(keys_ref[:], jnp.int32)
     # logical shift on the int32 bit pattern == shift on the uint32 key
     s = jax.lax.shift_right_logical(k, jnp.int32(shift))
     if has_prefix:
@@ -208,10 +257,11 @@ def _hist_kernel(zref_ref, keys_ref, out_ref, *, shift, radix_bits, has_prefix):
         "interpret",
         "count_dtype",
         "packed",
+        "orig_n",
     ),
 )
 def pallas_radix_histogram(
-    keys: jax.Array,
+    keys: jax.Array | None,
     *,
     shift: int,
     radix_bits: int,
@@ -220,6 +270,8 @@ def pallas_radix_histogram(
     block_rows: int = 4096,
     interpret: bool | None = None,
     packed: bool = True,
+    tiles: jax.Array | None = None,
+    orig_n: int | None = None,
 ) -> jax.Array:
     """Histogram of the ``radix_bits`` digit at ``shift`` over active keys.
 
@@ -227,6 +279,10 @@ def pallas_radix_histogram(
     unsigned <= 32 bits, active means ``keys >> (shift + radix_bits) ==
     prefix`` (all active when ``prefix`` is None). Returns ``(2**radix_bits,)``
     counts in ``count_dtype``.
+
+    ``tiles``/``orig_n`` (from :func:`prepare_tiles32`) skip the per-call
+    pad/reshape so pass loops materialize the tiled view once; ``keys`` may
+    be None then. ``block_rows`` must match the prepared tiling.
 
     ``block_rows=4096`` is the measured v5e sweet spot (0.74 ms vs 0.86 ms
     at 1024 for a 537 MB pass, ~89% of HBM peak); 8192 exceeds the 16 MB
@@ -236,22 +292,29 @@ def pallas_radix_histogram(
         raise NotImplementedError(
             "the pallas histogram kernel is not available in this jax build"
         )
-    keys = keys.ravel()
-    if keys.dtype.itemsize > 4:
-        raise ValueError("the pallas histogram kernel supports <=32-bit keys")
-    if keys.dtype != jnp.uint32:
-        keys = keys.astype(jnp.uint32)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    n = keys.shape[0]
     nb = 1 << radix_bits
+    block_rows = _cap_block_rows(block_rows, radix_bits)
 
-    # view as (rows, 128); zero-pad to whole blocks (no masking in-kernel —
-    # the pad contribution is subtracted analytically below)
-    grid = -(-n // (block_rows * LANES))
+    if tiles is None:
+        if keys is None:
+            raise ValueError("need keys or tiles")
+        # view as (rows, 128); zero-pad to whole blocks (no masking
+        # in-kernel — the pad contribution is subtracted analytically below)
+        k2d, n = prepare_tiles32(keys, block_rows)
+    else:
+        if orig_n is None:
+            raise ValueError("tiles needs orig_n (the unpadded key count)")
+        k2d, n = tiles, orig_n
+        if k2d.dtype != jnp.uint32:
+            raise ValueError(f"tiles must be uint32, got {k2d.dtype}")
+        if k2d.shape[0] % block_rows or k2d.shape[1] != LANES:
+            raise ValueError(
+                f"tiles shape {k2d.shape} does not match block_rows={block_rows}"
+            )
+    grid = k2d.shape[0] // block_rows
     pad_to = grid * block_rows * LANES
-    kp = jnp.pad(keys, (0, pad_to - n))
-    k2d = jax.lax.bitcast_convert_type(kp.reshape(grid * block_rows, LANES), jnp.int32)
 
     has_prefix = prefix is not None
     pref = jnp.asarray(0 if prefix is None else prefix, jnp.uint32)
@@ -298,8 +361,8 @@ def _hist_kernel64(phi_ref, zlo_ref, hi_ref, lo_ref, out_ref, *, shift, radix_bi
     (hi plane == prefix_hi) AND (lo high bits == prefix_lo), the latter fused
     into the digit compare by xor (see _hist_kernel)."""
     i = pl.program_id(0)
-    hi = hi_ref[:]
-    lo = lo_ref[:]
+    hi = jax.lax.bitcast_convert_type(hi_ref[:], jnp.int32)
+    lo = jax.lax.bitcast_convert_type(lo_ref[:], jnp.int32)
     z = jax.lax.shift_right_logical(lo, jnp.int32(shift)) ^ zlo_ref[0, 0]
     # any hi mismatch forces z out of every bucket; one select, no mask ANDs
     z = jnp.where(hi == phi_ref[0, 0], z, jnp.int32(1 << (radix_bits + 1)))
@@ -325,6 +388,7 @@ def _hist_kernel64(phi_ref, zlo_ref, hi_ref, lo_ref, out_ref, *, shift, radix_bi
         "interpret",
         "count_dtype",
         "packed",
+        "orig_n",
     ),
 )
 def pallas_radix_histogram64(
@@ -337,7 +401,8 @@ def pallas_radix_histogram64(
     block_rows: int = 4096,
     interpret: bool | None = None,
     packed: bool = True,
-    planes: tuple[jax.Array, jax.Array] | None = None,
+    tiles: tuple[jax.Array, jax.Array] | None = None,
+    orig_n: int | None = None,
 ) -> jax.Array:
     """64-bit-key variant of :func:`pallas_radix_histogram` (same contract).
 
@@ -345,9 +410,9 @@ def pallas_radix_histogram64(
     == 64``) — exactly how radix descent calls it; other prefix-free shapes
     take the XLA fallback in ops/histogram.py.
 
-    ``planes=(hi, lo)`` (uint32, from :func:`split_planes`) skips the
-    per-call deinterleave; pass-loop callers split once up front. ``keys``
-    may be None when planes are given.
+    ``tiles=(hi_tiles, lo_tiles)`` + ``orig_n`` (from
+    :func:`prepare_tiles64`) skip the per-call deinterleave + pad/reshape;
+    pass-loop callers prepare once up front. ``keys`` may be None then.
     """
     if pltpu is None:
         raise NotImplementedError(
@@ -357,26 +422,32 @@ def pallas_radix_histogram64(
         raise ValueError(
             "prefix=None needs shift + radix_bits == 64 on the 64-bit kernel"
         )
-    if planes is None:
+    block_rows = _cap_block_rows(block_rows, radix_bits)
+    if tiles is not None:
+        if orig_n is None:
+            raise ValueError("tiles needs orig_n (the unpadded key count)")
+        hi2, lo2 = tiles
+        if hi2.shape != lo2.shape:
+            raise ValueError(
+                f"tile shape mismatch: hi {hi2.shape} vs lo {lo2.shape}"
+            )
+        if hi2.dtype != jnp.uint32 or lo2.dtype != jnp.uint32:
+            raise ValueError("tiles must be uint32 (hi, lo)")
+        n = orig_n
+    else:
         if keys is None:
-            raise ValueError("need keys or planes")
+            raise ValueError("need keys or tiles")
         keys = keys.ravel()
         if keys.dtype != jnp.uint64:
             raise ValueError(
                 f"pallas_radix_histogram64 wants uint64 keys, got {keys.dtype}"
             )
-        hi, lo = split_planes(keys)
-    else:
-        hi, lo = (p.ravel() for p in planes)
-        if hi.dtype != jnp.uint32 or lo.dtype != jnp.uint32:
-            raise ValueError("planes must be uint32 (hi, lo)")
-        if hi.shape != lo.shape:
-            raise ValueError(f"plane length mismatch: hi {hi.shape} vs lo {lo.shape}")
+        hi2, lo2, n = prepare_tiles64(keys, block_rows)
     if shift >= 32:
         # digit and the whole prefix live in the hi plane: 32-bit kernel
         pref32 = None if prefix is None else jnp.asarray(prefix, jnp.uint64).astype(jnp.uint32)
         return pallas_radix_histogram(
-            hi,
+            None,
             shift=shift - 32,
             radix_bits=radix_bits,
             prefix=pref32,
@@ -384,6 +455,8 @@ def pallas_radix_histogram64(
             block_rows=block_rows,
             interpret=interpret,
             packed=packed,
+            tiles=hi2,
+            orig_n=n,
         )
     if shift + radix_bits > 32:
         raise ValueError(
@@ -392,7 +465,6 @@ def pallas_radix_histogram64(
         )
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    n = hi.shape[0]
     nb = 1 << radix_bits
 
     pref = jnp.asarray(prefix, jnp.uint64)
@@ -403,14 +475,12 @@ def pallas_radix_histogram64(
     phi = jax.lax.bitcast_convert_type(phi, jnp.int32).reshape(1, 1)
     zlo = jax.lax.bitcast_convert_type(zlo, jnp.int32).reshape(1, 1)
 
-    grid = -(-n // (block_rows * LANES))
+    if hi2.shape[0] % block_rows or hi2.shape[1] != LANES:
+        raise ValueError(
+            f"tiles shape {hi2.shape} does not match block_rows={block_rows}"
+        )
+    grid = hi2.shape[0] // block_rows
     pad_to = grid * block_rows * LANES
-    hi2 = jax.lax.bitcast_convert_type(
-        jnp.pad(hi, (0, pad_to - n)).reshape(grid * block_rows, LANES), jnp.int32
-    )
-    lo2 = jax.lax.bitcast_convert_type(
-        jnp.pad(lo, (0, pad_to - n)).reshape(grid * block_rows, LANES), jnp.int32
-    )
 
     kern64 = _hist_kernel64_packed if packed else _hist_kernel64
     kernel = functools.partial(kern64, shift=shift, radix_bits=radix_bits)

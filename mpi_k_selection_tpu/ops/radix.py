@@ -92,26 +92,51 @@ def cutover_passes(n: int, total_bits: int, radix_bits: int, budget: int) -> int
     return ncut
 
 
-def _collect_prefix_matches(u, resolved_bits, prefix, budget: int, block: int = 1024):
+def _collect_prefix_matches(
+    u, resolved_bits, prefix, budget: int, block: int = 1024, n_valid: int | None = None
+):
     """Values (in key space) of up to ``budget`` elements whose top
     ``resolved_bits`` bits equal ``prefix`` (both traced), in position order,
     padded with the order-maximum. Streaming per-block counts + per-slot
-    block gather — no full-length cumsum. Returns (values, population)."""
-    n = u.shape[0]
-    kdt = u.dtype
-    total_bits = np.dtype(kdt).itemsize * 8
-    cdt = jnp.int32 if n < 2**31 else jnp.int64
-    nb_ = -(-n // block)
-    padded = nb_ * block != n
-    up = jnp.pad(u, (0, nb_ * block - n)) if padded else u
-    u2 = up.reshape(nb_, block)
+    block gather — no full-length cumsum. Returns (values, population).
+
+    ``n_valid``: elements at positions >= n_valid are pad, never collected
+    (used when ``u`` is the prepared-tiles view, whose zero pad would
+    otherwise match a zero prefix).
+
+    ``u`` may be 2-D — the prepared ``(rows, 128)`` tiles are consumed AS
+    IS (``block`` is then the tile width): routing the very tensor the
+    histogram passes read into this branch lets XLA share one buffer across
+    the cutover ``cond``; a ravel+reshape round-trip here made XLA
+    rematerialize a second full-size copy inside the branch (OOM at the 1B
+    int32 config).
+    """
+    if u.ndim == 2:
+        nb_, block = u.shape
+        n = u.size
+        nv = n if n_valid is None else n_valid
+        kdt = u.dtype
+        total_bits = np.dtype(kdt).itemsize * 8
+        cdt = jnp.int32 if n < 2**31 else jnp.int64
+        padded = nv != n
+        u2 = u
+    else:
+        n = u.shape[0]
+        nv = n if n_valid is None else n_valid
+        kdt = u.dtype
+        total_bits = np.dtype(kdt).itemsize * 8
+        cdt = jnp.int32 if n < 2**31 else jnp.int64
+        nb_ = -(-n // block)
+        padded = nb_ * block != n or nv != n
+        up = jnp.pad(u, (0, nb_ * block - n)) if nb_ * block != n else u
+        u2 = up.reshape(nb_, block)
     mshift = jnp.asarray(total_bits - resolved_bits).astype(kdt)  # >= 1 pass ran
     match2 = jax.lax.shift_right_logical(u2, mshift) == prefix
     if padded:
         valid = (
             jax.lax.broadcasted_iota(cdt, (nb_, block), 0) * block
             + jax.lax.broadcasted_iota(cdt, (nb_, block), 1)
-            < n
+            < nv
         )
         match2 = jnp.logical_and(match2, valid)
     cnt = jnp.sum(match2, axis=1, dtype=cdt)
@@ -126,7 +151,7 @@ def _collect_prefix_matches(u, resolved_bits, prefix, budget: int, block: int = 
     rmatch = jax.lax.shift_right_logical(rows, mshift) == prefix
     if padded:
         cols = jax.lax.broadcasted_iota(cdt, (budget, block), 1)
-        rmatch = jnp.logical_and(rmatch, cols < (n - b[:, None] * block))
+        rmatch = jnp.logical_and(rmatch, cols < (nv - b[:, None] * block))
     within = jnp.cumsum(rmatch.astype(cdt), axis=1)
     local = jnp.argmax(jnp.logical_and(within == r[:, None], rmatch), axis=1)
     vals = rows[jnp.arange(budget), local]
@@ -189,10 +214,20 @@ def radix_select(
     u = _dt.to_sortable_bits(x)
     kdt = u.dtype
 
-    # 64-bit pallas path: deinterleave the u32 planes ONCE for all passes
-    from mpi_k_selection_tpu.ops.histogram import maybe_split_planes
+    # pallas path: build the kernel's tiled key view ONCE for all passes
+    # (and the cutover collect) — per-pass views make XLA hold/remat extra
+    # full-size temporaries, OOMing 16 GB HBM at the 1B-element config
+    from mpi_k_selection_tpu.ops.histogram import prepare_keys
 
-    planes = maybe_split_planes(hist_method, u)
+    tiles, tiles_n = prepare_keys(hist_method, u)
+    if tiles is not None and len(tiles) == 1:
+        # 32-bit: the collect scans the 2-D tiles tensor itself (the same
+        # uint32 buffer the kernels read) so `u` fuses away and the cutover
+        # cond's branches share one full-size buffer
+        u_collect = tiles[0]
+        n_collect = tiles_n
+    else:
+        u_collect, n_collect = u, None
 
     kk = jnp.clip(jnp.asarray(k, cdt), 1, n)
     early = early_exit_budget is not None and n > early_exit_budget
@@ -207,7 +242,8 @@ def radix_select(
             method=hist_method,
             count_dtype=cdt,
             chunk=chunk,
-            planes=planes,
+            tiles=tiles,
+            orig_n=tiles_n,
         )
         cum = jnp.cumsum(hist)
         bucket = jnp.argmax(cum >= kk)
@@ -238,7 +274,8 @@ def radix_select(
         def finish_small(args):
             prefix, kk = args
             cand, _pop = _collect_prefix_matches(
-                u, resolved, prefix, cutover_budget, block=128
+                u_collect, resolved, prefix, cutover_budget, block=128,
+                n_valid=n_collect,
             )
             return jax.lax.sort(cand)[jnp.clip(kk - 1, 0, cutover_budget - 1)]
 
@@ -272,7 +309,9 @@ def radix_select(
     prefix, kk, pop, resolved = state
 
     def finish_small(_):
-        cand, _pop = _collect_prefix_matches(u, resolved, prefix, early_exit_budget)
+        cand, _pop = _collect_prefix_matches(
+            u_collect, resolved, prefix, early_exit_budget, n_valid=n_collect
+        )
         return jax.lax.sort(cand)[jnp.clip(kk - 1, 0, early_exit_budget - 1)]
 
     # population never fit the budget => every key bit is resolved and all

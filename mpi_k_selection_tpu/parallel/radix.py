@@ -47,13 +47,13 @@ def _jitted_select(mesh, n, total_bits, cdt, radix_bits, hist_method, chunk):
     axis = mesh.axis_names[0]
 
     def shard_fn(xs, kk):
-        from mpi_k_selection_tpu.ops.histogram import maybe_split_planes
+        from mpi_k_selection_tpu.ops.histogram import prepare_keys
 
         u = _dt.to_sortable_bits(xs.ravel())
         kdt = u.dtype
-        # 64-bit pallas path: deinterleave the shard's u32 planes once for
-        # all passes (see ops/pallas/histogram.py:split_planes)
-        planes = maybe_split_planes(hist_method, u)
+        # pallas path: build the shard's tiled key view once for all passes
+        # (see ops/pallas/histogram.py:prepare_tiles32)
+        tiles, tiles_n = prepare_keys(hist_method, u)
         kk = jnp.clip(kk.astype(cdt), 1, n)
         prefix = None
         for p in range(total_bits // radix_bits):
@@ -66,7 +66,8 @@ def _jitted_select(mesh, n, total_bits, cdt, radix_bits, hist_method, chunk):
                 method=hist_method,
                 count_dtype=cdt,
                 chunk=chunk,
-                planes=planes,
+                tiles=tiles,
+                orig_n=tiles_n,
             )
             hist = jax.lax.psum(local, axis)  # the MPI_Allreduce analogue (TODO-…:190)
             cum = jnp.cumsum(hist)

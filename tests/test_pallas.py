@@ -59,14 +59,16 @@ def test_pallas_histogram_small_block_multigrid(rng):
 
 @pytest.mark.parametrize(
     "radix_bits,block_rows",
-    [(4, 4096), (8, 2048)],  # rb=4: the production default geometry;
-    # rb=8 at the minimal drain-triggering size (interpret-mode trace cost
-    # scales with ngroups*nreg, and the drain fires at any block > 2040 rows)
+    [(4, 4096), (8, 2048)],
+    # rb=4 at 4096 rows: the production geometry, where the flushes==17
+    # drain actually fires (needs > 2040 rows). rb=8 is capped to 1024 rows
+    # by _cap_block_rows (scoped VMEM), so it covers the multi-register
+    # (nreg=32) end-of-block extract under skew, NOT the mid-block drain —
+    # that is covered at nreg=32 by test_packed_count_drain_nreg32 below.
 )
 def test_pallas_histogram_default_block_adversarial_skew(rng, radix_bits, block_rows):
     # every element in ONE bucket: the SWAR byte-field overflow case
-    # (counts per field >> 255 without the periodic drain at flushes==17);
-    # rb=8 exercises the multi-register (nreg=32) extract() indexing too
+    # (counts per field >> 255 without the periodic drain at flushes==17)
     n = 300_000
     keys = jnp.asarray(np.full(n, 0x12345678, dtype=np.uint32))
     got = np.asarray(
@@ -83,6 +85,43 @@ def test_pallas_histogram_default_block_adversarial_skew(rng, radix_bits, block_
     want = np.zeros(nb, np.int64)
     assert (key >> radix_bits) == 1  # prefix matches
     want[key & (nb - 1)] = n
+    np.testing.assert_array_equal(got, want)
+
+
+class _FakeRef:
+    """Minimal out_ref stand-in so _packed_count runs outside a kernel."""
+
+    def __init__(self, a):
+        self.a = a
+
+    def __getitem__(self, idx):
+        return self.a[idx]
+
+    def __setitem__(self, idx, v):
+        self.a = v
+
+
+@pytest.mark.parametrize("radix_bits", [4, 8])
+def test_packed_count_drain(rng, radix_bits):
+    # direct unit test of the SWAR accumulator at a drain-triggering height
+    # (> 2040 rows => flushes==17 fires mid-block), including the
+    # multi-register nreg=32 case the kernel-level tests cannot reach
+    # (_cap_block_rows caps rb=8 kernels to 1024 rows for scoped VMEM)
+    import jax.numpy as jnp
+
+    from mpi_k_selection_tpu.ops.pallas.histogram import LANES, _packed_count
+
+    rows = 4096
+    nb = 1 << radix_bits
+    # adversarial: every element in one bucket, plus a random tail
+    z_np = np.full((rows, LANES), nb - 1, dtype=np.int32)
+    z_np[3000:] = rng.integers(0, nb, size=(rows - 3000, LANES), dtype=np.int32)
+    out = _FakeRef(jnp.zeros((nb, LANES), jnp.int32))
+    _packed_count(jnp.asarray(z_np), out, radix_bits)
+    got = np.asarray(out.a)
+    want = np.stack(
+        [(z_np == b).sum(axis=0, dtype=np.int64) for b in range(nb)]
+    )
     np.testing.assert_array_equal(got, want)
 
 
@@ -133,24 +172,25 @@ def test_pallas64_matches_oracle(rng, shift, radix_bits, prefix):
 @pytest.mark.parametrize(
     "shift,radix_bits,prefix", [(60, 4, None), (56, 4, 9), (28, 4, 11), (0, 4, 17)]
 )
-def test_pallas64_planes_path_matches_keys_path(rng, shift, radix_bits, prefix):
-    # split-once planes (the pass-loop fast path) == per-call deinterleave
+def test_pallas64_tiles_path_matches_keys_path(rng, shift, radix_bits, prefix):
+    # prepare-once tiles (the pass-loop fast path) == per-call prepare
     from mpi_k_selection_tpu.ops.pallas.histogram import (
         pallas_radix_histogram64,
-        split_planes,
+        prepare_tiles64,
     )
     from mpi_k_selection_tpu.utils.x64 import enable_x64
 
     with enable_x64():
         keys = jnp.asarray(rng.integers(0, 2**64, size=12345, dtype=np.uint64))
-        planes = split_planes(keys)
+        hi2, lo2, n = prepare_tiles64(keys, block_rows=256)
         got = np.asarray(
             pallas_radix_histogram64(
                 None,
                 shift=shift,
                 radix_bits=radix_bits,
                 prefix=prefix,
-                planes=planes,
+                tiles=(hi2, lo2),
+                orig_n=n,
                 block_rows=256,
             )
         )

@@ -1,0 +1,128 @@
+"""TPU smoke test — Mosaic-compiled Pallas kernel correctness on real hardware.
+
+The unit suite exercises the Pallas kernels in interpret mode on CPU
+(tests/test_pallas.py); this script is the repeatable artifact that proves
+the *compiled* kernels — 32-bit and 64-bit, packed SWAR and compare
+variants, prefix-free and prefixed, ragged and tile-aligned — produce
+oracle-exact histograms and selections on an actual TPU (VERDICT.md round-1
+item 6). Run it directly on a TPU-attached host:
+
+    python tpu_smoke.py
+
+Exit code 0 = every case exact. On a non-TPU host it exits 0 with a skip
+notice (the interpret path is already covered by the unit suite).
+
+Reference parity anchor: these kernels are the TPU replacement for the
+reference's hot local compute — the per-shard ``qsort``
+(``TODO-kth-problem-cgm.c:115``) and linear L/E/G counting sweep
+(``:175-185``) — so this is the analogue of running the reference binaries
+on real silicon rather than under an emulator.
+"""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+
+def _hist_oracle(keys, shift, radix_bits, prefix):
+    keys = np.asarray(keys, np.uint64)
+    nb = 1 << radix_bits
+    digits = (keys >> np.uint64(shift)) & np.uint64(nb - 1)
+    active = np.ones(keys.shape, bool)
+    if prefix is not None:
+        active = (keys >> np.uint64(shift + radix_bits)) == np.uint64(prefix)
+    return np.bincount(digits[active].astype(np.int64), minlength=nb)
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    if jax.default_backend() == "cpu":
+        print("tpu_smoke: no TPU attached; compiled-kernel smoke skipped")
+        return 0
+
+    from mpi_k_selection_tpu.ops.pallas.histogram import (
+        pallas_radix_histogram,
+        pallas_radix_histogram64,
+    )
+    from mpi_k_selection_tpu.ops.radix import radix_select
+    from mpi_k_selection_tpu.utils.x64 import enable_x64
+
+    rng = np.random.default_rng(42)
+    failures = []
+
+    def check(label, got, want):
+        ok = np.array_equal(np.asarray(got), np.asarray(want))
+        print(f"  {'ok ' if ok else 'FAIL'} {label}")
+        if not ok:
+            failures.append(label)
+
+    # --- 32-bit kernel: shapes x prefix cases x variants, compiled ---
+    print("32-bit histogram kernel (Mosaic-compiled):")
+    for n in (12345, 1 << 20, (1 << 22) + 77):  # ragged, aligned, multi-grid+tail
+        keys = rng.integers(0, 2**32, size=n, dtype=np.uint32)
+        kd = jax.device_put(jnp.asarray(keys))
+        for shift, rb, prefix in ((28, 4, None), (24, 4, 7), (0, 4, 2**27 - 5),
+                                  (24, 8, None), (16, 8, 129)):
+            for packed in (True, False):
+                got = pallas_radix_histogram(
+                    kd, shift=shift, radix_bits=rb, prefix=prefix,
+                    packed=packed, interpret=False,
+                )
+                check(
+                    f"n={n} shift={shift} rb={rb} prefix={prefix} packed={packed}",
+                    got, _hist_oracle(keys, shift, rb, prefix),
+                )
+
+    # adversarial skew at the production block size (SWAR drain path)
+    skew = np.full(300_000, 0x12345678, dtype=np.uint32)
+    got = pallas_radix_histogram(
+        jax.device_put(jnp.asarray(skew)), shift=24, radix_bits=4,
+        prefix=jnp.uint32(1), interpret=False,
+    )
+    check("adversarial skew (drain)", got, _hist_oracle(skew, 24, 4, 1))
+
+    # --- 64-bit two-plane kernel, compiled (needs x64) ---
+    print("64-bit histogram kernel (Mosaic-compiled):")
+    with enable_x64():
+        for n in (54321, 1 << 20):
+            keys = rng.integers(0, 2**64, size=n, dtype=np.uint64)
+            kd = jax.device_put(jnp.asarray(keys))
+            for shift, rb, prefix in ((60, 4, None), (56, 4, 9), (32, 4, 3**10),
+                                      (28, 4, 11), (0, 4, 2**50 + 17)):
+                for packed in (True, False):
+                    got = pallas_radix_histogram64(
+                        kd, shift=shift, radix_bits=rb, prefix=prefix,
+                        packed=packed, interpret=False,
+                    )
+                    check(
+                        f"n={n} shift={shift} rb={rb} prefix={prefix} packed={packed}",
+                        got, _hist_oracle(keys, shift, rb, prefix),
+                    )
+
+    # --- end-to-end compiled selections over the kernel ---
+    print("radix_select end-to-end (compiled kernels):")
+    x32 = rng.integers(-(2**31), 2**31, size=2_000_003, dtype=np.int32)
+    for k in (1, 1_000_000, 2_000_003):
+        got = int(radix_select(jax.device_put(jnp.asarray(x32)), k))
+        check(f"int32 k={k}", got, int(np.sort(x32)[k - 1]))
+    xf = rng.standard_normal(1_000_000).astype(np.float32)
+    got = float(radix_select(jax.device_put(jnp.asarray(xf)), 500_000))
+    check("float32 median", got, float(np.sort(xf)[499_999]))
+    with enable_x64():
+        x64v = rng.integers(-(2**62), 2**62, size=1_000_000, dtype=np.int64)
+        got = int(radix_select(jax.device_put(jnp.asarray(x64v)), 123_456))
+        check("int64 k=123456", got, int(np.sort(x64v)[123_455]))
+
+    if failures:
+        print(f"tpu_smoke: {len(failures)} FAILURES")
+        return 1
+    print("tpu_smoke: all cases exact")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
