@@ -27,7 +27,14 @@ from mpi_k_selection_tpu.buffer import DeviceVector
 from mpi_k_selection_tpu.ops.sort import sort_select
 from mpi_k_selection_tpu.ops.radix import radix_select
 from mpi_k_selection_tpu.ops.topk import topk, batched_topk
-from mpi_k_selection_tpu.api import batched_kselect, batched_median, kselect, median
+from mpi_k_selection_tpu.api import (
+    batched_kselect,
+    batched_median,
+    kselect,
+    kselect_many,
+    median,
+    quantiles,
+)
 from mpi_k_selection_tpu.parallel import (
     distributed_kselect,
     distributed_radix_select,
@@ -39,6 +46,8 @@ __all__ = [
     "__version__",
     "DeviceVector",
     "kselect",
+    "kselect_many",
+    "quantiles",
     "median",
     "batched_kselect",
     "batched_median",

@@ -78,6 +78,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="CGM coarseness constant (mpi backend; TODO-kth-problem-cgm.c:44)",
     )
     p.add_argument("--topk", type=int, default=None, help="return top-k instead of k-th")
+    p.add_argument(
+        "--quantiles",
+        default=None,
+        help="comma-separated quantiles in [0,1] (e.g. 0.5,0.9,0.99): exact "
+        "nearest-rank order statistics, amortized over one prepared pass",
+    )
     p.add_argument("--smallest", action="store_true", help="top-k smallest instead of largest")
     p.add_argument("--batch", type=int, default=None, help="batch dimension for top-k")
     p.add_argument(
@@ -175,6 +181,45 @@ def _run_kth(args, x):
     return record, ok
 
 
+def _run_quantiles(args, x):
+    import jax.numpy as jnp
+
+    from mpi_k_selection_tpu.api import quantiles as _quantiles
+
+    try:
+        qs = [float(s) for s in args.quantiles.split(",") if s.strip()]
+    except ValueError as e:
+        raise SystemExit(f"error: bad --quantiles value: {e}") from e
+    if args.backend != "tpu":
+        raise SystemExit("error: --quantiles runs on the tpu backend")
+    xd = jnp.asarray(x)
+    fn = lambda: _quantiles(xd, qs)
+    seconds, values = time_fn(fn, repeats=args.repeats, warmup=1)
+    values = np.asarray(values)
+    record = ResultRecord(
+        answer=values.tolist(),
+        n=x.size,
+        k=0,
+        backend=args.backend,
+        algorithm="quantiles",
+        dtype=args.dtype,
+        seconds=seconds,
+        n_devices=_device_count(args),
+    )
+    record.extra["quantiles"] = qs
+    ok = True
+    if args.verify:
+        import math
+
+        s = np.sort(x.ravel(), kind="stable")
+        want = np.array(
+            [s[max(1, min(x.size, math.ceil(q * x.size))) - 1] for q in qs]
+        )
+        ok = np.array_equal(values, want)
+        record.extra["exact_match"] = ok
+    return record, ok
+
+
 def _run_topk(args, x):
     k = args.topk
     if args.backend == "seq":
@@ -254,6 +299,10 @@ def main(argv=None) -> int:
         raise SystemExit(
             "error: --check applies to k-th selection; use --verify for top-k"
         )
+    if args.quantiles is not None and (args.topk is not None or args.check):
+        raise SystemExit(
+            "error: --quantiles is exclusive with --topk/--check; use --verify"
+        )
     x64_needed = args.dtype in ("int64", "float64")
     from mpi_k_selection_tpu.utils import profiling
 
@@ -274,7 +323,9 @@ def main(argv=None) -> int:
                 else contextlib.nullcontext()
             )
             with tracer, timer.phase("solve"):
-                if args.topk is not None:
+                if args.quantiles is not None:
+                    record, ok = _run_quantiles(args, x)
+                elif args.topk is not None:
                     record, ok = _run_topk(args, x)
                 else:
                     record, ok = _run_kth(args, x)

@@ -159,6 +159,78 @@ def _collect_prefix_matches(
     return jnp.where(jj < pop, vals, maxkey), pop
 
 
+class _Descent:
+    """Shared per-select state: sortable keys, prepared tiles, and the
+    one_pass bucket-walk closure both select entry points drive."""
+
+    def __init__(self, x, radix_bits, hist_method, chunk):
+        n = x.shape[0]
+        if radix_bits is None:
+            radix_bits = default_radix_bits(x.dtype, hist_method)
+        total_bits = _dt.key_bits(x.dtype)
+        if total_bits % radix_bits:
+            raise ValueError(
+                f"radix_bits={radix_bits} must divide key bits {total_bits}"
+            )
+        self.radix_bits = radix_bits
+        self.total_bits = total_bits
+        self.npasses = total_bits // radix_bits
+        self.cdt = select_count_dtype(n)
+        self.u = _dt.to_sortable_bits(x)
+        self.kdt = self.u.dtype
+
+        # pallas path: build the kernel's tiled key view ONCE for all
+        # passes (and the cutover collect) — per-pass views make XLA
+        # hold/remat extra full-size temporaries, OOMing 16 GB HBM at the
+        # 1B-element config
+        from mpi_k_selection_tpu.ops.histogram import prepare_keys
+
+        self.tiles, self.tiles_n = prepare_keys(hist_method, self.u)
+        if (
+            self.tiles is not None
+            and len(self.tiles) == 1
+            and self.kdt == jnp.uint32
+        ):
+            # 32-bit: the collect scans the 2-D tiles tensor itself (the
+            # same uint32 buffer the kernels read) so `u` fuses away and
+            # the cutover cond's branches share one full-size buffer.
+            # Sub-32-bit keys keep the native-width `u`: the tiles are
+            # widened uint32, so collecting from them would shift by the
+            # wrong key width and return the wrong dtype.
+            self.u_collect = self.tiles[0]
+            self.n_collect = self.tiles_n
+        else:
+            self.u_collect, self.n_collect = self.u, None
+
+        cdt, kdt = self.cdt, self.kdt
+
+        def one_pass(p, prefix, kk):
+            shift = total_bits - (p + 1) * radix_bits
+            hist = masked_radix_histogram(
+                self.u,
+                shift=shift,
+                radix_bits=radix_bits,
+                prefix=prefix if p else None,
+                method=hist_method,
+                count_dtype=cdt,
+                chunk=chunk,
+                tiles=self.tiles,
+                orig_n=self.tiles_n,
+            )
+            cum = jnp.cumsum(hist)
+            bucket = jnp.argmax(cum >= kk)
+            kk = kk - (cum[bucket] - hist[bucket])
+            bkey = bucket.astype(kdt)
+            prefix = (
+                bkey
+                if p == 0
+                else jax.lax.shift_left(prefix, kdt.type(radix_bits)) | bkey
+            )
+            return prefix, kk, hist[bucket]
+
+        self.one_pass = one_pass
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(
@@ -205,54 +277,13 @@ def radix_select(
     """
     x = x.ravel()
     n = x.shape[0]
-    if radix_bits is None:
-        radix_bits = default_radix_bits(x.dtype, hist_method)
-    total_bits = _dt.key_bits(x.dtype)
-    if total_bits % radix_bits:
-        raise ValueError(f"radix_bits={radix_bits} must divide key bits {total_bits}")
-    cdt = select_count_dtype(n)
-    u = _dt.to_sortable_bits(x)
-    kdt = u.dtype
-
-    # pallas path: build the kernel's tiled key view ONCE for all passes
-    # (and the cutover collect) — per-pass views make XLA hold/remat extra
-    # full-size temporaries, OOMing 16 GB HBM at the 1B-element config
-    from mpi_k_selection_tpu.ops.histogram import prepare_keys
-
-    tiles, tiles_n = prepare_keys(hist_method, u)
-    if tiles is not None and len(tiles) == 1:
-        # 32-bit: the collect scans the 2-D tiles tensor itself (the same
-        # uint32 buffer the kernels read) so `u` fuses away and the cutover
-        # cond's branches share one full-size buffer
-        u_collect = tiles[0]
-        n_collect = tiles_n
-    else:
-        u_collect, n_collect = u, None
+    prep = _Descent(x, radix_bits, hist_method, chunk)
+    radix_bits, total_bits, npasses = prep.radix_bits, prep.total_bits, prep.npasses
+    cdt, kdt, u, one_pass = prep.cdt, prep.kdt, prep.u, prep.one_pass
+    u_collect, n_collect = prep.u_collect, prep.n_collect
 
     kk = jnp.clip(jnp.asarray(k, cdt), 1, n)
     early = early_exit_budget is not None and n > early_exit_budget
-
-    def one_pass(p, prefix, kk):
-        shift = total_bits - (p + 1) * radix_bits
-        hist = masked_radix_histogram(
-            u,
-            shift=shift,
-            radix_bits=radix_bits,
-            prefix=prefix if p else None,
-            method=hist_method,
-            count_dtype=cdt,
-            chunk=chunk,
-            tiles=tiles,
-            orig_n=tiles_n,
-        )
-        cum = jnp.cumsum(hist)
-        bucket = jnp.argmax(cum >= kk)
-        kk = kk - (cum[bucket] - hist[bucket])
-        bkey = bucket.astype(kdt)
-        prefix = bkey if p == 0 else jax.lax.shift_left(prefix, kdt.type(radix_bits)) | bkey
-        return prefix, kk, hist[bucket]
-
-    npasses = total_bits // radix_bits
     if early:
         ncut = None  # research path below
     elif cutover == "auto":
@@ -321,3 +352,59 @@ def radix_select(
         pop > early_exit_budget, lambda _: prefix, finish_small, operand=None
     )
     return _dt.from_sortable_bits(ans, x.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("radix_bits", "hist_method", "chunk")
+)
+def radix_select_many(
+    x: jax.Array,
+    ks,
+    *,
+    radix_bits: int | None = None,
+    hist_method: str = "auto",
+    chunk: int = 32768,
+) -> jax.Array:
+    """Exact k-th smallest for EVERY k in ``ks`` over the same array.
+
+    The amortized form the prepared-tiles design buys (the telemetry shape:
+    p50/p90/p99 of one giant array): the tiled key view and the prefix-free
+    first pass are computed ONCE and shared by all queries; each k then
+    walks only the remaining ``npasses - 1`` prefixed passes under
+    ``lax.scan``. Cost ~ prep + pass0 + K*(npasses-1) passes instead of
+    K*npasses + K*prep. Returns answers in ``ks`` order (shape ``ks.shape``).
+
+    Out-of-range concrete ks raise in the API layer (api.kselect_many);
+    traced ks are clamped to [1, n] like radix_select.
+    """
+    x = x.ravel()
+    n = x.shape[0]
+    ks_arr = jnp.atleast_1d(jnp.asarray(ks))
+    prep = _Descent(x, radix_bits, hist_method, chunk)
+    radix_bits = prep.radix_bits
+    kk0 = jnp.clip(ks_arr.astype(prep.cdt), 1, n).ravel()
+
+    # shared prefix-free pass: one histogram serves every query's first step
+    hist0 = masked_radix_histogram(
+        prep.u,
+        shift=prep.total_bits - radix_bits,
+        radix_bits=radix_bits,
+        prefix=None,
+        method=hist_method,
+        count_dtype=prep.cdt,
+        chunk=chunk,
+        tiles=prep.tiles,
+        orig_n=prep.tiles_n,
+    )
+    cum0 = jnp.cumsum(hist0)
+
+    def per_k(carry, kk):
+        bucket = jnp.argmax(cum0 >= kk)
+        kk = kk - (cum0[bucket] - hist0[bucket])
+        prefix = bucket.astype(prep.kdt)
+        for p in range(1, prep.npasses):
+            prefix, kk, _ = prep.one_pass(p, prefix, kk)
+        return carry, prefix
+    _, prefixes = jax.lax.scan(per_k, None, kk0)
+    ans = _dt.from_sortable_bits(prefixes, x.dtype)
+    return ans.reshape(ks_arr.shape)

@@ -40,3 +40,44 @@ def test_reference_defaults_config():
     assert config.REFERENCE_K_SEQ == 250
     assert config.REFERENCE_K_CGM == 150
     assert config.REFERENCE_C == 500
+
+
+@pytest.mark.parametrize("n", [5000, 100_001])  # sort path, radix path
+def test_kselect_many_matches_oracle(rng, n):
+    x = rng.integers(-(2**31), 2**31, size=n, dtype=np.int32)
+    ks_q = np.array([1, 7, n // 2, n - 1, n], dtype=np.int64)
+    got = np.asarray(ks.kselect_many(jnp.asarray(x), ks_q))
+    want = np.sort(x)[ks_q - 1]
+    np.testing.assert_array_equal(got, want)
+
+
+def test_kselect_many_duplicates_and_float(rng):
+    xd = (rng.integers(0, 9, size=60_000)).astype(np.int32)
+    ks_q = np.array([1, 30_000, 60_000])
+    np.testing.assert_array_equal(
+        np.asarray(ks.kselect_many(jnp.asarray(xd), ks_q)), np.sort(xd)[ks_q - 1]
+    )
+    xf = rng.standard_normal(70_001).astype(np.float32)
+    np.testing.assert_array_equal(
+        np.asarray(ks.kselect_many(jnp.asarray(xf), ks_q)), np.sort(xf)[ks_q - 1]
+    )
+
+
+def test_kselect_many_rejects_bad_k(rng):
+    x = jnp.asarray(rng.integers(0, 100, size=1000, dtype=np.int32))
+    with pytest.raises(ValueError):
+        ks.kselect_many(x, [1, 0])
+    with pytest.raises(ValueError):
+        ks.kselect_many(x, [1, 1001])
+
+
+def test_quantiles_nearest_rank(rng):
+    x = rng.integers(-(10**6), 10**6, size=99_999, dtype=np.int32)
+    qs = [0.0, 0.5, 0.9, 0.99, 1.0]
+    got = np.asarray(ks.quantiles(jnp.asarray(x), qs))
+    s = np.sort(x)
+    import math
+    want = np.array([s[max(1, min(x.size, math.ceil(q * x.size))) - 1] for q in qs])
+    np.testing.assert_array_equal(got, want)
+    with pytest.raises(ValueError):
+        ks.quantiles(jnp.asarray(x), [0.5, 1.5])

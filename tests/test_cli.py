@@ -97,3 +97,24 @@ def test_float16_dtype(capsys):
     out = capsys.readouterr().out
     assert rc == 0
     assert json.loads(out.strip().splitlines()[-1])["extra"]["exact_match"] is True
+
+
+def test_cli_quantiles(capsys):
+    from mpi_k_selection_tpu.cli import main
+
+    rc = main(
+        ["--backend", "tpu", "--n", "100000", "--quantiles", "0.5,0.9,0.99",
+         "--seed", "5", "--verify"]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "exact match" in out
+
+
+def test_cli_quantiles_bad_combo():
+    from mpi_k_selection_tpu.cli import main
+
+    with pytest.raises(SystemExit, match="exclusive"):
+        main(["--quantiles", "0.5", "--topk", "8"])
+    with pytest.raises(SystemExit, match="tpu backend"):
+        main(["--backend", "seq", "--quantiles", "0.5", "--n", "1000"])

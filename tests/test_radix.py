@@ -127,3 +127,21 @@ def test_early_exit_float32():
     k = 31_337
     got = radix_select(jnp.asarray(x), k, early_exit_budget=4096)
     assert float(got) == float(np.sort(x)[k - 1])
+
+
+@pytest.mark.parametrize("dtype", [np.int16, np.float16])
+def test_radix_select_sub32_dtypes_with_pallas_cutover(rng, dtype):
+    # sub-32-bit keys use widened uint32 tiles for the histogram passes but
+    # must keep the native-width sortable keys for the cutover collect
+    # (regression: uint16 vs uint32 cond-branch dtype mismatch, and a
+    # wrong-width mshift had the dtypes been coerced)
+    if dtype == np.int16:
+        x = rng.integers(-30000, 30000, size=120001, dtype=np.int16)
+    else:
+        x = (rng.standard_normal(120001) * 100).astype(np.float16)
+    k = 60000
+    got = radix_select(
+        jnp.asarray(x), k, hist_method="pallas", cutover=1, cutover_budget=65536
+    )
+    want = np.sort(x, kind="stable")[k - 1]
+    assert np.asarray(got)[()] == want
