@@ -11,7 +11,7 @@ import jax.numpy as jnp
 
 from mpi_k_selection_tpu.ops.radix import radix_select, radix_select_many
 from mpi_k_selection_tpu.ops.sort import sort_select
-from mpi_k_selection_tpu.utils.debug import check_concrete_k
+from mpi_k_selection_tpu.utils.debug import check_concrete_k, check_concrete_ks
 
 ALGORITHMS = ("auto", "radix", "sort")
 
@@ -42,19 +42,10 @@ def kselect_many(x, ks, **kwargs):
     across all queries (ops/radix.py:radix_select_many); small inputs sort
     once and gather. Returns answers in ``ks`` order.
     """
-    import numpy as np
-
     x = jnp.asarray(x)
     if x.size == 0:
         raise ValueError("kselect_many requires a non-empty input")
-    ks_concrete = None
-    try:
-        ks_concrete = np.asarray(ks)
-    except Exception:
-        pass  # traced ks: clamped inside the op
-    if ks_concrete is not None:
-        for k in ks_concrete.ravel():
-            check_concrete_k(int(k), x.size)
+    check_concrete_ks(ks, x.size)
     if x.size <= 1 << 14:
         ks_arr = jnp.atleast_1d(jnp.asarray(ks))
         s = jnp.sort(x.ravel())
@@ -63,24 +54,30 @@ def kselect_many(x, ks, **kwargs):
     return radix_select_many(x, ks, **kwargs)
 
 
-def quantiles(x, qs, **kwargs):
-    """Exact order statistics at quantiles ``qs`` (nearest-rank,
-    ``k = max(1, ceil(q * n))`` — every returned value is an actual array
-    element, the same guarantee the reference's selection gives)."""
+def quantile_ranks(qs, n: int) -> list[int]:
+    """Nearest-rank 1-indexed ks for quantiles ``qs`` over ``n`` elements:
+    ``k = max(1, ceil(q * n))``, computed in float64 on the host (a float32
+    round-trip perturbs q — 0.99 -> 0.99000001 — enough to shift
+    ``ceil(q * n)`` by one rank)."""
     import math
 
     import numpy as np
 
-    x = jnp.asarray(x)
-    if x.size == 0:
-        raise ValueError("quantiles requires a non-empty input")
-    # float64 on the host: a float32 round-trip perturbs q (0.99 ->
-    # 0.99000001) enough to shift ceil(q*n) by one rank
     qs_list = [float(q) for q in np.atleast_1d(np.asarray(qs, dtype=np.float64))]
     for q in qs_list:
         if not 0.0 <= q <= 1.0:
             raise ValueError(f"quantile {q} outside [0, 1]")
-    ks = [max(1, min(x.size, math.ceil(q * x.size))) for q in qs_list]
+    return [max(1, min(n, math.ceil(q * n))) for q in qs_list]
+
+
+def quantiles(x, qs, **kwargs):
+    """Exact order statistics at quantiles ``qs`` (nearest-rank — every
+    returned value is an actual array element, the same guarantee the
+    reference's selection gives)."""
+    x = jnp.asarray(x)
+    if x.size == 0:
+        raise ValueError("quantiles requires a non-empty input")
+    ks = quantile_ranks(qs, x.size)
     return kselect_many(x, jnp.asarray(ks, jnp.int32), **kwargs)
 
 

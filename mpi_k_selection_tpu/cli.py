@@ -184,7 +184,8 @@ def _run_kth(args, x):
 def _run_quantiles(args, x):
     import jax.numpy as jnp
 
-    from mpi_k_selection_tpu.api import quantiles as _quantiles
+    from mpi_k_selection_tpu.api import quantile_ranks, quantiles as _quantiles
+    from mpi_k_selection_tpu.backends import get_backend
 
     try:
         qs = [float(s) for s in args.quantiles.split(",") if s.strip()]
@@ -193,7 +194,24 @@ def _run_quantiles(args, x):
     if args.backend != "tpu":
         raise SystemExit("error: --quantiles runs on the tpu backend")
     xd = jnp.asarray(x)
-    fn = lambda: _quantiles(xd, qs)
+    # same distribution planner as k-th selection: --distribute always (or
+    # auto at sharded scale) routes to the mesh multi-rank path
+    _, distributed = get_backend("tpu").plan(x.size, "radix", args.distribute)
+    if distributed:
+        from mpi_k_selection_tpu.parallel import (
+            distributed_radix_select_many,
+            make_mesh,
+        )
+
+        mesh = make_mesh(args.devices)
+        ks = jnp.asarray(quantile_ranks(qs, x.size), jnp.int32)
+        fn = lambda: distributed_radix_select_many(xd, ks, mesh=mesh)
+        algorithm = "quantiles-distributed"
+        n_devices = mesh.size
+    else:
+        fn = lambda: _quantiles(xd, qs)
+        algorithm = "quantiles"
+        n_devices = 1
     seconds, values = time_fn(fn, repeats=args.repeats, warmup=1)
     values = np.asarray(values)
     record = ResultRecord(
@@ -201,20 +219,16 @@ def _run_quantiles(args, x):
         n=x.size,
         k=0,
         backend=args.backend,
-        algorithm="quantiles",
+        algorithm=algorithm,
         dtype=args.dtype,
         seconds=seconds,
-        n_devices=_device_count(args),
+        n_devices=n_devices,
     )
     record.extra["quantiles"] = qs
     ok = True
     if args.verify:
-        import math
-
         s = np.sort(x.ravel(), kind="stable")
-        want = np.array(
-            [s[max(1, min(x.size, math.ceil(q * x.size))) - 1] for q in qs]
-        )
+        want = s[np.asarray(quantile_ranks(qs, x.size)) - 1]
         ok = np.array_equal(values, want)
         record.extra["exact_match"] = ok
     return record, ok

@@ -159,6 +159,21 @@ def _collect_prefix_matches(
     return jnp.where(jj < pop, vals, maxkey), pop
 
 
+def bucket_walk_step(hist, kk, prefix, kdt, radix_bits):
+    """One descent step on a (global) bucket histogram: pick the bucket
+    containing the k-th element, rebase k within it, extend the prefix.
+    ``prefix=None`` on the first (prefix-free) step. The single shared
+    implementation of the walk — local and distributed, single- and
+    multi-rank paths all call this. Returns (prefix, kk, bucket_count)."""
+    cum = jnp.cumsum(hist)
+    bucket = jnp.argmax(cum >= kk)
+    kk = kk - (cum[bucket] - hist[bucket])
+    bkey = bucket.astype(kdt)
+    if prefix is not None:
+        bkey = jax.lax.shift_left(prefix, kdt.type(radix_bits)) | bkey
+    return bkey, kk, hist[bucket]
+
+
 class _Descent:
     """Shared per-select state: sortable keys, prepared tiles, and the
     one_pass bucket-walk closure both select entry points drive."""
@@ -217,16 +232,7 @@ class _Descent:
                 tiles=self.tiles,
                 orig_n=self.tiles_n,
             )
-            cum = jnp.cumsum(hist)
-            bucket = jnp.argmax(cum >= kk)
-            kk = kk - (cum[bucket] - hist[bucket])
-            bkey = bucket.astype(kdt)
-            prefix = (
-                bkey
-                if p == 0
-                else jax.lax.shift_left(prefix, kdt.type(radix_bits)) | bkey
-            )
-            return prefix, kk, hist[bucket]
+            return bucket_walk_step(hist, kk, prefix if p else None, kdt, radix_bits)
 
         self.one_pass = one_pass
 
@@ -396,12 +402,8 @@ def radix_select_many(
         tiles=prep.tiles,
         orig_n=prep.tiles_n,
     )
-    cum0 = jnp.cumsum(hist0)
-
     def per_k(carry, kk):
-        bucket = jnp.argmax(cum0 >= kk)
-        kk = kk - (cum0[bucket] - hist0[bucket])
-        prefix = bucket.astype(prep.kdt)
+        prefix, kk, _ = bucket_walk_step(hist0, kk, None, prep.kdt, radix_bits)
         for p in range(1, prep.npasses):
             prefix, kk, _ = prep.one_pass(p, prefix, kk)
         return carry, prefix

@@ -2,7 +2,10 @@
 
 from mpi_k_selection_tpu.parallel.cgm import distributed_cgm_select
 from mpi_k_selection_tpu.parallel.mesh import make_mesh, require_distributed, shard_1d
-from mpi_k_selection_tpu.parallel.radix import distributed_radix_select
+from mpi_k_selection_tpu.parallel.radix import (
+    distributed_radix_select,
+    distributed_radix_select_many,
+)
 from mpi_k_selection_tpu.parallel.topk import distributed_topk
 
 DISTRIBUTED_ALGORITHMS = ("radix", "cgm")
@@ -24,6 +27,7 @@ def distributed_kselect(x, k, *, algorithm: str = "radix", mesh=None, **kwargs):
 __all__ = [
     "distributed_kselect",
     "distributed_radix_select",
+    "distributed_radix_select_many",
     "distributed_cgm_select",
     "distributed_topk",
     "make_mesh",

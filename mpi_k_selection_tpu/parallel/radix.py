@@ -31,7 +31,11 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from mpi_k_selection_tpu.ops.histogram import masked_radix_histogram
-from mpi_k_selection_tpu.ops.radix import default_radix_bits, select_count_dtype
+from mpi_k_selection_tpu.ops.radix import (
+    bucket_walk_step,
+    default_radix_bits,
+    select_count_dtype,
+)
 from mpi_k_selection_tpu.parallel import mesh as mesh_lib
 from mpi_k_selection_tpu.utils import debug as _debug, dtypes as _dt
 
@@ -70,14 +74,7 @@ def _jitted_select(mesh, n, total_bits, cdt, radix_bits, hist_method, chunk):
                 orig_n=tiles_n,
             )
             hist = jax.lax.psum(local, axis)  # the MPI_Allreduce analogue (TODO-…:190)
-            cum = jnp.cumsum(hist)
-            bucket = jnp.argmax(cum >= kk)
-            kk = kk - (cum[bucket] - hist[bucket])
-            bkey = bucket.astype(kdt)
-            if prefix is None:
-                prefix = bkey
-            else:
-                prefix = jax.lax.shift_left(prefix, kdt.type(radix_bits)) | bkey
+            prefix, kk, _ = bucket_walk_step(hist, kk, prefix, kdt, radix_bits)
         return _dt.from_sortable_bits(prefix, xs.dtype)
 
     fn = jax.shard_map(shard_fn, mesh=mesh, in_specs=(P(axis), P()), out_specs=P())
@@ -114,3 +111,81 @@ def distributed_radix_select(
     xs = jax.device_put(x, NamedSharding(mesh, P(mesh.axis_names[0])))
     kk = jnp.asarray(k, cdt)
     return fn(xs, kk)
+
+
+@functools.lru_cache(maxsize=64)
+def _jitted_select_many(mesh, n, total_bits, cdt, radix_bits, hist_method, chunk):
+    """Sharded multi-rank selection: the shard's tiled view and the
+    prefix-free first pass (one local histogram + one ``psum``) are shared
+    by every query; each k walks the remaining prefixed passes under
+    ``lax.scan`` — per-k communication stays one small ``psum`` per pass,
+    the same O(p)-scalars-per-round property as the single-k path."""
+    axis = mesh.axis_names[0]
+    npasses = total_bits // radix_bits
+
+    def shard_fn(xs, ks):
+        from mpi_k_selection_tpu.ops.histogram import prepare_keys
+
+        u = _dt.to_sortable_bits(xs.ravel())
+        kdt = u.dtype
+        tiles, tiles_n = prepare_keys(hist_method, u)
+
+        def local_hist(shift, prefix):
+            return masked_radix_histogram(
+                u,
+                shift=shift,
+                radix_bits=radix_bits,
+                prefix=prefix,
+                method=hist_method,
+                count_dtype=cdt,
+                chunk=chunk,
+                tiles=tiles,
+                orig_n=tiles_n,
+            )
+
+        hist0 = jax.lax.psum(local_hist(total_bits - radix_bits, None), axis)
+
+        def per_k(carry, kk):
+            kk = jnp.clip(kk.astype(cdt), 1, n)
+            prefix, kk, _ = bucket_walk_step(hist0, kk, None, kdt, radix_bits)
+            for p in range(1, npasses):
+                shift = total_bits - (p + 1) * radix_bits
+                hist = jax.lax.psum(local_hist(shift, prefix), axis)
+                prefix, kk, _ = bucket_walk_step(hist, kk, prefix, kdt, radix_bits)
+            return carry, prefix
+        _, prefixes = jax.lax.scan(per_k, None, ks)
+        return _dt.from_sortable_bits(prefixes, xs.dtype)
+
+    fn = jax.shard_map(shard_fn, mesh=mesh, in_specs=(P(axis), P()), out_specs=P())
+    return jax.jit(fn)
+
+
+def distributed_radix_select_many(
+    x: jax.Array,
+    ks,
+    *,
+    mesh=None,
+    radix_bits: int | None = None,
+    hist_method: str = "auto",
+    chunk: int = 32768,
+):
+    """Exact k-th smallest of sharded ``x`` for every (1-indexed) k in
+    ``ks``; replicated vector out, in ``ks`` order."""
+    if mesh is None:
+        mesh = mesh_lib.make_mesh()
+    mesh_lib.require_distributed(mesh)
+
+    x = jnp.ravel(jnp.asarray(x))
+    ks_arr = jnp.atleast_1d(jnp.asarray(ks))
+    _debug.check_concrete_ks(ks_arr, x.shape[0])
+    if radix_bits is None:
+        radix_bits = default_radix_bits(x.dtype, hist_method)
+    x, n = mesh_lib.pad_to_multiple(x, mesh.size)
+    cdt = select_count_dtype(x.shape[0])
+    total_bits = _dt.key_bits(x.dtype)
+    if total_bits % radix_bits:
+        raise ValueError(f"radix_bits={radix_bits} must divide {total_bits}")
+
+    fn = _jitted_select_many(mesh, n, total_bits, cdt, radix_bits, hist_method, chunk)
+    xs = jax.device_put(x, NamedSharding(mesh, P(mesh.axis_names[0])))
+    return fn(xs, ks_arr.astype(cdt).ravel()).reshape(ks_arr.shape)

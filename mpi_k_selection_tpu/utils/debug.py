@@ -41,6 +41,18 @@ def check_concrete_k(k, n: int) -> None:
         raise ValueError(f"k={kv} out of range [1, {n}] (k is 1-indexed)")
 
 
+def check_concrete_ks(ks, n: int) -> None:
+    """Vector form of :func:`check_concrete_k` for multi-rank selection:
+    every concrete k in ``ks`` must lie in [1, n]; a traced ``ks`` passes
+    through (clamped inside the ops)."""
+    try:
+        ks_concrete = np.asarray(ks)
+    except Exception:
+        return  # traced: cannot validate at trace time
+    for k in ks_concrete.ravel():
+        check_concrete_k(int(k), n)
+
+
 def validate_input(x, k: int, *, allow_nan: bool = False) -> None:
     """Raise ValueError on inputs that would make selection ill-defined."""
     x = np.asarray(x)

@@ -118,3 +118,17 @@ def test_cli_quantiles_bad_combo():
         main(["--quantiles", "0.5", "--topk", "8"])
     with pytest.raises(SystemExit, match="tpu backend"):
         main(["--backend", "seq", "--quantiles", "0.5", "--n", "1000"])
+
+
+def test_cli_quantiles_distributed(monkeypatch):
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs a multi-device mesh")
+    from mpi_k_selection_tpu.cli import main
+
+    rc = main(
+        ["--backend", "tpu", "--n", "100000", "--quantiles", "0.25,0.75",
+         "--distribute", "always", "--seed", "6", "--verify", "--json"]
+    )
+    assert rc == 0

@@ -122,3 +122,30 @@ def test_concrete_k_raises_everywhere(mesh8):
             distributed_cgm_select(x, bad_k, mesh=mesh8)
         with pytest.raises(ValueError, match="out of range"):
             distributed_topk(x, bad_k, mesh=mesh8)
+
+
+def test_distributed_radix_select_many(mesh8, rng):
+    from mpi_k_selection_tpu.parallel import distributed_radix_select_many
+
+    n = 40001  # non-divisible by 8: sentinel-padding path
+    x = rng.integers(-(2**31), 2**31, size=n, dtype=np.int32)
+    ks_q = np.array([1, 7, n // 2, n - 1, n])
+    got = np.asarray(distributed_radix_select_many(x, ks_q, mesh=mesh8))
+    np.testing.assert_array_equal(got, np.sort(x, kind="stable")[ks_q - 1])
+
+
+def test_distributed_radix_select_many_rejects_bad_k(mesh8, rng):
+    from mpi_k_selection_tpu.parallel import distributed_radix_select_many
+
+    x = rng.integers(0, 100, size=1000, dtype=np.int32)
+    with pytest.raises(ValueError):
+        distributed_radix_select_many(x, [1, 1001], mesh=mesh8)
+
+
+def test_distributed_radix_select_many_2d_ks(mesh8, rng):
+    from mpi_k_selection_tpu.parallel import distributed_radix_select_many
+
+    x = rng.integers(-(2**31), 2**31, size=9000, dtype=np.int32)
+    ks_2d = np.array([[1, 2], [4000, 9000]])
+    got = np.asarray(distributed_radix_select_many(x, ks_2d, mesh=mesh8))
+    np.testing.assert_array_equal(got, np.sort(x, kind="stable")[ks_2d - 1])

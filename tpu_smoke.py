@@ -112,6 +112,11 @@ def main() -> int:
     xf = rng.standard_normal(1_000_000).astype(np.float32)
     got = float(radix_select(jax.device_put(jnp.asarray(xf)), 500_000))
     check("float32 median", got, float(np.sort(xf)[499_999]))
+    for dt in (np.float16, jnp.bfloat16):
+        xh = (rng.standard_normal(300_001) * 8).astype(dt)
+        got = radix_select(jax.device_put(jnp.asarray(xh)), 150_000)
+        want = np.sort(np.asarray(xh), kind="stable")[149_999]
+        check(f"{np.dtype(dt).name} median", np.asarray(got)[()], want)
     with enable_x64():
         x64v = rng.integers(-(2**62), 2**62, size=1_000_000, dtype=np.int64)
         got = int(radix_select(jax.device_put(jnp.asarray(x64v)), 123_456))
