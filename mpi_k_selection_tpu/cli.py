@@ -193,6 +193,12 @@ def _run_quantiles(args, x):
         raise SystemExit(f"error: bad --quantiles value: {e}") from e
     if args.backend != "tpu":
         raise SystemExit("error: --quantiles runs on the tpu backend")
+    if args.algorithm not in ("auto", "radix"):
+        raise SystemExit(
+            f"error: --quantiles supports --algorithm auto|radix "
+            f"(multi-rank selection is a radix-descent path), not "
+            f"{args.algorithm!r}"
+        )
     xd = jnp.asarray(x)
     # same distribution planner as k-th selection: --distribute always (or
     # auto at sharded scale) routes to the mesh multi-rank path
@@ -204,11 +210,17 @@ def _run_quantiles(args, x):
         )
 
         mesh = make_mesh(args.devices)
-        ks = jnp.asarray(quantile_ranks(qs, x.size), jnp.int32)
-        fn = lambda: distributed_radix_select_many(xd, ks, mesh=mesh)
-        algorithm = "quantiles-distributed"
-        n_devices = mesh.size
-    else:
+        if mesh.size < 2:
+            # a --devices cap can shrink the mesh below the distributed
+            # minimum; run single-device (same silent fallback the planner
+            # applies on single-device hosts)
+            distributed = False
+        else:
+            ks = jnp.asarray(quantile_ranks(qs, x.size), jnp.int32)
+            fn = lambda: distributed_radix_select_many(xd, ks, mesh=mesh)
+            algorithm = "quantiles-distributed"
+            n_devices = mesh.size
+    if not distributed:
         fn = lambda: _quantiles(xd, qs)
         algorithm = "quantiles"
         n_devices = 1

@@ -64,7 +64,7 @@ def cutover_passes(n: int, total_bits: int, radix_bits: int, budget: int) -> int
     cutover, or None when the fixed schedule is better.
 
     Chosen so the *expected* surviving population (``n >> resolved_bits`` for
-    uniform keys) is <= budget/8 — an 8x safety margin for mild skew. Skewed
+    uniform keys) is <= budget/4 — a 4x safety margin for mild skew. Skewed
     or duplicate-heavy data that still overflows the budget takes the
     fallback branch (the remaining fixed passes), so the worst case costs
     the fixed schedule plus one cond, never more. This is the reference
@@ -72,22 +72,24 @@ def cutover_passes(n: int, total_bits: int, radix_bits: int, budget: int) -> int
     122, 236-280``) rebuilt without data movement until the final collect.
 
     The cutover only pays when the skipped passes outweigh the collect
-    (one extra scan + a rank-slot gather + a small sort, ~2.5 ms measured on
-    v5e): with the packed histogram kernel at ~4 ps per element-pass the
-    break-even is ``(skipped_passes - 1) * n > ~6e8`` — int32 at the 134M
-    headline config stays on the fixed 8-pass schedule, while 1B-class
-    int32 and every int64/float64 config (16 passes) cut over.
+    (one extra count scan + a rank-slot gather + a small sort). Measured on
+    v5e with the block_rows=4096 packed kernel and budget=4096: collect ~=
+    1 pass + ~0.5 ms, passes ~5.5 ps/element, so the break-even is
+    ``(skipped_passes - 1) * n > ~1e8`` — at the 134M int32 headline config
+    the ncut=5 cutover wins 7.5 -> 6.9 ms, and 1B-class / 64-bit configs
+    win more (large-budget collects lose: 16384-slot gathers cost more than
+    the passes they save; see BENCH history).
     """
     if n < (1 << 20):  # small inputs: pass cost is trivial, skip the cond
         return None
     npasses = total_bits // radix_bits
     r = radix_bits
-    while r < total_bits and (n >> r) > (budget >> 3):
+    while r < total_bits and (n >> r) > (budget >> 2):
         r += radix_bits
     ncut = r // radix_bits
     if ncut >= npasses:
         return None
-    if (npasses - ncut - 1) * n <= 600_000_000:  # collect costs ~1 pass + 2.5ms
+    if (npasses - ncut - 1) * n <= 100_000_000:  # collect ~ 1 pass + 0.5ms
         return None
     return ncut
 
@@ -257,7 +259,7 @@ def radix_select(
     chunk: int = 32768,
     early_exit_budget: int | None = None,
     cutover: int | str | None = "auto",
-    cutover_budget: int = 16384,
+    cutover_budget: int = 4096,
 ) -> jax.Array:
     """Exact k-th smallest element of ``x`` (k is 1-indexed, reference semantics).
 

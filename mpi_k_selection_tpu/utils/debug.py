@@ -44,10 +44,11 @@ def check_concrete_k(k, n: int) -> None:
 def check_concrete_ks(ks, n: int) -> None:
     """Vector form of :func:`check_concrete_k` for multi-rank selection:
     every concrete k in ``ks`` must lie in [1, n]; a traced ``ks`` passes
-    through (clamped inside the ops)."""
+    through (clamped inside the ops). Malformed inputs (ragged lists,
+    non-numeric) still raise — only the tracer conversion is excused."""
     try:
         ks_concrete = np.asarray(ks)
-    except Exception:
+    except jax.errors.TracerArrayConversionError:
         return  # traced: cannot validate at trace time
     for k in ks_concrete.ravel():
         check_concrete_k(int(k), n)

@@ -132,3 +132,21 @@ def test_cli_quantiles_distributed(monkeypatch):
          "--distribute", "always", "--seed", "6", "--verify", "--json"]
     )
     assert rc == 0
+
+
+def test_cli_quantiles_devices_cap_falls_back_single(capsys):
+    from mpi_k_selection_tpu.cli import main
+
+    rc = main(
+        ["--backend", "tpu", "--n", "50000", "--quantiles", "0.5",
+         "--distribute", "always", "--devices", "1", "--seed", "3", "--verify"]
+    )
+    assert rc == 0
+    assert "exact match" in capsys.readouterr().out
+
+
+def test_cli_quantiles_rejects_non_radix_algorithm():
+    from mpi_k_selection_tpu.cli import main
+
+    with pytest.raises(SystemExit, match="radix"):
+        main(["--quantiles", "0.5", "--algorithm", "sort", "--n", "1000"])
