@@ -75,6 +75,47 @@ def kselect(x, k: int, *, algorithm: str = "auto", distribute: str = "auto", **k
     return api.kselect(jnp.asarray(x), k, algorithm=algorithm, **kwargs)
 
 
+def plan_many(n: int, distribute: str = "auto", devices: int | None = None):
+    """Mesh to run multi-rank selection on, or None for single-device.
+
+    The one dispatch decision shared by :func:`kselect_many` and the CLI's
+    ``--quantiles`` path: the kselect planner (radix is the only multi-rank
+    algorithm), plus the ``devices`` cap — a cap that shrinks the mesh
+    below the distributed minimum of 2 falls back to single-device, the
+    same silent fallback the planner applies on single-device hosts."""
+    _, use_mesh = plan(n, "radix", distribute)
+    if not use_mesh:
+        return None
+    from mpi_k_selection_tpu.parallel import make_mesh
+
+    mesh = make_mesh(devices)
+    return mesh if mesh.size >= 2 else None
+
+
+def kselect_many(x, ks, *, distribute: str = "auto", devices: int | None = None, **kwargs):
+    """Exact k-th smallest for every k in ``ks`` (multi-rank selection),
+    distributed over the device mesh per the same planner as kselect.
+    Multi-rank is radix-only (api.kselect_many handles the small-input
+    sort-and-gather case on the single-device path)."""
+    n = np.asarray(x).size if not hasattr(x, "size") else x.size
+    mesh = plan_many(n, distribute, devices)
+    if mesh is not None:
+        from mpi_k_selection_tpu.parallel import radix as pradix
+
+        return pradix.distributed_radix_select_many(
+            jnp.asarray(x), ks, mesh=mesh, **kwargs
+        )
+    return api.kselect_many(jnp.asarray(x), ks, **kwargs)
+
+
+def quantiles(x, qs, *, distribute: str = "auto", devices: int | None = None, **kwargs):
+    """Exact nearest-rank order statistics at quantiles ``qs``; distributes
+    like :func:`kselect_many`."""
+    x = jnp.asarray(x)
+    ks = jnp.asarray(api.quantile_ranks(qs, x.size), jnp.int32)
+    return kselect_many(x, ks, distribute=distribute, devices=devices, **kwargs)
+
+
 def topk(x, k: int, *, largest: bool = True, **kwargs):
     from mpi_k_selection_tpu.ops.topk import topk as _topk
 

@@ -200,27 +200,18 @@ def _run_quantiles(args, x):
             f"{args.algorithm!r}"
         )
     xd = jnp.asarray(x)
-    # same distribution planner as k-th selection: --distribute always (or
-    # auto at sharded scale) routes to the mesh multi-rank path
-    _, distributed = get_backend("tpu").plan(x.size, "radix", args.distribute)
-    if distributed:
-        from mpi_k_selection_tpu.parallel import (
-            distributed_radix_select_many,
-            make_mesh,
-        )
+    # one shared dispatch decision with the library surface (tpu backend):
+    # --distribute always (or auto at sharded scale) routes to the mesh
+    # multi-rank path; a --devices cap below 2 falls back to single-device
+    mesh = get_backend("tpu").plan_many(x.size, args.distribute, args.devices)
+    if mesh is not None:
+        from mpi_k_selection_tpu.parallel import distributed_radix_select_many
 
-        mesh = make_mesh(args.devices)
-        if mesh.size < 2:
-            # a --devices cap can shrink the mesh below the distributed
-            # minimum; run single-device (same silent fallback the planner
-            # applies on single-device hosts)
-            distributed = False
-        else:
-            ks = jnp.asarray(quantile_ranks(qs, x.size), jnp.int32)
-            fn = lambda: distributed_radix_select_many(xd, ks, mesh=mesh)
-            algorithm = "quantiles-distributed"
-            n_devices = mesh.size
-    if not distributed:
+        ks = jnp.asarray(quantile_ranks(qs, x.size), jnp.int32)
+        fn = lambda: distributed_radix_select_many(xd, ks, mesh=mesh)
+        algorithm = "quantiles-distributed"
+        n_devices = mesh.size
+    else:
         fn = lambda: _quantiles(xd, qs)
         algorithm = "quantiles"
         n_devices = 1

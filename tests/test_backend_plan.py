@@ -60,3 +60,21 @@ def test_backend_kselect_cgm_dispatch(rng):
     x = rng.integers(0, 10_000, size=1 << 14, dtype=np.int32)
     got = int(tpu_backend.kselect(x, 4321, algorithm="cgm"))
     assert got == int(np.sort(x)[4320])
+
+
+def test_tpu_backend_kselect_many_planned_dispatch(rng):
+    from mpi_k_selection_tpu.backends import tpu as tpu_backend
+
+    x = rng.integers(-(2**31), 2**31, size=2_100_000, dtype=np.int32)
+    ks_q = np.array([1, 1_050_000, 2_100_000])
+    want = np.sort(x, kind="stable")[ks_q - 1]
+    # auto: distributes on the virtual mesh (n divisible check may keep it
+    # single-device; either path must be exact)
+    got = np.asarray(tpu_backend.kselect_many(x, ks_q))
+    np.testing.assert_array_equal(got, want)
+    got = np.asarray(tpu_backend.kselect_many(x, ks_q, distribute="always"))
+    np.testing.assert_array_equal(got, want)
+    got = np.asarray(tpu_backend.quantiles(x, [0.5, 0.99], distribute="always"))
+    s = np.sort(x, kind="stable")
+    from mpi_k_selection_tpu.api import quantile_ranks
+    np.testing.assert_array_equal(got, s[np.asarray(quantile_ranks([0.5, 0.99], x.size)) - 1])

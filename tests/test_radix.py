@@ -145,3 +145,32 @@ def test_radix_select_sub32_dtypes_with_pallas_cutover(rng, dtype):
     )
     want = np.sort(x, kind="stable")[k - 1]
     assert np.asarray(got)[()] == want
+
+
+def test_disable_jit_python_paths(rng):
+    # SURVEY.md §4: run the python-level branches un-jitted (asserts,
+    # validation, dispatch) — shapes stay tiny, semantics must not change
+    import jax
+
+    x = jnp.asarray(rng.integers(-1000, 1000, size=2049, dtype=np.int32))
+    with jax.disable_jit():
+        got = int(radix_select(x, 1025))
+    assert got == int(np.sort(np.asarray(x))[1024])
+
+
+def test_property_fuzz_random_configs(rng):
+    # randomized sweep over (n, k, dtype, duplicates) vs the oracle —
+    # SURVEY.md §4 "property tests (random N, k, dtypes, duplicates-heavy)"
+    dtypes = [np.int32, np.uint32, np.int16, np.float32]
+    for trial in range(25):
+        n = int(rng.integers(1, 70_000))
+        k = int(rng.integers(1, n + 1))
+        dt = dtypes[trial % len(dtypes)]
+        if rng.integers(0, 2):  # duplicates-heavy half the time
+            base = rng.integers(0, max(2, n // 100) + 1, size=n)
+        else:
+            base = rng.integers(-(2**15), 2**15, size=n)
+        x = base.astype(dt)
+        got = np.asarray(radix_select(jnp.asarray(x), k))[()]
+        want = np.sort(x, kind="stable")[k - 1]
+        assert got == want, (trial, n, k, dt, got, want)
