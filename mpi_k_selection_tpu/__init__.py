@@ -18,8 +18,12 @@ Public API::
 
     import mpi_k_selection_tpu as ks
     ks.kselect(x, k)              # exact k-th smallest (1-indexed), any backend
+    ks.kselect_many(x, ks_list)   # multi-rank selection, one prepared pass
+    ks.quantiles(x, [.5, .9, .99])# exact nearest-rank order statistics
     ks.topk(x, k)                 # top-k values (and indices)
     ks.distributed_kselect(x, k)  # sharded over a jax.sharding.Mesh
+
+Full reference: docs/API.md.
 """
 
 from mpi_k_selection_tpu.version import __version__

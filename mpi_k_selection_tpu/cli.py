@@ -184,7 +184,7 @@ def _run_kth(args, x):
 def _run_quantiles(args, x):
     import jax.numpy as jnp
 
-    from mpi_k_selection_tpu.api import quantile_ranks, quantiles as _quantiles
+    from mpi_k_selection_tpu.api import quantile_ranks
     from mpi_k_selection_tpu.backends import get_backend
 
     try:
@@ -200,21 +200,16 @@ def _run_quantiles(args, x):
             f"{args.algorithm!r}"
         )
     xd = jnp.asarray(x)
-    # one shared dispatch decision with the library surface (tpu backend):
-    # --distribute always (or auto at sharded scale) routes to the mesh
-    # multi-rank path; a --devices cap below 2 falls back to single-device
-    mesh = get_backend("tpu").plan_many(x.size, args.distribute, args.devices)
-    if mesh is not None:
-        from mpi_k_selection_tpu.parallel import distributed_radix_select_many
-
-        ks = jnp.asarray(quantile_ranks(qs, x.size), jnp.int32)
-        fn = lambda: distributed_radix_select_many(xd, ks, mesh=mesh)
-        algorithm = "quantiles-distributed"
-        n_devices = mesh.size
-    else:
-        fn = lambda: _quantiles(xd, qs)
-        algorithm = "quantiles"
-        n_devices = 1
+    backend = get_backend("tpu")
+    # the backend owns the whole dispatch (plan_many + rank conversion +
+    # mesh path); the CLI re-plans only to label the result record —
+    # plan_many is pure, so the label always matches what executed
+    fn = lambda: backend.quantiles(
+        xd, qs, distribute=args.distribute, devices=args.devices
+    )
+    mesh = backend.plan_many(x.size, args.distribute, args.devices)
+    algorithm = "quantiles-distributed" if mesh is not None else "quantiles"
+    n_devices = mesh.size if mesh is not None else 1
     seconds, values = time_fn(fn, repeats=args.repeats, warmup=1)
     values = np.asarray(values)
     record = ResultRecord(

@@ -40,11 +40,17 @@ import numpy as np
 from mpi_k_selection_tpu.utils import dtypes as _dt
 
 
-def _signed_keys(x: jax.Array, largest: bool) -> jax.Array:
-    """Keys whose *descending* signed order equals the requested value order."""
+def _signed_keys(x: jax.Array, largest: bool):
+    """``(keys, native)``: keys whose *descending* signed order equals the
+    requested value order, and whether they are ``x`` itself (native)."""
     dtype = np.dtype(x.dtype)
     if largest and (jnp.issubdtype(dtype, jnp.signedinteger) or dtype.kind == "f"):
-        return x  # lax.top_k compares these natively
+        # lax.top_k compares these natively — but on TPU the float TopK
+        # path is ~3.5x slower than integer TopK (measured 5.8 vs 3.0 ms at
+        # 4096x32768 f32 k=8), so floats take the order-preserving integer
+        # bitcast below there; one elementwise pass buys a faster sort
+        if not (dtype.kind == "f" and jax.default_backend() == "tpu"):
+            return x, True
     u = _dt.to_sortable_bits(x)
     kdt = u.dtype
     bits = _dt.key_bits(dtype)
@@ -52,7 +58,25 @@ def _signed_keys(x: jax.Array, largest: bool) -> jax.Array:
         u = ~u
     msb = kdt.type(np.uint64(1) << np.uint64(bits - 1))
     signed = np.dtype(f"int{bits}")
-    return jax.lax.bitcast_convert_type(u ^ msb, signed)
+    return jax.lax.bitcast_convert_type(u ^ msb, signed), False
+
+
+def _decode_keys(kv: jax.Array, dtype, largest: bool) -> jax.Array:
+    """Inverse of the non-native :func:`_signed_keys` transform: signed keys
+    back to values of ``dtype``. Lets the flat/chunked paths return values
+    straight from ``lax.top_k``'s own output instead of a
+    ``take_along_axis`` gather — the batched (B, k)-from-(B, d) gather
+    lowers catastrophically on TPU (measured 135 ms for 32K indices at
+    4096x32768, ~25x the whole top-k)."""
+    dtype = np.dtype(dtype)
+    bits = _dt.key_bits(dtype)
+    kdt = np.dtype(f"uint{bits}")
+    u = jax.lax.bitcast_convert_type(kv, kdt)
+    msb = u.dtype.type(np.uint64(1) << np.uint64(bits - 1))
+    u = u ^ msb
+    if not largest:
+        u = ~u
+    return _dt.from_sortable_bits(u, dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("k", "largest", "method", "num_chunks"))
@@ -71,28 +95,38 @@ def topk(
     d = x.shape[-1]
     if not 1 <= k <= d:
         raise ValueError(f"k={k} out of range for last axis of size {d}")
-    keys = _signed_keys(x, largest)
+    keys, native = _signed_keys(x, largest)
     if method == "auto":
         if x.ndim == 1 and d >= 1 << 18 and d >= 64 * k and d < 2**31:
             method = "threshold"
-        elif d >= 1 << 16 and d >= 64 * k:
+        elif d >= 1 << 16 and d >= 64 * k and jax.default_backend() != "tpu":
+            # chunked wins ~90x over lax.top_k on CPU; on TPU the XLA TopK
+            # custom call is already strong and chunked LOSES 3-9x at every
+            # measured batched shape (see bench history) — use flat there
             method = "chunked"
         else:
             method = "flat"
+    # the flat/chunked paths take values straight from lax.top_k's output
+    # (key-decoded when the keys are transformed) — the batched (B, k)
+    # take_along_axis gather lowers catastrophically on TPU (see
+    # _decode_keys); the 1-D threshold/tournament paths produce indices
+    # only, and a 1-D gather of k elements is cheap
     if method == "threshold":
         if x.ndim != 1:
             raise ValueError("threshold method applies to 1-D inputs")
         idx = _threshold_topk_indices(x, k, largest)
-    elif method == "tournament":
+        return jnp.take_along_axis(x, idx, axis=-1), idx
+    if method == "tournament":
         if x.ndim != 1:
             raise ValueError("tournament method applies to 1-D inputs")
         idx = _tournament_topk_indices(keys, k)
-    elif method == "flat":
-        _, idx = jax.lax.top_k(keys, k)
+        return jnp.take_along_axis(x, idx, axis=-1), idx
+    if method == "flat":
+        kv, idx = jax.lax.top_k(keys, k)
     elif method == "chunked":
         c = num_chunks or _pick_num_chunks(d, k)
         if c <= 1 or d % c:
-            _, idx = jax.lax.top_k(keys, k)
+            kv, idx = jax.lax.top_k(keys, k)
         else:
             sub = d // c
             kk = keys.reshape(*keys.shape[:-1], c, sub)
@@ -100,11 +134,11 @@ def topk(
             base = jnp.arange(c, dtype=subidx.dtype)[:, None] * sub
             cand_idx = (subidx + base).reshape(*keys.shape[:-1], -1)
             cand_vals = subvals.reshape(*keys.shape[:-1], -1)
-            _, pos = jax.lax.top_k(cand_vals, k)
+            kv, pos = jax.lax.top_k(cand_vals, k)
             idx = jnp.take_along_axis(cand_idx, pos, axis=-1)
     else:
         raise ValueError(f"unknown topk method {method!r}")
-    values = jnp.take_along_axis(x, idx, axis=-1)
+    values = kv if native else _decode_keys(kv, x.dtype, largest)
     return values, idx
 
 
