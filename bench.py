@@ -251,6 +251,7 @@ def bench_cgm_native():
     `mpirun -np 4` launch of the reference, `TODO-kth-problem-cgm.c`)."""
     import numpy as np
 
+    from mpi_k_selection_tpu.errors import NativeUnavailableError
     from mpi_k_selection_tpu.utils import datagen
 
     try:
@@ -280,9 +281,10 @@ def bench_cgm_native():
     except Exception as e:
         _emit({"metric": "cgm_mpi_16m_4ranks", "value": 0.0, "unit": "elems/sec",
                "vs_baseline": 0.0, "error": str(e)[:200]})
-        # only a missing native toolchain is tolerable; a crash in the
-        # backend itself must fail the bench exit code
-        return "requires the native" in str(e)
+        # only a missing native toolchain is tolerable (typed, so a reworded
+        # message can't change the outcome); a crash in the backend itself
+        # must fail the bench exit code
+        return isinstance(e, NativeUnavailableError)
 
 
 def bench_seq_oracle():

@@ -8,6 +8,7 @@ function; the CLI (cli.py) and the backends are thin wrappers over this.
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 
 from mpi_k_selection_tpu.ops.radix import radix_select, radix_select_many
 from mpi_k_selection_tpu.ops.sort import sort_select
@@ -40,18 +41,29 @@ def kselect_many(x, ks, **kwargs):
     Amortized multi-rank selection (the p50/p90/p99 telemetry shape): the
     radix path shares the prepared key view and the first histogram pass
     across all queries (ops/radix.py:radix_select_many); small inputs sort
-    once and gather. Returns answers in ``ks`` order.
+    once and gather. Returns answers in ``ks`` order, with ``ks``'s shape
+    (a scalar k returns a scalar, matching :func:`kselect`).
     """
     x = jnp.asarray(x)
     if x.size == 0:
         raise ValueError("kselect_many requires a non-empty input")
     check_concrete_ks(ks, x.size)
     if x.size <= 1 << 14:
+        if kwargs:
+            import warnings
+
+            warnings.warn(
+                f"kselect_many: small input takes the sort path; radix "
+                f"options {sorted(kwargs)} are ignored",
+                stacklevel=2,
+            )
         ks_arr = jnp.atleast_1d(jnp.asarray(ks))
         s = jnp.sort(x.ravel())
         idx = jnp.clip(ks_arr.astype(jnp.int32) - 1, 0, x.size - 1)
-        return s[idx.ravel()].reshape(ks_arr.shape)
-    return radix_select_many(x, ks, **kwargs)
+        out = s[idx.ravel()].reshape(ks_arr.shape)
+    else:
+        out = radix_select_many(x, ks, **kwargs)
+    return restore_k_shape(out, ks)
 
 
 def quantile_ranks(qs, n: int) -> list[int]:
@@ -61,13 +73,27 @@ def quantile_ranks(qs, n: int) -> list[int]:
     ``ceil(q * n)`` by one rank)."""
     import math
 
-    import numpy as np
-
     qs_list = [float(q) for q in np.atleast_1d(np.asarray(qs, dtype=np.float64))]
     for q in qs_list:
         if not 0.0 <= q <= 1.0:
             raise ValueError(f"quantile {q} outside [0, 1]")
     return [max(1, min(n, math.ceil(q * n))) for q in qs_list]
+
+
+def quantile_ks(qs, n: int) -> jnp.ndarray:
+    """:func:`quantile_ranks` as a device array in the selection's count
+    dtype — int64 for n >= 2^31, where an int32 rank would overflow at the
+    multi-chip 64-bit scales PARITY.md targets. The one conversion shared by
+    every quantiles entry point (here and backends/tpu.py)."""
+    from mpi_k_selection_tpu.ops.radix import select_count_dtype
+
+    return jnp.asarray(quantile_ranks(qs, n), select_count_dtype(n))
+
+
+def restore_k_shape(out, ks):
+    """Shape contract of the *_many entry points: answers carry ``ks``'s
+    shape, so a scalar k returns a scalar (matching :func:`kselect`)."""
+    return out.reshape(()) if np.ndim(ks) == 0 else out
 
 
 def quantiles(x, qs, **kwargs):
@@ -77,8 +103,7 @@ def quantiles(x, qs, **kwargs):
     x = jnp.asarray(x)
     if x.size == 0:
         raise ValueError("quantiles requires a non-empty input")
-    ks = quantile_ranks(qs, x.size)
-    return kselect_many(x, jnp.asarray(ks, jnp.int32), **kwargs)
+    return kselect_many(x, quantile_ks(qs, x.size), **kwargs)
 
 
 def median(x, **kwargs):

@@ -9,6 +9,8 @@ through the framework's native shared-memory collectives runtime
 
 from __future__ import annotations
 
+from mpi_k_selection_tpu.errors import NativeUnavailableError
+
 NAME = "mpi"
 
 _NOT_BUILT = (
@@ -21,7 +23,7 @@ def kselect(x, k: int, *, num_procs: int = 4, **kwargs):
     try:
         from mpi_k_selection_tpu.native import cgm_driver
     except ImportError as e:
-        raise RuntimeError(_NOT_BUILT) from e
+        raise NativeUnavailableError(_NOT_BUILT) from e
 
     return cgm_driver.kselect(x, k, num_procs=num_procs, **kwargs)
 

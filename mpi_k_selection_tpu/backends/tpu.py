@@ -18,7 +18,7 @@ from mpi_k_selection_tpu import api
 NAME = "tpu"
 
 
-def plan(n: int, algorithm: str = "auto", distribute: str = "auto"):
+def plan(n: int, algorithm: str = "auto", distribute: str = "auto", n_dev: int | None = None):
     """Resolve (effective_algorithm, distributed) for a selection of size n.
 
     The radix and cgm algorithms have distributed paths; an explicit
@@ -27,12 +27,28 @@ def plan(n: int, algorithm: str = "auto", distribute: str = "auto"):
     CGM is the reference's multi-rank protocol (``TODO-kth-problem-cgm.c``) —
     it is *only* distributed, so ``distribute='never'`` with it is an error
     (mirroring the reference's world_size >= 2 abort at ``:56-59``).
+
+    ``n_dev`` is the mesh size the caller will actually run on (the
+    ``devices`` cap of :func:`plan_many`); defaults to every visible device.
+    Non-divisible N distributes fine — the distributed paths pad to equal
+    shards with order-maximal sentinels (parallel/mesh.py:pad_to_multiple).
     """
     if distribute not in ("auto", "never", "always"):
         raise ValueError(
             f"distribute={distribute!r} must be one of 'auto', 'never', 'always'"
         )
-    n_dev = len(jax.devices())
+    from mpi_k_selection_tpu import config
+
+    if n_dev is None:
+        n_dev = len(jax.devices())
+    if distribute == "always" and n_dev < config.MIN_DEVICES_DISTRIBUTED:
+        # mirror require_distributed / the reference's world_size >= 2 abort
+        # (TODO-kth-problem-cgm.c:56-59) instead of a silent single-chip run;
+        # checked before the cgm branch so cgm surfaces it at plan time too
+        raise ValueError(
+            f"distribute='always' needs >= {config.MIN_DEVICES_DISTRIBUTED} "
+            f"devices, have {n_dev}"
+        )
     if algorithm == "cgm":
         if distribute == "never":
             raise ValueError(
@@ -51,9 +67,9 @@ def plan(n: int, algorithm: str = "auto", distribute: str = "auto"):
             "use algorithm='radix', 'cgm' (or 'auto') with distribute='always'"
         )
     use_mesh = {
-        "auto": distributable and n_dev > 1 and n >= 1 << 20 and n % n_dev == 0,
+        "auto": distributable and n_dev > 1 and n >= 1 << 20,
         "never": False,
-        "always": n_dev > 1,
+        "always": True,
     }[distribute]
     if use_mesh:
         return "radix", True
@@ -80,16 +96,22 @@ def plan_many(n: int, distribute: str = "auto", devices: int | None = None):
 
     The one dispatch decision shared by :func:`kselect_many` and the CLI's
     ``--quantiles`` path: the kselect planner (radix is the only multi-rank
-    algorithm), plus the ``devices`` cap — a cap that shrinks the mesh
-    below the distributed minimum of 2 falls back to single-device, the
-    same silent fallback the planner applies on single-device hosts."""
-    _, use_mesh = plan(n, "radix", distribute)
+    algorithm), evaluated against the *capped* device count so a ``devices``
+    cap and the auto-size gate agree on the mesh that will actually run. A
+    cap that shrinks the mesh below the distributed minimum of 2 falls back
+    to single-device under ``auto`` (the same fallback the planner applies
+    on single-device hosts) and raises under ``always``."""
+    n_dev = len(jax.devices())
+    if devices is not None:
+        n_dev = min(devices, n_dev)
+    _, use_mesh = plan(n, "radix", distribute, n_dev=n_dev)
     if not use_mesh:
         return None
     from mpi_k_selection_tpu.parallel import make_mesh
 
-    mesh = make_mesh(devices)
-    return mesh if mesh.size >= 2 else None
+    # n_dev (not the raw cap) so the gate and the mesh always agree — an
+    # over-request like devices=16 on an 8-device host caps to 8
+    return make_mesh(n_dev)
 
 
 def kselect_many(x, ks, *, distribute: str = "auto", devices: int | None = None, **kwargs):
@@ -102,9 +124,10 @@ def kselect_many(x, ks, *, distribute: str = "auto", devices: int | None = None,
     if mesh is not None:
         from mpi_k_selection_tpu.parallel import radix as pradix
 
-        return pradix.distributed_radix_select_many(
+        out = pradix.distributed_radix_select_many(
             jnp.asarray(x), ks, mesh=mesh, **kwargs
         )
+        return api.restore_k_shape(out, ks)
     return api.kselect_many(jnp.asarray(x), ks, **kwargs)
 
 
@@ -112,7 +135,7 @@ def quantiles(x, qs, *, distribute: str = "auto", devices: int | None = None, **
     """Exact nearest-rank order statistics at quantiles ``qs``; distributes
     like :func:`kselect_many`."""
     x = jnp.asarray(x)
-    ks = jnp.asarray(api.quantile_ranks(qs, x.size), jnp.int32)
+    ks = api.quantile_ks(qs, x.size)
     return kselect_many(x, ks, distribute=distribute, devices=devices, **kwargs)
 
 

@@ -1,0 +1,15 @@
+"""Typed exceptions shared across the package.
+
+The reference signals every failure as a process exit (``MPI_Abort``,
+``TODO-kth-problem-cgm.c:58``); a library needs typed errors so callers can
+distinguish "this machine cannot run it" from "the run failed".
+"""
+
+from __future__ import annotations
+
+
+class NativeUnavailableError(RuntimeError):
+    """The native (C++) runtime cannot be built/loaded on this machine —
+    e.g. no C++ toolchain. Environmental, not a bug: harness code (bench.py)
+    treats it as a tolerable skip, while any other exception from the native
+    backend is a real failure."""

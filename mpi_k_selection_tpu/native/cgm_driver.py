@@ -17,7 +17,9 @@ def kselect_full(x, k: int, *, num_procs: int = 4, c: int | None = None):
 
     lib = loader.get_lib()
     if lib is None:
-        raise RuntimeError(
+        from mpi_k_selection_tpu.errors import NativeUnavailableError
+
+        raise NativeUnavailableError(
             "the native runtime is unavailable (no C++ compiler?); "
             "build it with `python -m mpi_k_selection_tpu.native.build`"
         )

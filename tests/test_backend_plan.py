@@ -78,3 +78,57 @@ def test_tpu_backend_kselect_many_planned_dispatch(rng):
     s = np.sort(x, kind="stable")
     from mpi_k_selection_tpu.api import quantile_ranks
     np.testing.assert_array_equal(got, s[np.asarray(quantile_ranks([0.5, 0.99], x.size)) - 1])
+
+
+def test_plan_auto_distributes_non_divisible_n():
+    # the padding path (pad_to_multiple) makes ragged N shardable; auto must
+    # not silently fall back to single-chip for n % n_dev != 0
+    algo, dist = tpu_backend.plan((1 << 20) + 5, "auto", "auto")
+    assert algo == "radix" and dist
+
+
+def test_backend_auto_distributes_and_matches_oracle_ragged(rng):
+    n = (1 << 20) + 5
+    x = rng.integers(-(2**31), 2**31, size=n, dtype=np.int32)
+    got = int(tpu_backend.kselect(x, n // 2))  # auto + auto on the 8-dev mesh
+    assert got == int(np.sort(x, kind="stable")[n // 2 - 1])
+
+
+def test_plan_always_single_device_raises():
+    with pytest.raises(ValueError, match="needs >= 2 devices"):
+        tpu_backend.plan(1 << 22, "radix", "always", n_dev=1)
+
+
+def test_plan_many_respects_devices_cap():
+    # gate must be evaluated against the capped device count: a cap of 1
+    # falls back to single-device under auto...
+    assert tpu_backend.plan_many(1 << 22, "auto", devices=1) is None
+    # ...and raises under always (require_distributed semantics)
+    with pytest.raises(ValueError, match="needs >= 2 devices"):
+        tpu_backend.plan_many(1 << 22, "always", devices=1)
+    mesh = tpu_backend.plan_many(1 << 22, "auto", devices=4)
+    assert mesh is not None and mesh.size == 4
+
+
+def test_kselect_many_scalar_k_returns_scalar(rng):
+    from mpi_k_selection_tpu import api
+
+    x = rng.integers(0, 1 << 20, size=100_000, dtype=np.int32)
+    out = api.kselect_many(x, 50_000)
+    assert out.shape == ()
+    assert int(out) == int(np.sort(x)[49_999])
+    out_small = api.kselect_many(x[:1000], 17)
+    assert out_small.shape == ()
+    # backend path (distributed on the virtual mesh) honors the same contract
+    big = rng.integers(0, 1 << 20, size=(1 << 20) + 3, dtype=np.int32)
+    out_b = tpu_backend.kselect_many(big, 12345)
+    assert out_b.shape == ()
+    assert int(out_b) == int(np.sort(big)[12344])
+
+
+def test_kselect_many_warns_on_ignored_radix_kwargs(rng):
+    from mpi_k_selection_tpu import api
+
+    x = rng.integers(0, 100, size=1000, dtype=np.int32)
+    with pytest.warns(UserWarning, match="sort path"):
+        api.kselect_many(x, [1, 500], radix_bits=8)

@@ -134,15 +134,29 @@ def test_cli_quantiles_distributed(monkeypatch):
     assert rc == 0
 
 
-def test_cli_quantiles_devices_cap_falls_back_single(capsys):
+def test_cli_quantiles_devices_cap_auto_falls_back_single(capsys):
     from mpi_k_selection_tpu.cli import main
 
     rc = main(
         ["--backend", "tpu", "--n", "50000", "--quantiles", "0.5",
-         "--distribute", "always", "--devices", "1", "--seed", "3", "--verify"]
+         "--devices", "1", "--seed", "3", "--verify"]
     )
     assert rc == 0
     assert "exact match" in capsys.readouterr().out
+
+
+def test_cli_quantiles_devices_cap_always_errors():
+    # distribute='always' capped below 2 devices raises (the reference's
+    # world_size >= 2 abort), no silent single-chip fallback
+    import pytest
+
+    from mpi_k_selection_tpu.cli import main
+
+    with pytest.raises(SystemExit, match="needs >= 2 devices"):
+        main(
+            ["--backend", "tpu", "--n", "50000", "--quantiles", "0.5",
+             "--distribute", "always", "--devices", "1", "--seed", "3"]
+        )
 
 
 def test_cli_quantiles_rejects_non_radix_algorithm():

@@ -149,3 +149,32 @@ def test_distributed_radix_select_many_2d_ks(mesh8, rng):
     ks_2d = np.array([[1, 2], [4000, 9000]])
     got = np.asarray(distributed_radix_select_many(x, ks_2d, mesh=mesh8))
     np.testing.assert_array_equal(got, np.sort(x, kind="stable")[ks_2d - 1])
+
+
+def _assert_replicated(arr):
+    """Every device's buffer of a nominally-replicated output must be equal —
+    the dynamic check for the two check_vma=False shard_map bodies (a
+    replication bug would make devices disagree silently)."""
+    shards = list(arr.addressable_shards)
+    assert len(shards) > 1, "expected a multi-device output"
+    ref = np.asarray(shards[0].data)
+    for s in shards[1:]:
+        np.testing.assert_array_equal(np.asarray(s.data), ref)
+
+
+def test_cgm_outputs_replicated_on_all_devices(mesh8):
+    x = datagen.generate(N, pattern="uniform", seed=41, dtype=np.int32)
+    val, rounds = distributed_cgm_select(x, N // 2, mesh=mesh8, return_rounds=True)
+    _assert_replicated(val)
+    _assert_replicated(rounds)
+    assert int(val) == int(seq.kselect(x, N // 2))
+
+
+def test_distributed_topk_outputs_replicated_on_all_devices(mesh8):
+    from mpi_k_selection_tpu.parallel import distributed_topk
+
+    x = datagen.generate(N, pattern="normal", seed=42, dtype=np.float32)
+    vals, idx = distributed_topk(x, 16, mesh=mesh8)
+    _assert_replicated(vals)
+    _assert_replicated(idx)
+    np.testing.assert_array_equal(np.asarray(vals), np.sort(x)[::-1][:16])
