@@ -41,14 +41,19 @@ def plan(n: int, algorithm: str = "auto", distribute: str = "auto", n_dev: int |
 
     if n_dev is None:
         n_dev = len(jax.devices())
-    if distribute == "always" and n_dev < config.MIN_DEVICES_DISTRIBUTED:
+
+    def check_min_devices():
         # mirror require_distributed / the reference's world_size >= 2 abort
-        # (TODO-kth-problem-cgm.c:56-59) instead of a silent single-chip run;
-        # checked before the cgm branch so cgm surfaces it at plan time too
-        raise ValueError(
-            f"distribute='always' needs >= {config.MIN_DEVICES_DISTRIBUTED} "
-            f"devices, have {n_dev}"
-        )
+        # (TODO-kth-problem-cgm.c:56-59) instead of a silent single-chip
+        # run. Runs AFTER the algorithm-distributability validation so a
+        # non-distributable algorithm keeps its more specific error even on
+        # single-device hosts.
+        if distribute == "always" and n_dev < config.MIN_DEVICES_DISTRIBUTED:
+            raise ValueError(
+                f"distribute='always' needs >= {config.MIN_DEVICES_DISTRIBUTED} "
+                f"devices, have {n_dev}"
+            )
+
     if algorithm == "cgm":
         if distribute == "never":
             raise ValueError(
@@ -57,6 +62,7 @@ def plan(n: int, algorithm: str = "auto", distribute: str = "auto", n_dev: int |
                 "TODO-kth-problem-cgm.c:56-59); use algorithm='radix' or "
                 "'sort' single-chip"
             )
+        check_min_devices()
         return "cgm", True
     distributable = algorithm in ("auto", "radix")
     if distribute == "always" and not distributable:
@@ -66,6 +72,7 @@ def plan(n: int, algorithm: str = "auto", distribute: str = "auto", n_dev: int |
             f"algorithm={algorithm!r} has no distributed path; "
             "use algorithm='radix', 'cgm' (or 'auto') with distribute='always'"
         )
+    check_min_devices()
     use_mesh = {
         "auto": distributable and n_dev > 1 and n >= 1 << 20,
         "never": False,

@@ -40,6 +40,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 
 try:  # pltpu is importable on CPU builds too; guard for safety
@@ -48,6 +49,13 @@ except ImportError:  # pragma: no cover
     pltpu = None
 
 LANES = 128
+
+
+def _i32const(v: int) -> int:
+    """Python int with the uint32 bit pattern ``v`` as a signed int32 value
+    (the kernels compute on int32 bit patterns)."""
+    v &= 0xFFFFFFFF
+    return v - (1 << 32) if v >= (1 << 31) else v
 
 
 def split_planes(keys: jax.Array) -> tuple[jax.Array, jax.Array]:
@@ -99,6 +107,32 @@ def prepare_tiles64(keys: jax.Array, block_rows: int = 4096):
     hi2, n = prepare_tiles32(hi, block_rows)
     lo2, _ = prepare_tiles32(lo, block_rows)
     return hi2, lo2, n
+
+
+def prepare_raw_tiles32(x: jax.Array, block_rows: int = 4096):
+    """``(tiles, n)`` of RAW bit patterns of a 4-byte-dtype array — no
+    key transform pass. The sortable-key transform happens inside the
+    kernel instead (``key_op``/``key_xor``, see utils/dtypes.py:key_fold):
+    for integer dtypes it folds into the kernel's xor constant at zero
+    cost, so when n is block-aligned this prepare is a free bitcast+reshape
+    and the select never touches the data outside the histogram kernels."""
+    x = x.ravel()
+    if np.dtype(x.dtype).itemsize != 4:
+        raise ValueError(f"prepare_raw_tiles32 wants a 4-byte dtype, got {x.dtype}")
+    raw = jax.lax.bitcast_convert_type(x, jnp.uint32)
+    return prepare_tiles32(raw, block_rows)
+
+
+def prepare_raw_tiles64(x: jax.Array, block_rows: int = 4096):
+    """``(hi_tiles, lo_tiles, n)`` of RAW bit planes of an 8-byte-dtype
+    array; the key transform happens in kernel (see prepare_raw_tiles32).
+    Skips the full-array to_sortable pass; the plane deinterleave remains
+    (it is the kernels' required layout)."""
+    x = x.ravel()
+    if np.dtype(x.dtype).itemsize != 8:
+        raise ValueError(f"prepare_raw_tiles64 wants an 8-byte dtype, got {x.dtype}")
+    raw = jax.lax.bitcast_convert_type(x, jnp.uint64)
+    return prepare_tiles64(raw, block_rows)
 
 
 def _cap_block_rows(block_rows: int, radix_bits: int) -> int:
@@ -180,18 +214,42 @@ def _packed_count(z, out_ref, radix_bits, group=8):
                 flushes = 0
 
 
-def _hist_kernel_packed(zref_ref, keys_ref, out_ref, *, shift, radix_bits, has_prefix):
+def _shifted_digit(keys_ref, zref_ref, shift, radix_bits, has_prefix, key_op):
+    """``z`` such that ``z == b`` iff the element is active and its digit is
+    b — shared by the packed and compare 32-bit kernels.
+
+    ``key_op`` selects the in-kernel key transform over the RAW bit tiles:
+
+    - ``"none"``  — tiles already hold sortable keys (legacy path).
+    - ``"xor"``   — key = raw ^ C for integer dtypes. FREE here: the shift
+      distributes over xor, so C>>shift is folded into ``zref`` by the
+      wrapper and this path is byte-identical to "none" with a prefix.
+    - ``"float"`` — float32 keys (neg ? ~raw : raw | MSB). Two extra VPU
+      ops: ``key >> shift`` equals ``(raw >> shift) ^ (neg ? ~0 >> shift
+      : MSB >> shift)`` with both constants static.
+    """
+    k = jax.lax.bitcast_convert_type(keys_ref[:], jnp.int32)
+    s = jax.lax.shift_right_logical(k, jnp.int32(shift))
+    if key_op == "float":
+        m_neg = jnp.int32(_i32const(0xFFFFFFFF >> shift))
+        m_pos = jnp.int32(_i32const(0x80000000 >> shift))
+        s = s ^ jnp.where(k < jnp.int32(0), m_neg, m_pos)
+    if has_prefix or key_op != "none":
+        # key_op="xor"/"float" route prefix-free passes through here too
+        # (zref carries the fold constant; the wrapper enforces that the
+        # digit then sits at the top of the key, so no mask is needed)
+        return s ^ zref_ref[0, 0]
+    return s & jnp.int32((1 << radix_bits) - 1)
+
+
+def _hist_kernel_packed(
+    zref_ref, keys_ref, out_ref, *, shift, radix_bits, has_prefix, key_op="none"
+):
     """Packed-field (SWAR) histogram: ~3x fewer VPU ops than the compare-
     per-bucket kernel; measured 1.8x end-to-end on v5e (6.2ms vs 11.4ms for
     the 8-pass 134M select). Prefix fusion identical to ``_hist_kernel``."""
     i = pl.program_id(0)
-    # tiles arrive uint32 (see prepare_tiles32); work on the int32 bit pattern
-    k = jax.lax.bitcast_convert_type(keys_ref[:], jnp.int32)
-    s = jax.lax.shift_right_logical(k, jnp.int32(shift))
-    if has_prefix:
-        z = s ^ zref_ref[0, 0]
-    else:
-        z = s & jnp.int32((1 << radix_bits) - 1)
+    z = _shifted_digit(keys_ref, zref_ref, shift, radix_bits, has_prefix, key_op)
 
     @pl.when(i == 0)
     def _():
@@ -200,15 +258,38 @@ def _hist_kernel_packed(zref_ref, keys_ref, out_ref, *, shift, radix_bits, has_p
     _packed_count(z, out_ref, radix_bits)
 
 
-def _hist_kernel64_packed(phi_ref, zlo_ref, hi_ref, lo_ref, out_ref, *, shift, radix_bits):
+def _lo_digit64(phi_ref, zlo_ref, hi_ref, lo_ref, shift, radix_bits, key_op):
+    """``z`` for the low-bit passes over two RAW (with ``key_op``) or
+    key-space planes; inactive elements (hi-plane prefix mismatch) are
+    pushed out of every bucket with one select.
+
+    ``key_op="float"`` applies the float64 transform in kernel: the whole
+    64-bit key flips with the sign (held by the hi plane), so the lo plane's
+    contribution is ``raw_lo ^ (neg ? ~0 : 0)`` and the hi compare uses
+    ``raw_hi ^ (neg ? ~0 : MSB)``. ``key_op="xor"`` needs no kernel code:
+    the wrapper folds the per-plane constants into ``phi``/``zlo``.
+    """
+    hi = jax.lax.bitcast_convert_type(hi_ref[:], jnp.int32)
+    lo = jax.lax.bitcast_convert_type(lo_ref[:], jnp.int32)
+    z = jax.lax.shift_right_logical(lo, jnp.int32(shift)) ^ zlo_ref[0, 0]
+    if key_op == "float":
+        neg = hi < jnp.int32(0)
+        hk = hi ^ jnp.where(neg, jnp.int32(-1), jnp.int32(_i32const(1 << 31)))
+        z = z ^ jnp.where(neg, jnp.int32(_i32const(0xFFFFFFFF >> shift)), jnp.int32(0))
+        active = hk == phi_ref[0, 0]
+    else:
+        active = hi == phi_ref[0, 0]
+    return jnp.where(active, z, jnp.int32(1 << (radix_bits + 1)))
+
+
+def _hist_kernel64_packed(
+    phi_ref, zlo_ref, hi_ref, lo_ref, out_ref, *, shift, radix_bits, key_op="none"
+):
     """Packed-field variant of the 64-bit two-plane kernel: digit/prefix-lo
     from the lo plane via the xor trick, hi-plane mismatch pushed out of
     every register gate with one select (see ``_hist_kernel64``)."""
     i = pl.program_id(0)
-    hi = jax.lax.bitcast_convert_type(hi_ref[:], jnp.int32)
-    lo = jax.lax.bitcast_convert_type(lo_ref[:], jnp.int32)
-    z = jax.lax.shift_right_logical(lo, jnp.int32(shift)) ^ zlo_ref[0, 0]
-    z = jnp.where(hi == phi_ref[0, 0], z, jnp.int32(1 << (radix_bits + 1)))
+    z = _lo_digit64(phi_ref, zlo_ref, hi_ref, lo_ref, shift, radix_bits, key_op)
 
     @pl.when(i == 0)
     def _():
@@ -217,7 +298,9 @@ def _hist_kernel64_packed(phi_ref, zlo_ref, hi_ref, lo_ref, out_ref, *, shift, r
     _packed_count(z, out_ref, radix_bits)
 
 
-def _hist_kernel(zref_ref, keys_ref, out_ref, *, shift, radix_bits, has_prefix):
+def _hist_kernel(
+    zref_ref, keys_ref, out_ref, *, shift, radix_bits, has_prefix, key_op="none"
+):
     """One grid step: per-lane digit histogram of one (block_rows, 128) block.
 
     With a prefix, ``zref_ref`` holds ``prefix << radix_bits`` and
@@ -227,14 +310,7 @@ def _hist_kernel(zref_ref, keys_ref, out_ref, *, shift, radix_bits, has_prefix):
     is active regardless of its high bits, so ``z`` is just the masked digit.
     """
     i = pl.program_id(0)
-    # tiles arrive uint32 (see prepare_tiles32); work on the int32 bit pattern
-    k = jax.lax.bitcast_convert_type(keys_ref[:], jnp.int32)
-    # logical shift on the int32 bit pattern == shift on the uint32 key
-    s = jax.lax.shift_right_logical(k, jnp.int32(shift))
-    if has_prefix:
-        z = s ^ zref_ref[0, 0]
-    else:
-        z = s & jnp.int32((1 << radix_bits) - 1)
+    z = _shifted_digit(keys_ref, zref_ref, shift, radix_bits, has_prefix, key_op)
 
     @pl.when(i == 0)
     def _():
@@ -258,6 +334,8 @@ def _hist_kernel(zref_ref, keys_ref, out_ref, *, shift, radix_bits, has_prefix):
         "count_dtype",
         "packed",
         "orig_n",
+        "key_op",
+        "key_xor",
     ),
 )
 def pallas_radix_histogram(
@@ -272,6 +350,8 @@ def pallas_radix_histogram(
     packed: bool = True,
     tiles: jax.Array | None = None,
     orig_n: int | None = None,
+    key_op: str = "none",
+    key_xor: int = 0,
 ) -> jax.Array:
     """Histogram of the ``radix_bits`` digit at ``shift`` over active keys.
 
@@ -280,18 +360,30 @@ def pallas_radix_histogram(
     prefix`` (all active when ``prefix`` is None). Returns ``(2**radix_bits,)``
     counts in ``count_dtype``.
 
-    ``tiles``/``orig_n`` (from :func:`prepare_tiles32`) skip the per-call
-    pad/reshape so pass loops materialize the tiled view once; ``keys`` may
-    be None then. ``block_rows`` must match the prepared tiling.
+    ``tiles``/``orig_n`` (from :func:`prepare_tiles32` or, with ``key_op``,
+    :func:`prepare_raw_tiles32`) skip the per-call pad/reshape so pass loops
+    materialize the tiled view once; ``keys`` may be None then.
 
-    ``block_rows=4096`` is the measured v5e sweet spot (0.74 ms vs 0.86 ms
-    at 1024 for a 537 MB pass, ~89% of HBM peak); 8192 exceeds the 16 MB
-    scoped-VMEM budget with double buffering.
+    ``key_op``/``key_xor`` (utils/dtypes.py:key_fold) make the tiles RAW bit
+    patterns and apply the sortable-key transform in kernel — free for
+    integer dtypes (the xor constant folds into ``zref``), two VPU ops for
+    float32. ``prefix`` and the returned bucket walk stay in key space.
+    Removes the full-array to_sortable pass (measured 1.63 ms at N=2^27 on
+    v5e — ~22% of the whole select).
+
+    ``block_rows=4096`` is the measured v5e sweet spot; 8192 exceeds the
+    16 MB scoped-VMEM budget with double buffering.
     """
     if pltpu is None:
         raise NotImplementedError(
             "the pallas histogram kernel is not available in this jax build"
         )
+    if key_op not in ("none", "xor", "float"):
+        raise ValueError(f"unknown key_op {key_op!r}")
+    if key_op != "none" and prefix is None and shift + radix_bits != 32:
+        # fold modes compute z by xor only; a prefix-free digit below the
+        # top of the key would need the legacy mask path
+        raise ValueError("key_op needs shift + radix_bits == 32 when prefix is None")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     nb = 1 << radix_bits
@@ -318,13 +410,17 @@ def pallas_radix_histogram(
 
     has_prefix = prefix is not None
     pref = jnp.asarray(0 if prefix is None else prefix, jnp.uint32)
-    zref = jax.lax.bitcast_convert_type(
-        jax.lax.shift_left(pref, jnp.uint32(radix_bits)), jnp.int32
-    ).reshape(1, 1)
+    zbits = jax.lax.shift_left(pref, jnp.uint32(radix_bits))
+    if key_op == "xor":
+        # the integer-key fold: (raw ^ C) >> s == (raw >> s) ^ (C >> s),
+        # so C lands in the xor reference for free
+        zbits = zbits ^ jnp.uint32((key_xor & 0xFFFFFFFF) >> shift)
+    zref = jax.lax.bitcast_convert_type(zbits, jnp.int32).reshape(1, 1)
 
     kern = _hist_kernel_packed if packed else _hist_kernel
     kernel = functools.partial(
-        kern, shift=shift, radix_bits=radix_bits, has_prefix=has_prefix
+        kern, shift=shift, radix_bits=radix_bits, has_prefix=has_prefix,
+        key_op=key_op,
     )
     # trace the kernel with x64 off: the kernel is int32-only, and Mosaic
     # fails to legalize programs traced in x64 mode (int64 grid indices)
@@ -346,26 +442,29 @@ def pallas_radix_histogram(
 
     pad = pad_to - n
     if pad:
-        # padded zero keys always land in bucket 0 on the prefix-free pass;
-        # with a prefix they match (and land in bucket 0) only when prefix==0
+        # padded raw zeros hold the key K0 = to_sortable(raw 0) for the
+        # tile mode in use; they count in bucket (K0 >> shift) & mask
+        # exactly when the prefix matches K0's high bits (always, on the
+        # prefix-free pass — shift + radix_bits covers the whole key then)
+        k0 = {"none": 0, "xor": key_xor & 0xFFFFFFFF, "float": 1 << 31}[key_op]
+        b0 = (k0 >> shift) & (nb - 1)
         if has_prefix:
-            correction = jnp.where(pref == 0, count_dtype(pad), count_dtype(0))
+            cmp0 = jnp.uint32(k0 >> (shift + radix_bits))
+            correction = jnp.where(pref == cmp0, count_dtype(pad), count_dtype(0))
         else:
             correction = count_dtype(pad)
-        hist = hist.at[0].add(-correction)
+        hist = hist.at[b0].add(-correction)
     return hist
 
 
-def _hist_kernel64(phi_ref, zlo_ref, hi_ref, lo_ref, out_ref, *, shift, radix_bits):
+def _hist_kernel64(
+    phi_ref, zlo_ref, hi_ref, lo_ref, out_ref, *, shift, radix_bits, key_op="none"
+):
     """Low-bit pass over 64-bit keys: digit from the lo plane, activity =
     (hi plane == prefix_hi) AND (lo high bits == prefix_lo), the latter fused
     into the digit compare by xor (see _hist_kernel)."""
     i = pl.program_id(0)
-    hi = jax.lax.bitcast_convert_type(hi_ref[:], jnp.int32)
-    lo = jax.lax.bitcast_convert_type(lo_ref[:], jnp.int32)
-    z = jax.lax.shift_right_logical(lo, jnp.int32(shift)) ^ zlo_ref[0, 0]
-    # any hi mismatch forces z out of every bucket; one select, no mask ANDs
-    z = jnp.where(hi == phi_ref[0, 0], z, jnp.int32(1 << (radix_bits + 1)))
+    z = _lo_digit64(phi_ref, zlo_ref, hi_ref, lo_ref, shift, radix_bits, key_op)
 
     @pl.when(i == 0)
     def _():
@@ -389,6 +488,8 @@ def _hist_kernel64(phi_ref, zlo_ref, hi_ref, lo_ref, out_ref, *, shift, radix_bi
         "count_dtype",
         "packed",
         "orig_n",
+        "key_op",
+        "key_xor",
     ),
 )
 def pallas_radix_histogram64(
@@ -403,6 +504,8 @@ def pallas_radix_histogram64(
     packed: bool = True,
     tiles: tuple[jax.Array, jax.Array] | None = None,
     orig_n: int | None = None,
+    key_op: str = "none",
+    key_xor: int = 0,
 ) -> jax.Array:
     """64-bit-key variant of :func:`pallas_radix_histogram` (same contract).
 
@@ -411,13 +514,21 @@ def pallas_radix_histogram64(
     take the XLA fallback in ops/histogram.py.
 
     ``tiles=(hi_tiles, lo_tiles)`` + ``orig_n`` (from
-    :func:`prepare_tiles64`) skip the per-call deinterleave + pad/reshape;
-    pass-loop callers prepare once up front. ``keys`` may be None then.
+    :func:`prepare_tiles64`, or :func:`prepare_raw_tiles64` with
+    ``key_op``) skip the per-call deinterleave + pad/reshape; pass-loop
+    callers prepare once up front. ``keys`` may be None then.
+
+    ``key_op``/``key_xor``: in-kernel key transform over raw bit planes
+    (utils/dtypes.py:key_fold) — free for int64/uint64 (per-plane xor
+    constants fold into ``phi``/``zlo``), a few VPU ops for float64 (the
+    sign lives in the hi plane and gates both planes' flips).
     """
     if pltpu is None:
         raise NotImplementedError(
             "the pallas histogram kernel is not available in this jax build"
         )
+    if key_op not in ("none", "xor", "float"):
+        raise ValueError(f"unknown key_op {key_op!r}")
     if prefix is None and shift + radix_bits != 64:
         raise ValueError(
             "prefix=None needs shift + radix_bits == 64 on the 64-bit kernel"
@@ -444,7 +555,10 @@ def pallas_radix_histogram64(
             )
         hi2, lo2, n = prepare_tiles64(keys, block_rows)
     if shift >= 32:
-        # digit and the whole prefix live in the hi plane: 32-bit kernel
+        # digit and the whole prefix live in the hi plane: 32-bit kernel.
+        # key_op carries over — for "xor" the hi plane's fold constant is
+        # the hi word of C; for "float" the f64 transform restricted to the
+        # hi plane IS the f32 transform (the sign bit lives there).
         pref32 = None if prefix is None else jnp.asarray(prefix, jnp.uint64).astype(jnp.uint32)
         return pallas_radix_histogram(
             None,
@@ -457,6 +571,8 @@ def pallas_radix_histogram64(
             packed=packed,
             tiles=hi2,
             orig_n=n,
+            key_op=key_op,
+            key_xor=(key_xor >> 32) & 0xFFFFFFFF,
         )
     if shift + radix_bits > 32:
         raise ValueError(
@@ -472,6 +588,11 @@ def pallas_radix_histogram64(
     phi = jax.lax.shift_right_logical(pref, jnp.uint64(lo_prefix_bits)).astype(jnp.uint32)
     plo = (pref & jnp.uint64((1 << lo_prefix_bits) - 1)).astype(jnp.uint32)
     zlo = jax.lax.shift_left(plo, jnp.uint32(radix_bits))
+    if key_op == "xor":
+        # per-plane fold: key_hi = raw_hi ^ C_hi (compared against phi),
+        # key_lo = raw_lo ^ C_lo (digit + lo-prefix via the z xor)
+        phi = phi ^ jnp.uint32((key_xor >> 32) & 0xFFFFFFFF)
+        zlo = zlo ^ jnp.uint32(((key_xor & 0xFFFFFFFF) >> shift))
     phi = jax.lax.bitcast_convert_type(phi, jnp.int32).reshape(1, 1)
     zlo = jax.lax.bitcast_convert_type(zlo, jnp.int32).reshape(1, 1)
 
@@ -483,7 +604,9 @@ def pallas_radix_histogram64(
     pad_to = grid * block_rows * LANES
 
     kern64 = _hist_kernel64_packed if packed else _hist_kernel64
-    kernel = functools.partial(kern64, shift=shift, radix_bits=radix_bits)
+    kernel = functools.partial(
+        kern64, shift=shift, radix_bits=radix_bits, key_op=key_op
+    )
     # x64 off while tracing: the kernel is int32-only (see 32-bit variant)
     with jax.enable_x64(False):
         lane_hist = pl.pallas_call(
@@ -507,7 +630,11 @@ def pallas_radix_histogram64(
 
     pad = pad_to - n
     if pad:
-        # zero pad keys count in bucket 0 only when the full prefix is zero
-        correction = jnp.where(pref == 0, count_dtype(pad), count_dtype(0))
-        hist = hist.at[0].add(-correction)
+        # padded raw zeros hold the 64-bit key K0 = to_sortable(raw 0);
+        # they count in bucket (K0 >> shift) & mask when the prefix matches
+        k0 = {"none": 0, "xor": key_xor & ~(-1 << 64), "float": 1 << 63}[key_op]
+        b0 = (k0 >> shift) & (nb - 1)
+        cmp0 = jnp.uint64(k0 >> (shift + radix_bits))
+        correction = jnp.where(pref == cmp0, count_dtype(pad), count_dtype(0))
+        hist = hist.at[b0].add(-correction)
     return hist

@@ -95,7 +95,13 @@ def cutover_passes(n: int, total_bits: int, radix_bits: int, budget: int) -> int
 
 
 def _collect_prefix_matches(
-    u, resolved_bits, prefix, budget: int, block: int = 1024, n_valid: int | None = None
+    u,
+    resolved_bits,
+    prefix,
+    budget: int,
+    block: int = 1024,
+    n_valid: int | None = None,
+    key_of=None,
 ):
     """Values (in key space) of up to ``budget`` elements whose top
     ``resolved_bits`` bits equal ``prefix`` (both traced), in position order,
@@ -111,17 +117,33 @@ def _collect_prefix_matches(
     histogram passes read into this branch lets XLA share one buffer across
     the cutover ``cond``; a ravel+reshape round-trip here made XLA
     rematerialize a second full-size copy inside the branch (OOM at the 1B
-    int32 config).
+    int32 config). With the raw-tiles fast path, ``u`` holds raw bit
+    patterns (or a (hi, lo) tuple of raw planes for 64-bit keys) and
+    ``key_of`` maps them to key space on the fly — elementwise, so XLA
+    fuses it into the compares and never materializes the keys.
     """
-    if u.ndim == 2:
-        nb_, block = u.shape
-        n = u.size
+    if key_of is None:
+        key_of = lambda v: v
+    planes = isinstance(u, tuple)
+    if planes:
+        hi2, lo2 = u
+        nb_, block = hi2.shape
+        n = hi2.size
         nv = n if n_valid is None else n_valid
-        kdt = u.dtype
+        kdt = key_of((hi2[:1, :1], lo2[:1, :1])).dtype
         total_bits = np.dtype(kdt).itemsize * 8
         cdt = jnp.int32 if n < 2**31 else jnp.int64
         padded = nv != n
-        u2 = u
+        ku2 = key_of((hi2, lo2))
+    elif u.ndim == 2:
+        nb_, block = u.shape
+        n = u.size
+        nv = n if n_valid is None else n_valid
+        kdt = key_of(u[:1, :1]).dtype
+        total_bits = np.dtype(kdt).itemsize * 8
+        cdt = jnp.int32 if n < 2**31 else jnp.int64
+        padded = nv != n
+        ku2 = key_of(u)
     else:
         n = u.shape[0]
         nv = n if n_valid is None else n_valid
@@ -131,9 +153,10 @@ def _collect_prefix_matches(
         nb_ = -(-n // block)
         padded = nb_ * block != n or nv != n
         up = jnp.pad(u, (0, nb_ * block - n)) if nb_ * block != n else u
-        u2 = up.reshape(nb_, block)
+        u = up.reshape(nb_, block)
+        ku2 = u
     mshift = jnp.asarray(total_bits - resolved_bits).astype(kdt)  # >= 1 pass ran
-    match2 = jax.lax.shift_right_logical(u2, mshift) == prefix
+    match2 = jax.lax.shift_right_logical(ku2, mshift) == prefix
     if padded:
         valid = (
             jax.lax.broadcasted_iota(cdt, (nb_, block), 0) * block
@@ -149,7 +172,10 @@ def _collect_prefix_matches(
     b = jnp.clip(jnp.searchsorted(off, target), 0, nb_ - 1).astype(cdt)
     prev = jnp.where(b > 0, off[jnp.maximum(b - 1, 0)], jnp.zeros_like(target))
     r = target - prev  # 1-based rank within block b
-    rows = u2[b]  # (budget, block)
+    if planes:
+        rows = key_of((hi2[b], lo2[b]))  # (budget, block), key space
+    else:
+        rows = key_of(u[b]) if u.ndim == 2 else u[b]
     rmatch = jax.lax.shift_right_logical(rows, mshift) == prefix
     if padded:
         cols = jax.lax.broadcasted_iota(cdt, (budget, block), 1)
@@ -177,7 +203,8 @@ def bucket_walk_step(hist, kk, prefix, kdt, radix_bits):
 
 
 class _Descent:
-    """Shared per-select state: sortable keys, prepared tiles, and the
+    """Shared per-select state: prepared kernel tiles (raw-bits with the
+    in-kernel key fold when available, key-space otherwise) and the
     one_pass bucket-walk closure both select entry points drive."""
 
     def __init__(self, x, radix_bits, hist_method, chunk):
@@ -193,31 +220,64 @@ class _Descent:
         self.total_bits = total_bits
         self.npasses = total_bits // radix_bits
         self.cdt = select_count_dtype(n)
-        self.u = _dt.to_sortable_bits(x)
-        self.kdt = self.u.dtype
+        self.kdt = jnp.dtype(_dt.key_dtype(x.dtype))
 
-        # pallas path: build the kernel's tiled key view ONCE for all
-        # passes (and the cutover collect) — per-pass views make XLA
-        # hold/remat extra full-size temporaries, OOMing 16 GB HBM at the
-        # 1B-element config
-        from mpi_k_selection_tpu.ops.histogram import prepare_keys
+        from mpi_k_selection_tpu.ops.histogram import prepare_keys, prepare_raw
 
-        self.tiles, self.tiles_n = prepare_keys(hist_method, self.u)
-        if (
-            self.tiles is not None
-            and len(self.tiles) == 1
-            and self.kdt == jnp.uint32
-        ):
-            # 32-bit: the collect scans the 2-D tiles tensor itself (the
-            # same uint32 buffer the kernels read) so `u` fuses away and
-            # the cutover cond's branches share one full-size buffer.
-            # Sub-32-bit keys keep the native-width `u`: the tiles are
-            # widened uint32, so collecting from them would shift by the
-            # wrong key width and return the wrong dtype.
-            self.u_collect = self.tiles[0]
+        # raw fast path (pallas methods, 32/64-bit dtypes): tiles hold the
+        # input's raw bits, the key transform runs in kernel — removes the
+        # full-array to_sortable pass (1.63 ms at N=2^27 on v5e). Either
+        # way the tiled view is built ONCE for all passes (and the cutover
+        # collect): per-pass views make XLA hold/remat extra full-size
+        # temporaries, OOMing 16 GB HBM at the 1B-element config.
+        _dt._require_x64(x.dtype)  # 64-bit key math needs x64 in every mode
+        raw = prepare_raw(hist_method, x)
+        if raw is not None:
+            self.tiles, self.tiles_n, self.key_op, self.key_xor = raw
+            self.u = None
+            # the collect scans the raw tiles, mapping bits to keys on the
+            # fly (XLA fuses the elementwise transform into the compare)
+            if len(self.tiles) == 1:
+                self.u_collect = self.tiles[0]
+            else:
+                self.u_collect = (self.tiles[0], self.tiles[1])
             self.n_collect = self.tiles_n
+            dtype = x.dtype
+
+            def key_of(raw_bits):
+                if isinstance(raw_bits, tuple):
+                    hi, lo = raw_bits
+                    raw64 = jax.lax.shift_left(
+                        hi.astype(jnp.uint64), jnp.uint64(32)
+                    ) | lo.astype(jnp.uint64)
+                    return _dt.to_sortable_bits(
+                        jax.lax.bitcast_convert_type(raw64, dtype)
+                    )
+                return _dt.to_sortable_bits(
+                    jax.lax.bitcast_convert_type(raw_bits, dtype)
+                )
+
+            self.key_of = key_of
         else:
-            self.u_collect, self.n_collect = self.u, None
+            self.key_op, self.key_xor = "none", 0
+            self.u = _dt.to_sortable_bits(x)
+            self.tiles, self.tiles_n = prepare_keys(hist_method, self.u)
+            self.key_of = None
+            if (
+                self.tiles is not None
+                and len(self.tiles) == 1
+                and self.kdt == jnp.uint32
+            ):
+                # 32-bit: the collect scans the 2-D tiles tensor itself
+                # (the same uint32 buffer the kernels read) so `u` fuses
+                # away and the cutover cond's branches share one full-size
+                # buffer. Sub-32-bit keys keep the native-width `u`: the
+                # tiles are widened uint32, so collecting from them would
+                # shift by the wrong key width and return the wrong dtype.
+                self.u_collect = self.tiles[0]
+                self.n_collect = self.tiles_n
+            else:
+                self.u_collect, self.n_collect = self.u, None
 
         cdt, kdt = self.cdt, self.kdt
 
@@ -233,6 +293,8 @@ class _Descent:
                 chunk=chunk,
                 tiles=self.tiles,
                 orig_n=self.tiles_n,
+                key_op=self.key_op,
+                key_xor=self.key_xor,
             )
             return bucket_walk_step(hist, kk, prefix if p else None, kdt, radix_bits)
 
@@ -287,8 +349,8 @@ def radix_select(
     n = x.shape[0]
     prep = _Descent(x, radix_bits, hist_method, chunk)
     radix_bits, total_bits, npasses = prep.radix_bits, prep.total_bits, prep.npasses
-    cdt, kdt, u, one_pass = prep.cdt, prep.kdt, prep.u, prep.one_pass
-    u_collect, n_collect = prep.u_collect, prep.n_collect
+    cdt, kdt, one_pass = prep.cdt, prep.kdt, prep.one_pass
+    u_collect, n_collect, key_of = prep.u_collect, prep.n_collect, prep.key_of
 
     kk = jnp.clip(jnp.asarray(k, cdt), 1, n)
     early = early_exit_budget is not None and n > early_exit_budget
@@ -314,7 +376,7 @@ def radix_select(
             prefix, kk = args
             cand, _pop = _collect_prefix_matches(
                 u_collect, resolved, prefix, cutover_budget, block=128,
-                n_valid=n_collect,
+                n_valid=n_collect, key_of=key_of,
             )
             return jax.lax.sort(cand)[jnp.clip(kk - 1, 0, cutover_budget - 1)]
 
@@ -349,7 +411,8 @@ def radix_select(
 
     def finish_small(_):
         cand, _pop = _collect_prefix_matches(
-            u_collect, resolved, prefix, early_exit_budget, n_valid=n_collect
+            u_collect, resolved, prefix, early_exit_budget, n_valid=n_collect,
+            key_of=key_of,
         )
         return jax.lax.sort(cand)[jnp.clip(kk - 1, 0, early_exit_budget - 1)]
 
@@ -403,6 +466,8 @@ def radix_select_many(
         chunk=chunk,
         tiles=prep.tiles,
         orig_n=prep.tiles_n,
+        key_op=prep.key_op,
+        key_xor=prep.key_xor,
     )
     def per_k(carry, kk):
         prefix, kk, _ = bucket_walk_step(hist0, kk, None, prep.kdt, radix_bits)

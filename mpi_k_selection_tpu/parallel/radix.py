@@ -40,6 +40,23 @@ from mpi_k_selection_tpu.parallel import mesh as mesh_lib
 from mpi_k_selection_tpu.utils import debug as _debug, dtypes as _dt
 
 
+def _prep_shard(hist_method, xs):
+    """Per-shard kernel-view prep: raw tiles + in-kernel key fold when
+    available (saves the per-shard to_sortable pass — see
+    ops/histogram.py:prepare_raw), key-space tiles otherwise. Returns
+    ``(u, tiles, tiles_n, key_op, key_xor)`` with ``u`` None on the raw
+    path."""
+    from mpi_k_selection_tpu.ops.histogram import prepare_keys, prepare_raw
+
+    raw = prepare_raw(hist_method, xs)
+    if raw is not None:
+        tiles, tiles_n, key_op, key_xor = raw
+        return None, tiles, tiles_n, key_op, key_xor
+    u = _dt.to_sortable_bits(xs)
+    tiles, tiles_n = prepare_keys(hist_method, u)
+    return u, tiles, tiles_n, "none", 0
+
+
 @functools.lru_cache(maxsize=64)
 def _jitted_select(mesh, n, total_bits, cdt, radix_bits, hist_method, chunk):
     """Build-and-cache the jitted sharded program for one (mesh, config).
@@ -51,13 +68,8 @@ def _jitted_select(mesh, n, total_bits, cdt, radix_bits, hist_method, chunk):
     axis = mesh.axis_names[0]
 
     def shard_fn(xs, kk):
-        from mpi_k_selection_tpu.ops.histogram import prepare_keys
-
-        u = _dt.to_sortable_bits(xs.ravel())
-        kdt = u.dtype
-        # pallas path: build the shard's tiled key view once for all passes
-        # (see ops/pallas/histogram.py:prepare_tiles32)
-        tiles, tiles_n = prepare_keys(hist_method, u)
+        u, tiles, tiles_n, key_op, key_xor = _prep_shard(hist_method, xs.ravel())
+        kdt = jnp.dtype(_dt.key_dtype(xs.dtype))
         kk = jnp.clip(kk.astype(cdt), 1, n)
         prefix = None
         for p in range(total_bits // radix_bits):
@@ -72,6 +84,8 @@ def _jitted_select(mesh, n, total_bits, cdt, radix_bits, hist_method, chunk):
                 chunk=chunk,
                 tiles=tiles,
                 orig_n=tiles_n,
+                key_op=key_op,
+                key_xor=key_xor,
             )
             hist = jax.lax.psum(local, axis)  # the MPI_Allreduce analogue (TODO-…:190)
             prefix, kk, _ = bucket_walk_step(hist, kk, prefix, kdt, radix_bits)
@@ -124,11 +138,8 @@ def _jitted_select_many(mesh, n, total_bits, cdt, radix_bits, hist_method, chunk
     npasses = total_bits // radix_bits
 
     def shard_fn(xs, ks):
-        from mpi_k_selection_tpu.ops.histogram import prepare_keys
-
-        u = _dt.to_sortable_bits(xs.ravel())
-        kdt = u.dtype
-        tiles, tiles_n = prepare_keys(hist_method, u)
+        u, tiles, tiles_n, key_op, key_xor = _prep_shard(hist_method, xs.ravel())
+        kdt = jnp.dtype(_dt.key_dtype(xs.dtype))
 
         def local_hist(shift, prefix):
             return masked_radix_histogram(
@@ -141,6 +152,8 @@ def _jitted_select_many(mesh, n, total_bits, cdt, radix_bits, hist_method, chunk
                 chunk=chunk,
                 tiles=tiles,
                 orig_n=tiles_n,
+                key_op=key_op,
+                key_xor=key_xor,
             )
 
         hist0 = jax.lax.psum(local_hist(total_bits - radix_bits, None), axis)

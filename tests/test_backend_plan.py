@@ -132,3 +132,12 @@ def test_kselect_many_warns_on_ignored_radix_kwargs(rng):
     x = rng.integers(0, 100, size=1000, dtype=np.int32)
     with pytest.warns(UserWarning, match="sort path"):
         api.kselect_many(x, [1, 500], radix_bits=8)
+
+
+def test_plan_always_sort_keeps_specific_error_on_single_device():
+    # the distributability error must win over the device-count error
+    with pytest.raises(ValueError, match="no distributed path"):
+        tpu_backend.plan(1 << 22, "sort", "always", n_dev=1)
+    # cgm surfaces the device-count error at plan time
+    with pytest.raises(ValueError, match="needs >= 2 devices"):
+        tpu_backend.plan(1 << 22, "cgm", "always", n_dev=1)

@@ -205,3 +205,129 @@ def test_pallas64_prefix_free_midkey_rejected(rng):
         keys = jnp.asarray(rng.integers(0, 2**64, size=128, dtype=np.uint64))
         with pytest.raises(ValueError, match="prefix=None"):
             pallas_radix_histogram64(keys, shift=16, radix_bits=4)
+
+
+# ---------------------------------------------------------------------------
+# Raw-bits tiles + in-kernel key fold (key_op/key_xor): the production TPU
+# fast path that removes the full-array to_sortable pass. Verified against
+# the key-space kernels AND numpy, including the ragged pad correction
+# (padded raw zeros carry the key to_sortable(0), not key 0).
+# ---------------------------------------------------------------------------
+
+
+def _raw_fold_case(rng, dtype, n):
+    dtype = np.dtype(dtype)
+    if dtype.kind == "f":
+        x = rng.standard_normal(n).astype(dtype)
+        # exercise the sign-dependent branch with exact halves
+        x[: n // 2] = -np.abs(x[: n // 2])
+    elif dtype.kind == "u":
+        x = rng.integers(0, 2 ** (dtype.itemsize * 8) - 1, size=n, dtype=dtype)
+    else:
+        b = dtype.itemsize * 8
+        x = rng.integers(-(2 ** (b - 2)), 2 ** (b - 2), size=n, dtype=dtype)
+    return x
+
+
+@pytest.mark.parametrize("dtype", [np.int32, np.uint32, np.float32])
+@pytest.mark.parametrize(
+    "shift,radix_bits,prefix_from_median", [(28, 4, False), (20, 4, True), (0, 4, True)]
+)
+def test_pallas_raw_fold_matches_keyspace(rng, dtype, shift, radix_bits, prefix_from_median):
+    from mpi_k_selection_tpu.ops.pallas.histogram import (
+        prepare_raw_tiles32,
+        prepare_tiles32,
+    )
+    from mpi_k_selection_tpu.utils import dtypes as _dt
+
+    n = 2 * 256 * 128 + 77  # ragged: pad-correction path
+    x = _raw_fold_case(rng, dtype, n)
+    xd = jnp.asarray(x)
+    u = _dt.to_sortable_bits(xd)
+    un = np.asarray(u).astype(np.uint64)
+    prefix = None
+    if prefix_from_median:
+        # a live prefix (the median element's bits): nonzero counts
+        prefix = jnp.uint32(int(np.sort(un)[n // 2]) >> (shift + radix_bits))
+    kt, kn = prepare_tiles32(u, 256)
+    rt, rn = prepare_raw_tiles32(xd, 256)
+    key_op, *rest = _dt.key_fold(dtype)
+    key_xor = rest[0] if key_op == "xor" else 0
+    h_ref = pallas_radix_histogram(
+        None, shift=shift, radix_bits=radix_bits, prefix=prefix,
+        tiles=kt, orig_n=kn, block_rows=256,
+    )
+    h_raw = pallas_radix_histogram(
+        None, shift=shift, radix_bits=radix_bits, prefix=prefix,
+        tiles=rt, orig_n=rn, block_rows=256, key_op=key_op, key_xor=key_xor,
+    )
+    np.testing.assert_array_equal(np.asarray(h_raw), np.asarray(h_ref))
+    np.testing.assert_array_equal(
+        np.asarray(h_raw),
+        _oracle(un, shift, radix_bits, None if prefix is None else int(prefix)),
+    )
+
+
+@pytest.mark.parametrize("dtype", [np.int64, np.uint64, np.float64])
+@pytest.mark.parametrize("shift,radix_bits", [(60, 4), (36, 4), (28, 4), (0, 4)])
+def test_pallas64_raw_fold_matches_keyspace(rng, dtype, shift, radix_bits):
+    import jax
+
+    from mpi_k_selection_tpu.ops.pallas.histogram import (
+        pallas_radix_histogram64,
+        prepare_raw_tiles64,
+        prepare_tiles64,
+    )
+    from mpi_k_selection_tpu.utils import dtypes as _dt
+
+    with jax.enable_x64(True):
+        n = 2 * 256 * 128 + 77
+        x = _raw_fold_case(rng, dtype, n)
+        xd = jnp.asarray(x)
+        u = _dt.to_sortable_bits(xd)
+        un = np.asarray(u).astype(np.uint64)
+        prefix = None
+        if shift + radix_bits != 64:
+            prefix = jnp.uint64(int(np.sort(un)[n // 2]) >> (shift + radix_bits))
+        hi_k, lo_k, kn = prepare_tiles64(u, 256)
+        hi_r, lo_r, rn = prepare_raw_tiles64(xd, 256)
+        key_op, *rest = _dt.key_fold(dtype)
+        key_xor = rest[0] if key_op == "xor" else 0
+        h_ref = pallas_radix_histogram64(
+            None, shift=shift, radix_bits=radix_bits, prefix=prefix,
+            tiles=(hi_k, lo_k), orig_n=kn, block_rows=256,
+        )
+        h_raw = pallas_radix_histogram64(
+            None, shift=shift, radix_bits=radix_bits, prefix=prefix,
+            tiles=(hi_r, lo_r), orig_n=rn, block_rows=256,
+            key_op=key_op, key_xor=key_xor,
+        )
+        np.testing.assert_array_equal(np.asarray(h_raw), np.asarray(h_ref))
+        np.testing.assert_array_equal(
+            np.asarray(h_raw),
+            _oracle(un, shift, radix_bits, None if prefix is None else int(prefix)),
+        )
+
+
+@pytest.mark.parametrize("dtype", [np.int32, np.float32])
+def test_radix_select_raw_fold_end_to_end(rng, dtype):
+    """Forced-pallas select on a 32-bit foldable dtype: the whole descent
+    (passes + cutover collect via key_of) runs on raw tiles."""
+    n = 40_000
+    x = _raw_fold_case(rng, dtype, n)
+    for k in (1, n // 2, n):
+        got = np.asarray(radix_select(jnp.asarray(x), k, hist_method="pallas"))[()]
+        want = np.sort(x, kind="stable")[k - 1]
+        assert got == want, (dtype, k, got, want)
+
+
+def test_masked_histogram_raw_tiles_reject_non_pallas(rng):
+    x = jnp.asarray(rng.integers(0, 2**31, size=1024, dtype=np.int32))
+    from mpi_k_selection_tpu.ops.pallas.histogram import prepare_raw_tiles32
+
+    tiles, n = prepare_raw_tiles32(x, 256)
+    with pytest.raises(ValueError, match="pallas"):
+        masked_radix_histogram(
+            None, shift=28, radix_bits=4, method="scatter",
+            tiles=(tiles,), orig_n=n, key_op="xor", key_xor=1 << 31,
+        )
