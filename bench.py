@@ -244,6 +244,66 @@ def bench_topk_batched(on_tpu: bool):
     return exact
 
 
+def bench_multirank(on_tpu: bool):
+    """Multi-rank selection: p50/p90/p99 of one large int32 array in one
+    call (the telemetry shape). All K queries ride one shared data sweep
+    per pass (the multi-prefix kernels) plus one batched collect; baseline
+    is the reference approach — one host sort + three indexes
+    (``kth-problem-seq.c:32-33`` amortized across the queries)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mpi_k_selection_tpu.ops.radix import radix_select_many
+    from mpi_k_selection_tpu.utils import datagen
+
+    n = 1 << 27 if on_tpu else 1 << 22
+    qs = (0.5, 0.9, 0.99)
+    ks = np.array([max(1, int(np.ceil(q * n))) for q in qs])
+    x = datagen.generate(n, pattern="uniform", seed=5, dtype=np.int32)
+
+    t0 = time.perf_counter()
+    s = np.sort(x, kind="stable")
+    want = s[ks - 1]
+    baseline_s = time.perf_counter() - t0
+
+    xd = jax.device_put(jnp.asarray(x))
+    got = np.asarray(radix_select_many(xd, jnp.asarray(ks, jnp.int32)))
+    exact = bool(np.array_equal(got, want))
+
+    def chain(reps):
+        @jax.jit
+        def run(xs, k0):
+            def body(_, kks):
+                ans = radix_select_many(xs, kks)
+                return k0 + jnp.abs(ans).astype(jnp.int32) % 7
+
+            return jax.lax.fori_loop(0, reps, body, k0)
+
+        return run
+
+    per = _timed_chain(
+        chain,
+        xd,
+        lambda i: jnp.asarray(ks - i, jnp.int32),
+        (3, 23) if on_tpu else (1, 3),
+    )
+    _emit(
+        {
+            "metric": "multirank_p50_p90_p99",
+            "value": round(len(ks) * n / per, 1) if exact else 0.0,
+            "unit": "query-elems/sec/chip",
+            "vs_baseline": round(baseline_s / per, 3) if exact else 0.0,
+            "n": n,
+            "ks": [int(v) for v in ks],
+            "seconds": round(per, 6),
+            "baseline_seconds": round(baseline_s, 6),
+            "exact_match": exact,
+        }
+    )
+    return exact
+
+
 def bench_cgm_native():
     """BASELINE config: CGM/MPI parity backend, 4 ranks, N=16M, k=N/2.
 
@@ -322,6 +382,7 @@ def main() -> int:
     ok = bench_kselect_headline(on_tpu)
     ok &= bench_topk_single(on_tpu)
     ok &= bench_topk_batched(on_tpu)
+    ok &= bench_multirank(on_tpu)
     ok &= bench_cgm_native()
     ok &= bench_seq_oracle()
     return 0 if ok else 1

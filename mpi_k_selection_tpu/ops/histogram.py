@@ -145,6 +145,96 @@ def prepare_raw(hist_method: str, x: jax.Array):
     return None
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "shift", "radix_bits", "method", "count_dtype", "chunk", "orig_n",
+        "key_op", "key_xor",
+    ),
+)
+def multi_masked_radix_histogram(
+    keys,
+    *,
+    shift: int,
+    radix_bits: int,
+    prefixes,
+    method: str = "auto",
+    count_dtype=jnp.int32,
+    chunk: int = 32768,
+    tiles=None,
+    orig_n: int | None = None,
+    key_op: str = "none",
+    key_xor: int = 0,
+) -> jax.Array:
+    """``(K, 2**radix_bits)`` histograms, one per key-space prefix in
+    ``prefixes`` (shape (K,), traced) — the shared-sweep primitive of
+    multi-rank selection. On the pallas methods all K queries ride ONE
+    read of the data (ops/pallas/histogram.py multi kernels); other
+    methods fall back to K single-prefix histograms (correct, K reads).
+    """
+    kd = keys.dtype if keys is not None else (
+        jnp.uint64 if len(tiles) == 2 else jnp.uint32
+    )
+    method = resolve_hist_method(method, kd)
+    if method in ("pallas", "pallas_compare"):
+        from mpi_k_selection_tpu.ops.pallas.histogram import (
+            pallas_radix_histogram_multi,
+        )
+
+        if tiles is None:
+            from mpi_k_selection_tpu.ops.pallas.histogram import prepare_tiles32
+
+            tiles_, orig_n = prepare_tiles32(keys.ravel())
+            tiles = (tiles_,)
+        return pallas_radix_histogram_multi(
+            shift=shift,
+            radix_bits=radix_bits,
+            prefixes=prefixes,
+            count_dtype=count_dtype,
+            tiles=tiles[0],
+            orig_n=orig_n,
+            key_op=key_op,
+            key_xor=key_xor,
+        )
+    if method in ("pallas64", "pallas64_compare"):
+        from mpi_k_selection_tpu.ops.pallas.histogram import (
+            pallas_radix_histogram64_multi,
+        )
+
+        if tiles is None:
+            from mpi_k_selection_tpu.ops.pallas.histogram import prepare_tiles64
+
+            hi2, lo2, orig_n = prepare_tiles64(keys.ravel())
+            tiles = (hi2, lo2)
+        return pallas_radix_histogram64_multi(
+            shift=shift,
+            radix_bits=radix_bits,
+            prefixes=prefixes,
+            count_dtype=count_dtype,
+            tiles=(tiles[0], tiles[1]),
+            orig_n=orig_n,
+            key_op=key_op,
+            key_xor=key_xor,
+        )
+    if key_op != "none":
+        raise ValueError("key_op/raw tiles require a pallas histogram method")
+    # fallback: one masked histogram per query (K unrolled calls)
+    nq = int(prefixes.shape[0])
+    hists = [
+        masked_radix_histogram(
+            keys,
+            shift=shift,
+            radix_bits=radix_bits,
+            prefix=prefixes[q],
+            method=method,
+            count_dtype=count_dtype,
+            chunk=chunk,
+        )
+        for q in range(nq)
+    ]
+    return jnp.stack(hists)
+
+
 def resolve_hist_method(method: str, key_dtype=None) -> str:
     if method != "auto":
         return method

@@ -110,17 +110,26 @@ def prepare_tiles64(keys: jax.Array, block_rows: int = 4096):
 
 
 def prepare_raw_tiles32(x: jax.Array, block_rows: int = 4096):
-    """``(tiles, n)`` of RAW bit patterns of a 4-byte-dtype array — no
-    key transform pass. The sortable-key transform happens inside the
-    kernel instead (``key_op``/``key_xor``, see utils/dtypes.py:key_fold):
-    for integer dtypes it folds into the kernel's xor constant at zero
-    cost, so when n is block-aligned this prepare is a free bitcast+reshape
-    and the select never touches the data outside the histogram kernels."""
+    """``(tiles, n)`` raw tiles of a 4-byte-dtype array IN ITS OWN DTYPE —
+    no key transform pass, no bitcast. The sortable-key transform happens
+    inside the kernel (``key_op``/``key_xor``, utils/dtypes.py:key_fold),
+    which bitcasts each block in VMEM anyway.
+
+    Keeping the original dtype is load-bearing: a dtype-changing bitcast
+    feeding a Pallas custom call makes XLA materialize a full copy of the
+    array (measured 1.63 ms for 537 MB on v5e), while a pure row-major
+    reshape aliases the input buffer — so on block-aligned n this prepare
+    is FREE and a single-shot select touches the data only inside the
+    kernels. Padding (ragged n) writes raw-zero elements; the wrappers'
+    pad correction accounts for their key being to_sortable(0)."""
     x = x.ravel()
     if np.dtype(x.dtype).itemsize != 4:
         raise ValueError(f"prepare_raw_tiles32 wants a 4-byte dtype, got {x.dtype}")
-    raw = jax.lax.bitcast_convert_type(x, jnp.uint32)
-    return prepare_tiles32(raw, block_rows)
+    n = x.shape[0]
+    grid = -(-n // (block_rows * LANES))
+    pad_to = grid * block_rows * LANES
+    xp = jnp.pad(x, (0, pad_to - n)) if pad_to != n else x
+    return xp.reshape(grid * block_rows, LANES), n
 
 
 def prepare_raw_tiles64(x: jax.Array, block_rows: int = 4096):
@@ -147,7 +156,7 @@ def _cap_block_rows(block_rows: int, radix_bits: int) -> int:
     return min(block_rows, 4096 if radix_bits <= 4 else 1024)
 
 
-def _packed_count(z, out_ref, radix_bits, group=8):
+def _packed_count(z, out_ref, radix_bits, group=8, row0=0):
     """SWAR accumulation shared by the 32- and 64-bit packed kernels.
 
     Per element, one one-hot *bitfield* ``f = 1 << ((z & 7) * 4)`` selects a
@@ -188,7 +197,7 @@ def _packed_count(z, out_ref, radix_bits, group=8):
             w = wide_lo[r] if j % 2 == 0 else wide_hi[r]
             cnt = jax.lax.shift_right_logical(w, jnp.int32(8 * (j // 2))) & byte
             rows_out.append(jnp.sum(cnt, axis=0, dtype=jnp.int32))
-        out_ref[:] += jnp.stack(rows_out)
+        out_ref[row0:row0 + nb] += jnp.stack(rows_out)
         for r in range(nreg):
             wide_lo[r] = zero
             wide_hi[r] = zero
@@ -399,8 +408,14 @@ def pallas_radix_histogram(
         if orig_n is None:
             raise ValueError("tiles needs orig_n (the unpadded key count)")
         k2d, n = tiles, orig_n
-        if k2d.dtype != jnp.uint32:
-            raise ValueError(f"tiles must be uint32, got {k2d.dtype}")
+        if key_op == "none":
+            if k2d.dtype != jnp.uint32:
+                raise ValueError(f"key-space tiles must be uint32, got {k2d.dtype}")
+        elif np.dtype(k2d.dtype).itemsize != 4:
+            # raw tiles keep the input's own 4-byte dtype (a dtype-changing
+            # bitcast before the custom call costs a full copy; the kernel
+            # bitcasts per block in VMEM for free)
+            raise ValueError(f"raw tiles must be a 4-byte dtype, got {k2d.dtype}")
         if k2d.shape[0] % block_rows or k2d.shape[1] != LANES:
             raise ValueError(
                 f"tiles shape {k2d.shape} does not match block_rows={block_rows}"
@@ -638,3 +653,401 @@ def pallas_radix_histogram64(
         correction = jnp.where(pref == cmp0, count_dtype(pad), count_dtype(0))
         hist = hist.at[b0].add(-correction)
     return hist
+
+
+# ---------------------------------------------------------------------------
+# Multi-prefix histograms: one data sweep serves K selection queries.
+#
+# Multi-rank selection (kselect_many / quantiles) walks K different prefixes
+# through the same array. Calling the single-prefix kernel per query reads
+# the data K times per pass; here the block is loaded once and the digit
+# base ``s = raw >> shift`` is computed once, then each query pays only its
+# xor + SWAR accumulation (~9 VPU ops/element/query) into its own slice of
+# a (K * nbuckets, 128) accumulator. The walk reads the data
+# ``npasses`` times total instead of ``1 + K * (npasses - 1)`` — the
+# reference anchor is the CGM round sharing one data sweep across all
+# protocol steps (TODO-kth-problem-cgm.c:170-190).
+# ---------------------------------------------------------------------------
+
+
+def _hist_kernel_multi_packed(
+    zrefs_ref, keys_ref, out_ref, *, shift, radix_bits, key_op, nq
+):
+    """K-query SWAR histogram over one 32-bit block: shared shift (and
+    float transform), per-query fused xor reference in SMEM."""
+    i = pl.program_id(0)
+    nb = 1 << radix_bits
+    k = jax.lax.bitcast_convert_type(keys_ref[:], jnp.int32)
+    s = jax.lax.shift_right_logical(k, jnp.int32(shift))
+    if key_op == "float":
+        m_neg = jnp.int32(_i32const(0xFFFFFFFF >> shift))
+        m_pos = jnp.int32(_i32const(0x80000000 >> shift))
+        s = s ^ jnp.where(k < jnp.int32(0), m_neg, m_pos)
+
+    @pl.when(i == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    for q in range(nq):
+        _packed_count(s ^ zrefs_ref[q, 0], out_ref, radix_bits, row0=q * nb)
+
+
+def _hist_kernel64_multi_packed(
+    phis_ref, zlos_ref, hi_ref, lo_ref, out_ref, *, shift, radix_bits, key_op, nq
+):
+    """K-query variant of the two-plane 64-bit low-bit kernel."""
+    i = pl.program_id(0)
+    nb = 1 << radix_bits
+    hi = jax.lax.bitcast_convert_type(hi_ref[:], jnp.int32)
+    lo = jax.lax.bitcast_convert_type(lo_ref[:], jnp.int32)
+    base = jax.lax.shift_right_logical(lo, jnp.int32(shift))
+    if key_op == "float":
+        neg = hi < jnp.int32(0)
+        hk = hi ^ jnp.where(neg, jnp.int32(-1), jnp.int32(_i32const(1 << 31)))
+        base = base ^ jnp.where(
+            neg, jnp.int32(_i32const(0xFFFFFFFF >> shift)), jnp.int32(0)
+        )
+    else:
+        hk = hi
+
+    @pl.when(i == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    out = jnp.int32(1 << (radix_bits + 1))
+    for q in range(nq):
+        z = jnp.where(hk == phis_ref[q, 0], base ^ zlos_ref[q, 0], out)
+        _packed_count(z, out_ref, radix_bits, row0=q * nb)
+
+
+def _multi_block_rows(block_rows: int, nq: int) -> int:
+    """Block cap for the multi kernel: each query keeps 6 block-height
+    register arrays live, so larger K needs shorter blocks to stay inside
+    scoped VMEM (same discipline as _cap_block_rows for radix_bits > 4)."""
+    return min(block_rows, 4096 if nq <= 2 else 1024)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "shift", "radix_bits", "block_rows", "interpret", "count_dtype",
+        "orig_n", "key_op", "key_xor",
+    ),
+)
+def pallas_radix_histogram_multi(
+    *,
+    shift: int,
+    radix_bits: int,
+    prefixes: jax.Array,
+    count_dtype=jnp.int32,
+    block_rows: int = 4096,
+    interpret: bool | None = None,
+    tiles: jax.Array = None,
+    orig_n: int = None,
+    key_op: str = "none",
+    key_xor: int = 0,
+) -> jax.Array:
+    """``(K, 2**radix_bits)`` counts: for each key-space prefix in
+    ``prefixes`` (shape (K,), traced), the digit histogram over elements
+    whose top bits match that prefix. One data read for all K queries.
+
+    32-bit keys only (``tiles`` from prepare_tiles32 / prepare_raw_tiles32);
+    64-bit callers go through :func:`pallas_radix_histogram64_multi`.
+    """
+    if pltpu is None:
+        raise NotImplementedError(
+            "the pallas histogram kernel is not available in this jax build"
+        )
+    if key_op not in ("none", "xor", "float"):
+        raise ValueError(f"unknown key_op {key_op!r}")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    nb = 1 << radix_bits
+    nq = int(prefixes.shape[0])
+    block_rows = _multi_block_rows(_cap_block_rows(block_rows, radix_bits), nq)
+    if orig_n is None:
+        raise ValueError("tiles needs orig_n")
+    k2d, n = tiles, orig_n
+    if key_op == "none":
+        if k2d.dtype != jnp.uint32:
+            raise ValueError(f"key-space tiles must be uint32, got {k2d.dtype}")
+    elif np.dtype(k2d.dtype).itemsize != 4:
+        raise ValueError(f"raw tiles must be a 4-byte dtype, got {k2d.dtype}")
+    if k2d.shape[0] % block_rows or k2d.shape[1] != LANES:
+        raise ValueError(
+            f"tiles shape {k2d.shape} does not match block_rows={block_rows}"
+        )
+    grid = k2d.shape[0] // block_rows
+    pad_to = grid * block_rows * LANES
+
+    prefs = prefixes.astype(jnp.uint32)
+    zbits = jax.lax.shift_left(prefs, jnp.uint32(radix_bits))
+    if key_op == "xor":
+        zbits = zbits ^ jnp.uint32((key_xor & 0xFFFFFFFF) >> shift)
+    zrefs = jax.lax.bitcast_convert_type(zbits, jnp.int32).reshape(nq, 1)
+
+    kernel = functools.partial(
+        _hist_kernel_multi_packed,
+        shift=shift, radix_bits=radix_bits, key_op=key_op, nq=nq,
+    )
+    with jax.enable_x64(False):
+        lane_hist = pl.pallas_call(
+            kernel,
+            grid=(grid,),
+            in_specs=[
+                pl.BlockSpec((nq, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+                pl.BlockSpec(
+                    (block_rows, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM
+                ),
+            ],
+            out_specs=pl.BlockSpec(
+                (nq * nb, LANES), lambda i: (0, 0), memory_space=pltpu.VMEM
+            ),
+            out_shape=jax.ShapeDtypeStruct((nq * nb, LANES), jnp.int32),
+            interpret=interpret,
+        )(zrefs, k2d)
+    hist = jnp.sum(
+        lane_hist.reshape(nq, nb, LANES), axis=2, dtype=count_dtype
+    )
+
+    pad = pad_to - n
+    if pad:
+        k0 = {"none": 0, "xor": key_xor & 0xFFFFFFFF, "float": 1 << 31}[key_op]
+        b0 = (k0 >> shift) & (nb - 1)
+        cmp0 = jnp.uint32(k0 >> (shift + radix_bits))
+        corr = jnp.where(prefs == cmp0, count_dtype(pad), count_dtype(0))
+        hist = hist.at[:, b0].add(-corr)
+    return hist
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "shift", "radix_bits", "block_rows", "interpret", "count_dtype",
+        "orig_n", "key_op", "key_xor",
+    ),
+)
+def pallas_radix_histogram64_multi(
+    *,
+    shift: int,
+    radix_bits: int,
+    prefixes: jax.Array,
+    count_dtype=jnp.int32,
+    block_rows: int = 4096,
+    interpret: bool | None = None,
+    tiles: tuple[jax.Array, jax.Array] = None,
+    orig_n: int = None,
+    key_op: str = "none",
+    key_xor: int = 0,
+) -> jax.Array:
+    """64-bit-key variant of :func:`pallas_radix_histogram_multi`:
+    ``prefixes`` is (K,) uint64 in key space."""
+    if pltpu is None:
+        raise NotImplementedError(
+            "the pallas histogram kernel is not available in this jax build"
+        )
+    if key_op not in ("none", "xor", "float"):
+        raise ValueError(f"unknown key_op {key_op!r}")
+    nb = 1 << radix_bits
+    nq = int(prefixes.shape[0])
+    if orig_n is None:
+        raise ValueError("tiles needs orig_n")
+    hi2, lo2 = tiles
+    if shift >= 32:
+        # digit + whole prefix in the hi plane: 32-bit multi kernel
+        return pallas_radix_histogram_multi(
+            shift=shift - 32,
+            radix_bits=radix_bits,
+            prefixes=prefixes.astype(jnp.uint32),
+            count_dtype=count_dtype,
+            block_rows=block_rows,
+            interpret=interpret,
+            tiles=hi2,
+            orig_n=orig_n,
+            key_op=key_op,
+            key_xor=(key_xor >> 32) & 0xFFFFFFFF,
+        )
+    if shift + radix_bits > 32:
+        raise ValueError(
+            f"digit at shift={shift} straddles the 32-bit plane boundary"
+        )
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    block_rows = _multi_block_rows(_cap_block_rows(block_rows, radix_bits), nq)
+    if hi2.shape[0] % block_rows or hi2.shape[1] != LANES:
+        raise ValueError(
+            f"tiles shape {hi2.shape} does not match block_rows={block_rows}"
+        )
+    grid = hi2.shape[0] // block_rows
+    pad_to = grid * block_rows * LANES
+    n = orig_n
+
+    prefs = prefixes.astype(jnp.uint64)
+    lo_prefix_bits = 32 - shift - radix_bits
+    phis = jax.lax.shift_right_logical(
+        prefs, jnp.uint64(lo_prefix_bits)
+    ).astype(jnp.uint32)
+    plos = (prefs & jnp.uint64((1 << lo_prefix_bits) - 1)).astype(jnp.uint32)
+    zlos = jax.lax.shift_left(plos, jnp.uint32(radix_bits))
+    if key_op == "xor":
+        phis = phis ^ jnp.uint32((key_xor >> 32) & 0xFFFFFFFF)
+        zlos = zlos ^ jnp.uint32((key_xor & 0xFFFFFFFF) >> shift)
+    phis = jax.lax.bitcast_convert_type(phis, jnp.int32).reshape(nq, 1)
+    zlos = jax.lax.bitcast_convert_type(zlos, jnp.int32).reshape(nq, 1)
+
+    kernel = functools.partial(
+        _hist_kernel64_multi_packed,
+        shift=shift, radix_bits=radix_bits, key_op=key_op, nq=nq,
+    )
+    with jax.enable_x64(False):
+        lane_hist = pl.pallas_call(
+            kernel,
+            grid=(grid,),
+            in_specs=[
+                pl.BlockSpec((nq, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+                pl.BlockSpec((nq, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+                pl.BlockSpec(
+                    (block_rows, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM
+                ),
+                pl.BlockSpec(
+                    (block_rows, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM
+                ),
+            ],
+            out_specs=pl.BlockSpec(
+                (nq * nb, LANES), lambda i: (0, 0), memory_space=pltpu.VMEM
+            ),
+            out_shape=jax.ShapeDtypeStruct((nq * nb, LANES), jnp.int32),
+            interpret=interpret,
+        )(phis, zlos, hi2, lo2)
+    hist = jnp.sum(
+        lane_hist.reshape(nq, nb, LANES), axis=2, dtype=count_dtype
+    )
+
+    pad = pad_to - n
+    if pad:
+        k0 = {"none": 0, "xor": key_xor & ~(-1 << 64), "float": 1 << 63}[key_op]
+        b0 = (k0 >> shift) & (nb - 1)
+        cmp0 = jnp.uint64(k0 >> (shift + radix_bits))
+        corr = jnp.where(prefs == cmp0, count_dtype(pad), count_dtype(0))
+        hist = hist.at[:, b0].add(-corr)
+    return hist
+
+
+# ---------------------------------------------------------------------------
+# Multi-prefix match counts: the collect phase's streaming counter.
+#
+# The cutover collect needs, for each query prefix, how many matching
+# elements live in each small "subblock" of the array, so slot j of the
+# candidate buffer can be routed to its subblock with a rank search. In
+# XLA the K-prefix count over (rows, 128) tiles refuses to fuse (measured
+# ~20 ms for K=9 at 2^27 vs the 0.7 ms read floor); this kernel does it in
+# one streaming read for all K queries.
+#
+# Subblock = one tile ROW (128 contiguous elements): the collect's slot
+# gather then fetches whole rows — the one gather shape XLA lowers well.
+# The kernel's per-query lane-axis reduction produces (rows,) counts,
+# re-laid out as a (rows/128, 128) tile in the query's slice of the
+# (nq * rows/128, 128) output block; subblock index == global row index.
+# Candidate order within a subblock is lane order (the gather uses same).
+# ---------------------------------------------------------------------------
+
+
+def _match_count_kernel(crefs_ref, keys_ref, out_ref, *, mshift, key_op, nq, n):
+    i = pl.program_id(0)
+    rows = keys_ref.shape[0]
+    groups = rows // 128
+    k = jax.lax.bitcast_convert_type(keys_ref[:], jnp.int32)
+    s = jax.lax.shift_right_logical(k, jnp.int32(mshift))
+    if key_op == "float":
+        m_neg = jnp.int32(_i32const(0xFFFFFFFF >> mshift))
+        m_pos = jnp.int32(_i32const(0x80000000 >> mshift))
+        s = s ^ jnp.where(k < jnp.int32(0), m_neg, m_pos)
+    # pad positions (global element index >= n) are masked out of the
+    # compare directly — a sentinel value would collide with a legitimate
+    # reference at mshift == 0, where the full 32-bit word is compared
+    base = i * rows
+    gpos = (
+        (base + jax.lax.broadcasted_iota(jnp.int32, (rows, LANES), 0))
+        * LANES
+        + jax.lax.broadcasted_iota(jnp.int32, (rows, LANES), 1)
+    )
+    valid = gpos < jnp.int32(n)
+    for q in range(nq):
+        m = jnp.logical_and(s == crefs_ref[q, 0], valid).astype(jnp.int32)
+        # per-row counts: reduce lanes, then re-lay the (rows,) vector as
+        # a (groups, 128) tile (row r -> out[r // 128, r % 128])
+        mg = jnp.sum(m.reshape(groups, 128, LANES), axis=2)
+        out_ref[q * groups:(q + 1) * groups, :] = mg
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("resolved_bits", "block_rows", "interpret", "orig_n",
+                     "key_op", "key_xor", "count_dtype"),
+)
+def pallas_match_counts(
+    *,
+    resolved_bits: int,
+    prefixes: jax.Array,
+    tiles: jax.Array,
+    orig_n: int,
+    key_op: str = "none",
+    key_xor: int = 0,
+    count_dtype=jnp.int32,
+    block_rows: int = 4096,
+    interpret: bool | None = None,
+):
+    """``(K, R)`` match counts per tile ROW (R = tile rows):
+    ``counts[q, r]`` = number of elements in row r whose key's top
+    ``resolved_bits`` bits equal ``prefixes[q]``. 32-bit tiles only (for
+    64-bit keys pass the HI plane — valid while resolved_bits <= 32).
+
+    Row r covers elements ``[r * 128, r * 128 + 128)`` in lane order. Pad
+    positions past ``orig_n`` are excluded in kernel (no analytic
+    correction needed).
+    """
+    if pltpu is None:
+        raise NotImplementedError(
+            "the pallas histogram kernel is not available in this jax build"
+        )
+    if key_op not in ("none", "xor", "float"):
+        raise ValueError(f"unknown key_op {key_op!r}")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    nq = int(prefixes.shape[0])
+    R = tiles.shape[0]
+    if R % block_rows or tiles.shape[1] != LANES:
+        raise ValueError(f"tiles shape {tiles.shape} vs block_rows={block_rows}")
+    if np.dtype(tiles.dtype).itemsize != 4:
+        raise ValueError(f"tiles must be a 4-byte dtype, got {tiles.dtype}")
+    grid = R // block_rows
+    groups = block_rows // 128
+    mshift = 32 - resolved_bits
+    crefs = prefixes.astype(jnp.uint32)
+    if key_op == "xor":
+        # match: (raw >> mshift) == prefix ^ (C >> mshift)
+        crefs = crefs ^ jnp.uint32((key_xor & 0xFFFFFFFF) >> mshift)
+    crefs = jax.lax.bitcast_convert_type(crefs, jnp.int32).reshape(nq, 1)
+
+    kernel = functools.partial(
+        _match_count_kernel, mshift=mshift, key_op=key_op, nq=nq, n=orig_n
+    )
+    with jax.enable_x64(False):
+        out = pl.pallas_call(
+            kernel,
+            grid=(grid,),
+            in_specs=[
+                pl.BlockSpec((nq, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+                pl.BlockSpec(
+                    (block_rows, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM
+                ),
+            ],
+            out_specs=pl.BlockSpec(
+                (nq * groups, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM
+            ),
+            out_shape=jax.ShapeDtypeStruct((grid * nq * groups, LANES), jnp.int32),
+            interpret=interpret,
+        )(crefs, tiles)
+    # (grid, nq, groups, 128) -> (nq, grid*groups*128) == (nq, R)
+    cnt = out.reshape(grid, nq, groups, LANES).transpose(1, 0, 2, 3).reshape(nq, -1)
+    return cnt.astype(count_dtype)

@@ -33,7 +33,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from mpi_k_selection_tpu.ops.histogram import masked_radix_histogram
+from mpi_k_selection_tpu.ops.histogram import (
+    masked_radix_histogram,
+    multi_masked_radix_histogram,
+)
 from mpi_k_selection_tpu.utils import dtypes as _dt
 
 
@@ -60,31 +63,32 @@ def select_count_dtype(n: int):
 
 
 def cutover_passes(n: int, total_bits: int, radix_bits: int, budget: int) -> int | None:
-    """Number of full histogram passes to run before the collect-and-sort
-    cutover, or None when the fixed schedule is better.
+    """Number of full histogram passes to run before the first
+    collect-and-sort cutover attempt, or None when the fixed schedule is
+    better.
 
-    Chosen so the *expected* surviving population (``n >> resolved_bits`` for
-    uniform keys) is <= budget/4 — a 4x safety margin for mild skew. Skewed
-    or duplicate-heavy data that still overflows the budget takes the
-    fallback branch (the remaining fixed passes), so the worst case costs
-    the fixed schedule plus one cond, never more. This is the reference
-    CGM's ``< n/(c*p)`` sequential-finish cutover (``TODO-kth-problem-cgm.c:
-    122, 236-280``) rebuilt without data movement until the final collect.
+    Chosen so the *expected* surviving population (``n >> resolved_bits``
+    for full-range uniform keys) is <= budget/16 — a 16x margin because
+    real data rarely spans the full key range (the reference generator's
+    values sit in [1, 1e8], 43x denser than full-range int32 —
+    ``TODO-kth-problem-cgm.c:15``), which inflates survivors by
+    range_fraction^-1 over the model. Data denser still falls to the next
+    rung of the runtime ladder (see radix_select: one more pass, then a
+    second collect attempt), and only after both rungs overflow does the
+    remainder of the fixed schedule run — so the worst case costs the
+    fixed schedule plus two conds, never more. This is the reference CGM's
+    ``< n/(c*p)`` sequential-finish cutover (``TODO-kth-problem-cgm.c:122,
+    236-280``) rebuilt without data movement until the final collect.
 
     The cutover only pays when the skipped passes outweigh the collect
-    (one extra count scan + a rank-slot gather + a small sort). Measured on
-    v5e with the block_rows=4096 packed kernel and budget=4096: collect ~=
-    1 pass + ~0.5 ms, passes ~5.5 ps/element, so the break-even is
-    ``(skipped_passes - 1) * n > ~1e8`` — at the 134M int32 headline config
-    the ncut=5 cutover wins 7.5 -> 6.9 ms, and 1B-class / 64-bit configs
-    win more (large-budget collects lose: 16384-slot gathers cost more than
-    the passes they save; see BENCH history).
+    (one extra count scan + a rank-slot gather + a small sort); below
+    that, None.
     """
     if n < (1 << 20):  # small inputs: pass cost is trivial, skip the cond
         return None
     npasses = total_bits // radix_bits
     r = radix_bits
-    while r < total_bits and (n >> r) > (budget >> 2):
+    while r < total_bits and (n >> r) > max(budget >> 4, 64):
         r += radix_bits
     ncut = r // radix_bits
     if ncut >= npasses:
@@ -92,6 +96,46 @@ def cutover_passes(n: int, total_bits: int, radix_bits: int, budget: int) -> int
     if (npasses - ncut - 1) * n <= 100_000_000:  # collect ~ 1 pass + 0.5ms
         return None
     return ncut
+
+
+def _rank_block_search(off, target):
+    """First index b with ``off[b] >= target`` for each target — the
+    slot->block mapping of the collect. Semantically
+    ``jnp.searchsorted(off, target, side='left')`` clipped to the table,
+    but computed as a two-level compare-and-sum: ``jnp.searchsorted`` on
+    TPU lowers to a ~20-step while loop whose per-step gathers dominated
+    the whole select (measured 32 ms of a 64 ms multi-select at a 1M-entry
+    table). Here level A counts superblock sums (one dense compare sweep),
+    level B gathers one superblock row per target — no loop, no scatter.
+
+    ``off`` is (m,) nondecreasing with ``target`` (T,), or batched:
+    (K, m) tables with ``target`` (K, T). Returns indices in [0, m-1] of
+    ``target``'s shape.
+
+    Recursive with small (128-entry) leaves: each level gathers one
+    128-entry row per target and counts with a dense compare — a sqrt(m)
+    leaf at m=1M made the level-B gather (T, 1024) the single biggest op
+    of the whole select (600 MB of random gather traffic at T=147K).
+    """
+    S = 128
+    m = off.shape[-1]
+    if m <= S:
+        b = jnp.sum(off[..., None, :] < target[..., :, None], axis=-1)
+        return jnp.minimum(b, m - 1)
+    nsuper = -(-m // S)
+    pad = nsuper * S - m
+    if pad:
+        widths = [(0, 0)] * (off.ndim - 1) + [(0, pad)]
+        off = jnp.pad(off, widths, mode="edge")
+    sup = off.reshape(*off.shape[:-1], nsuper, S)
+    sup_last = sup[..., -1]  # (..., nsuper)
+    sb = _rank_block_search(sup_last, target)  # superblock containing target
+    if off.ndim == 1:
+        rows = sup[sb]  # (T, S)
+    else:
+        rows = jnp.take_along_axis(sup, sb[..., None], axis=-2)  # (K, T, S)
+    b = sb * S + jnp.sum(rows < target[..., None], axis=-1)
+    return jnp.minimum(b, m - 1)
 
 
 def _collect_prefix_matches(
@@ -169,7 +213,7 @@ def _collect_prefix_matches(
     pop = off[-1]
     jj = jnp.arange(budget, dtype=cdt)
     target = jj + 1
-    b = jnp.clip(jnp.searchsorted(off, target), 0, nb_ - 1).astype(cdt)
+    b = _rank_block_search(off, target).astype(cdt)
     prev = jnp.where(b > 0, off[jnp.maximum(b - 1, 0)], jnp.zeros_like(target))
     r = target - prev  # 1-based rank within block b
     if planes:
@@ -200,6 +244,26 @@ def bucket_walk_step(hist, kk, prefix, kdt, radix_bits):
     if prefix is not None:
         bkey = jax.lax.shift_left(prefix, kdt.type(radix_bits)) | bkey
     return bkey, kk, hist[bucket]
+
+
+def bucket_walk_step_multi(hist2d, kk, prefixes, kdt, radix_bits):
+    """Vectorized :func:`bucket_walk_step` for K queries at once:
+    ``hist2d`` is (K, nbuckets) — each query's masked histogram from one
+    shared data sweep — and ``kk``/``prefixes`` are (K,). ``prefixes=None``
+    on the shared prefix-free first step (``hist2d`` may then be (nbuckets,)
+    — one global histogram serves every query's first walk).
+    Returns (prefixes, kk, bucket_counts), each (K,)."""
+    if hist2d.ndim == 1:
+        hist2d = jnp.broadcast_to(hist2d, (kk.shape[0],) + hist2d.shape)
+    cum = jnp.cumsum(hist2d, axis=1)
+    hit = cum >= kk[:, None]
+    bucket = jnp.argmax(hit, axis=1)
+    take = lambda a: jnp.take_along_axis(a, bucket[:, None], axis=1)[:, 0]
+    kk = kk - (take(cum) - take(hist2d))
+    bkey = bucket.astype(kdt)
+    if prefixes is not None:
+        bkey = jax.lax.shift_left(prefixes, kdt.type(radix_bits)) | bkey
+    return bkey, kk, take(hist2d)
 
 
 class _Descent:
@@ -253,9 +317,8 @@ class _Descent:
                     return _dt.to_sortable_bits(
                         jax.lax.bitcast_convert_type(raw64, dtype)
                     )
-                return _dt.to_sortable_bits(
-                    jax.lax.bitcast_convert_type(raw_bits, dtype)
-                )
+                # 32-bit raw tiles keep x's own dtype — transform directly
+                return _dt.to_sortable_bits(raw_bits)
 
             self.key_of = key_of
         else:
@@ -279,6 +342,24 @@ class _Descent:
             else:
                 self.u_collect, self.n_collect = self.u, None
 
+        # count-kernel collect (pallas): per-subblock match counts in one
+        # streaming read for all queries — XLA's jnp formulation of the
+        # same count refuses to fuse (measured ~20 ms for K=9 at 2^27 vs
+        # this kernel's ~1 ms). The 64-bit prefix lives entirely in the hi
+        # plane while resolved_bits <= 32, so the 32-bit kernel serves it.
+        self.count_tiles = None
+        self.count_key = ("none", 0)
+        if self.tiles is not None:
+            if len(self.tiles) == 2:
+                self.count_tiles = self.tiles[0]  # hi plane
+                if self.key_op == "xor":
+                    self.count_key = ("xor", self.key_xor >> 32)
+                elif self.key_op == "float":
+                    self.count_key = ("float", 0)
+            elif self.kdt == jnp.uint32 or self.key_op != "none":
+                self.count_tiles = self.tiles[0]
+                self.count_key = (self.key_op, self.key_xor)
+
         cdt, kdt = self.cdt, self.kdt
 
         def one_pass(p, prefix, kk):
@@ -301,6 +382,61 @@ class _Descent:
         self.one_pass = one_pass
 
 
+def _collect_via_counts(prep, resolved_passes: int, prefixes, budget: int):
+    """Collect up to ``budget`` candidates per query via the pallas
+    match-count kernel: one streaming read counts every query's matches per
+    128-element subblock, then each candidate slot gathers just its
+    subblock. ``prefixes`` is (K,) in key space; ``resolved_passes`` is
+    static. Returns ``(values (K, budget) in key space, pops (K,))``."""
+    res = resolved_passes * prep.radix_bits
+    planes = prep.tiles is not None and len(prep.tiles) == 2
+    from mpi_k_selection_tpu.ops.pallas.histogram import pallas_match_counts
+
+    key_op, key_xor = prep.count_key
+    # for 64-bit keys the resolved prefix lives entirely in the hi plane
+    # (res <= 32 guarded by the caller), so the 32-bit kernel serves both
+    pref32 = prefixes.astype(jnp.uint32)
+    cnt = pallas_match_counts(
+        resolved_bits=res,
+        prefixes=pref32,
+        tiles=prep.count_tiles,
+        orig_n=prep.tiles_n,
+        key_op=key_op,
+        key_xor=key_xor,
+        count_dtype=prep.cdt,
+    )  # (K, R)
+    cdt = prep.cdt
+    nq = prefixes.shape[0]
+    off = jnp.cumsum(cnt, axis=1)
+    pops = off[:, -1]
+    jj = jnp.arange(budget, dtype=cdt)
+    target = jj + 1
+    b = _rank_block_search(off, jnp.broadcast_to(target, (nq, budget))).astype(cdt)
+    prev = jnp.where(
+        b > 0,
+        jnp.take_along_axis(off, jnp.maximum(b - 1, 0), axis=1),
+        jnp.zeros((), cdt),
+    )
+    r = target[None, :] - prev  # 1-based rank within subblock, (K, budget)
+    # subblock index == tile row index: gather whole rows (the one gather
+    # shape XLA lowers efficiently; per-element coordinates were ~60x worse)
+    if planes:
+        gathered = (prep.tiles[0][b], prep.tiles[1][b])
+    else:
+        gathered = prep.tiles[0][b]  # (K, budget, 128)
+    keys = prep.key_of(gathered) if prep.key_of is not None else gathered
+    kdt = keys.dtype
+    mshift = kdt.type(np.dtype(kdt).itemsize * 8 - res)
+    rmatch = jax.lax.shift_right_logical(keys, mshift) == prefixes.astype(kdt)[:, None, None]
+    pos = (b[..., None] * 128 + jnp.arange(128, dtype=cdt)).astype(cdt)
+    rmatch = jnp.logical_and(rmatch, pos < prep.tiles_n)
+    within = jnp.cumsum(rmatch.astype(cdt), axis=2)
+    local = jnp.argmax(jnp.logical_and(within == r[..., None], rmatch), axis=2)
+    vals = jnp.take_along_axis(keys, local[..., None], axis=2)[..., 0]
+    maxkey = np.array(~np.uint64(0)).astype(np.dtype(kdt))
+    return jnp.where(jj[None, :] < pops[:, None], vals, maxkey), pops
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(
@@ -321,7 +457,7 @@ def radix_select(
     chunk: int = 32768,
     early_exit_budget: int | None = None,
     cutover: int | str | None = "auto",
-    cutover_budget: int = 4096,
+    cutover_budget: int = 8192,
 ) -> jax.Array:
     """Exact k-th smallest element of ``x`` (k is 1-indexed, reference semantics).
 
@@ -370,23 +506,69 @@ def radix_select(
         pop = jnp.asarray(n, cdt)
         for p in range(ncut):
             prefix, kk, pop = one_pass(p, prefix, kk)
-        resolved = jnp.asarray(ncut * radix_bits, jnp.int32)
 
-        def finish_small(args):
+        use_counts = (
+            prep.count_tiles is not None and (ncut + 1) * radix_bits <= 32
+        )
+
+        def finish_small(resolved_passes):
+            if use_counts:
+                def fn(args):
+                    prefix, kk = args
+                    cand, _pops = _collect_via_counts(
+                        prep, resolved_passes, prefix[None], cutover_budget
+                    )
+                    return jax.lax.sort(cand[0])[
+                        jnp.clip(kk - 1, 0, cutover_budget - 1)
+                    ]
+
+                return fn
+            resolved = jnp.asarray(resolved_passes * radix_bits, jnp.int32)
+
+            def fn(args):
+                prefix, kk = args
+                cand, _pop = _collect_prefix_matches(
+                    u_collect, resolved, prefix, cutover_budget, block=128,
+                    n_valid=n_collect, key_of=key_of,
+                )
+                return jax.lax.sort(cand)[jnp.clip(kk - 1, 0, cutover_budget - 1)]
+
+            return fn
+
+        # runtime ladder: try the collect after ncut passes; if the
+        # population still overflows the budget (dense/skewed data — the
+        # static ncut models full-range uniform keys), run ONE more pass
+        # and try again; only then fall back to the remaining fixed passes
+        def rung2(args):
             prefix, kk = args
-            cand, _pop = _collect_prefix_matches(
-                u_collect, resolved, prefix, cutover_budget, block=128,
-                n_valid=n_collect, key_of=key_of,
+            prefix, kk, pop = one_pass(ncut, prefix, kk)
+
+            def finish_full(args):
+                prefix, kk = args
+                for p in range(ncut + 1, npasses):
+                    prefix, kk, _ = one_pass(p, prefix, kk)
+                return prefix
+
+            return jax.lax.cond(
+                pop <= cutover_budget, finish_small(ncut + 1), finish_full,
+                (prefix, kk),
             )
-            return jax.lax.sort(cand)[jnp.clip(kk - 1, 0, cutover_budget - 1)]
 
-        def finish_full(args):
-            prefix, kk = args
-            for p in range(ncut, npasses):
-                prefix, kk, _ = one_pass(p, prefix, kk)
-            return prefix
+        if ncut + 1 < npasses:
+            ans = jax.lax.cond(
+                pop <= cutover_budget, finish_small(ncut), rung2, (prefix, kk)
+            )
+        else:
+            def finish_full(args):
+                prefix, kk = args
+                for p in range(ncut, npasses):
+                    prefix, kk, _ = one_pass(p, prefix, kk)
+                return prefix
 
-        ans = jax.lax.cond(pop <= cutover_budget, finish_small, finish_full, (prefix, kk))
+            ans = jax.lax.cond(
+                pop <= cutover_budget, finish_small(ncut), finish_full,
+                (prefix, kk),
+            )
         return _dt.from_sortable_bits(ans, x.dtype)
 
     if not early:
@@ -425,8 +607,77 @@ def radix_select(
     return _dt.from_sortable_bits(ans, x.dtype)
 
 
+def _collect_prefix_matches_multi(
+    u, resolved_bits, prefixes, budget: int, n_valid: int | None = None, key_of=None
+):
+    """K-query :func:`_collect_prefix_matches`: values (in key space, shape
+    ``(K, budget)``) of up to ``budget`` elements per prefix in ``prefixes``
+    (shape (K,)), padded with the order-maximum, plus populations (K,).
+    The streaming count reads the data ONCE for all K prefixes (the K
+    compares fuse into the per-block reduction)."""
+    if key_of is None:
+        key_of = lambda v: v
+    planes = isinstance(u, tuple)
+    if planes:
+        hi2, lo2 = u
+        nb_, block = hi2.shape
+        n = hi2.size
+        kdt = key_of((hi2[:1, :1], lo2[:1, :1])).dtype
+        ku2 = key_of((hi2, lo2))
+    else:
+        if u.ndim != 2:
+            nb_ = -(-u.shape[0] // 128)
+            n_valid = u.shape[0] if n_valid is None else n_valid
+            u = jnp.pad(u, (0, nb_ * 128 - u.shape[0])).reshape(nb_, 128)
+        nb_, block = u.shape
+        n = u.size
+        kdt = key_of(u[:1, :1]).dtype
+        ku2 = key_of(u)
+    nv = n if n_valid is None else n_valid
+    total_bits = np.dtype(kdt).itemsize * 8
+    cdt = jnp.int32 if n < 2**31 else jnp.int64
+    padded = nv != n
+    nq = prefixes.shape[0]
+    mshift = jnp.asarray(total_bits - resolved_bits).astype(kdt)
+    shifted = jax.lax.shift_right_logical(ku2, mshift)  # (nb_, block)
+    match3 = shifted[None] == prefixes.astype(kdt)[:, None, None]
+    if padded:
+        valid = (
+            jax.lax.broadcasted_iota(cdt, (nb_, block), 0) * block
+            + jax.lax.broadcasted_iota(cdt, (nb_, block), 1)
+            < nv
+        )
+        match3 = jnp.logical_and(match3, valid[None])
+    cnt = jnp.sum(match3, axis=2, dtype=cdt)  # (K, nb_)
+    off = jnp.cumsum(cnt, axis=1)
+    pops = off[:, -1]
+    jj = jnp.arange(budget, dtype=cdt)
+    target = jj + 1
+    b = _rank_block_search(off, jnp.broadcast_to(target, (nq, budget))).astype(cdt)
+    prev = jnp.where(
+        b > 0,
+        jnp.take_along_axis(off, jnp.maximum(b - 1, 0), axis=1),
+        jnp.zeros((), cdt),
+    )
+    r = target[None, :] - prev  # 1-based rank within block, (K, budget)
+    if planes:
+        rows = key_of((hi2[b], lo2[b]))  # (K, budget, block)
+    else:
+        rows = key_of(u[b])
+    rmatch = jax.lax.shift_right_logical(rows, mshift) == prefixes.astype(kdt)[:, None, None]
+    if padded:
+        cols = jax.lax.broadcasted_iota(cdt, (nq, budget, block), 2)
+        rmatch = jnp.logical_and(rmatch, cols < (nv - b[..., None] * block))
+    within = jnp.cumsum(rmatch.astype(cdt), axis=2)
+    local = jnp.argmax(jnp.logical_and(within == r[..., None], rmatch), axis=2)
+    vals = jnp.take_along_axis(rows, local[..., None], axis=2)[..., 0]
+    maxkey = np.array(~np.uint64(0)).astype(np.dtype(kdt))
+    return jnp.where(jj[None, :] < pops[:, None], vals, maxkey), pops
+
+
 @functools.partial(
-    jax.jit, static_argnames=("radix_bits", "hist_method", "chunk")
+    jax.jit,
+    static_argnames=("radix_bits", "hist_method", "chunk", "cutover", "cutover_budget"),
 )
 def radix_select_many(
     x: jax.Array,
@@ -435,15 +686,20 @@ def radix_select_many(
     radix_bits: int | None = None,
     hist_method: str = "auto",
     chunk: int = 32768,
+    cutover: int | str | None = "auto",
+    cutover_budget: int = 8192,
 ) -> jax.Array:
     """Exact k-th smallest for EVERY k in ``ks`` over the same array.
 
-    The amortized form the prepared-tiles design buys (the telemetry shape:
-    p50/p90/p99 of one giant array): the tiled key view and the prefix-free
-    first pass are computed ONCE and shared by all queries; each k then
-    walks only the remaining ``npasses - 1`` prefixed passes under
-    ``lax.scan``. Cost ~ prep + pass0 + K*(npasses-1) passes instead of
-    K*npasses + K*prep. Returns answers in ``ks`` order (shape ``ks.shape``).
+    The amortized multi-rank form (the telemetry shape: p50/p90/p99 of one
+    giant array): the tiled key view and the prefix-free first pass are
+    computed ONCE and shared by all queries, and every later pass runs ALL
+    K queries through one shared data sweep (the multi-prefix kernels,
+    ops/pallas/histogram.py) — the data is read ``npasses`` times total
+    instead of ``1 + K * (npasses - 1)``. The cutover applies to the whole
+    batch: one cond on the LARGEST query population, then a batched
+    collect + sort finishes every query at once. Returns answers in ``ks``
+    order (shape ``ks.shape``; K is static from it).
 
     Out-of-range concrete ks raise in the API layer (api.kselect_many);
     traced ks are clamped to [1, n] like radix_select.
@@ -452,28 +708,113 @@ def radix_select_many(
     n = x.shape[0]
     ks_arr = jnp.atleast_1d(jnp.asarray(ks))
     prep = _Descent(x, radix_bits, hist_method, chunk)
-    radix_bits = prep.radix_bits
-    kk0 = jnp.clip(ks_arr.astype(prep.cdt), 1, n).ravel()
+    radix_bits, total_bits, npasses = prep.radix_bits, prep.total_bits, prep.npasses
+    cdt, kdt = prep.cdt, prep.kdt
+    kk = jnp.clip(ks_arr.astype(cdt), 1, n).ravel()
 
     # shared prefix-free pass: one histogram serves every query's first step
     hist0 = masked_radix_histogram(
         prep.u,
-        shift=prep.total_bits - radix_bits,
+        shift=total_bits - radix_bits,
         radix_bits=radix_bits,
         prefix=None,
         method=hist_method,
-        count_dtype=prep.cdt,
+        count_dtype=cdt,
         chunk=chunk,
         tiles=prep.tiles,
         orig_n=prep.tiles_n,
         key_op=prep.key_op,
         key_xor=prep.key_xor,
     )
-    def per_k(carry, kk):
-        prefix, kk, _ = bucket_walk_step(hist0, kk, None, prep.kdt, radix_bits)
-        for p in range(1, prep.npasses):
-            prefix, kk, _ = prep.one_pass(p, prefix, kk)
-        return carry, prefix
-    _, prefixes = jax.lax.scan(per_k, None, kk0)
-    ans = _dt.from_sortable_bits(prefixes, x.dtype)
+    prefixes, kk, pops = bucket_walk_step_multi(hist0, kk, None, kdt, radix_bits)
+
+    def multi_pass(p, prefixes, kk):
+        shift = total_bits - (p + 1) * radix_bits
+        hist = multi_masked_radix_histogram(
+            prep.u,
+            shift=shift,
+            radix_bits=radix_bits,
+            prefixes=prefixes,
+            method=hist_method,
+            count_dtype=cdt,
+            chunk=chunk,
+            tiles=prep.tiles,
+            orig_n=prep.tiles_n,
+            key_op=prep.key_op,
+            key_xor=prep.key_xor,
+        )
+        return bucket_walk_step_multi(hist, kk, prefixes, kdt, radix_bits)
+
+    if cutover == "auto":
+        ncut = cutover_passes(n, total_bits, radix_bits, cutover_budget)
+    elif cutover is None:
+        ncut = None
+    else:
+        ncut = int(cutover)
+        if not 1 <= ncut < npasses:
+            raise ValueError(f"cutover={ncut} out of range [1, {npasses - 1}]")
+
+    if ncut is None:
+        for p in range(1, npasses):
+            prefixes, kk, pops = multi_pass(p, prefixes, kk)
+        ans = prefixes
+    else:
+        for p in range(1, ncut):
+            prefixes, kk, pops = multi_pass(p, prefixes, kk)
+
+        use_counts = (
+            prep.count_tiles is not None and (ncut + 1) * radix_bits <= 32
+        )
+
+        def finish_small(resolved_passes):
+            def fn(args):
+                prefixes, kk = args
+                if use_counts:
+                    cand, _pops = _collect_via_counts(
+                        prep, resolved_passes, prefixes, cutover_budget
+                    )
+                else:
+                    resolved = jnp.asarray(resolved_passes * radix_bits, jnp.int32)
+                    cand, _pops = _collect_prefix_matches_multi(
+                        prep.u_collect, resolved, prefixes, cutover_budget,
+                        n_valid=prep.n_collect, key_of=prep.key_of,
+                    )
+                s = jnp.sort(cand, axis=1)
+                idx = jnp.clip(kk - 1, 0, cutover_budget - 1)
+                return jnp.take_along_axis(s, idx[:, None], axis=1)[:, 0]
+
+            return fn
+
+        def finish_full_from(p0):
+            def fn(args):
+                prefixes, kk = args
+                for p in range(p0, npasses):
+                    prefixes, kk, _ = multi_pass(p, prefixes, kk)
+                return prefixes
+
+            return fn
+
+        # same 2-rung runtime ladder as radix_select: collect after ncut
+        # passes, else one more pass and a second attempt, else the rest
+        if ncut + 1 < npasses:
+            def rung2(args):
+                prefixes, kk = args
+                prefixes, kk, pops = multi_pass(ncut, prefixes, kk)
+                return jax.lax.cond(
+                    jnp.max(pops) <= cutover_budget,
+                    finish_small(ncut + 1), finish_full_from(ncut + 1),
+                    (prefixes, kk),
+                )
+
+            ans = jax.lax.cond(
+                jnp.max(pops) <= cutover_budget, finish_small(ncut), rung2,
+                (prefixes, kk),
+            )
+        else:
+            ans = jax.lax.cond(
+                jnp.max(pops) <= cutover_budget,
+                finish_small(ncut), finish_full_from(ncut),
+                (prefixes, kk),
+            )
+    ans = _dt.from_sortable_bits(ans, x.dtype)
     return ans.reshape(ks_arr.shape)

@@ -131,22 +131,46 @@ def distributed_radix_select(
 def _jitted_select_many(mesh, n, total_bits, cdt, radix_bits, hist_method, chunk):
     """Sharded multi-rank selection: the shard's tiled view and the
     prefix-free first pass (one local histogram + one ``psum``) are shared
-    by every query; each k walks the remaining prefixed passes under
-    ``lax.scan`` — per-k communication stays one small ``psum`` per pass,
-    the same O(p)-scalars-per-round property as the single-k path."""
+    by every query, and each later pass runs ALL K queries through one
+    shared sweep of the shard (the multi-prefix kernels) followed by one
+    ``psum`` of the (K, nbuckets) counts — the shard is read ``npasses``
+    times total instead of ``1 + K * (npasses - 1)``, and communication
+    stays one small psum per pass for the whole batch."""
     axis = mesh.axis_names[0]
     npasses = total_bits // radix_bits
 
     def shard_fn(xs, ks):
+        from mpi_k_selection_tpu.ops.histogram import multi_masked_radix_histogram
+        from mpi_k_selection_tpu.ops.radix import bucket_walk_step_multi
+
         u, tiles, tiles_n, key_op, key_xor = _prep_shard(hist_method, xs.ravel())
         kdt = jnp.dtype(_dt.key_dtype(xs.dtype))
 
-        def local_hist(shift, prefix):
-            return masked_radix_histogram(
+        hist0 = jax.lax.psum(
+            masked_radix_histogram(
+                u,
+                shift=total_bits - radix_bits,
+                radix_bits=radix_bits,
+                prefix=None,
+                method=hist_method,
+                count_dtype=cdt,
+                chunk=chunk,
+                tiles=tiles,
+                orig_n=tiles_n,
+                key_op=key_op,
+                key_xor=key_xor,
+            ),
+            axis,
+        )
+        kk = jnp.clip(ks.astype(cdt), 1, n)
+        prefixes, kk, _ = bucket_walk_step_multi(hist0, kk, None, kdt, radix_bits)
+        for p in range(1, npasses):
+            shift = total_bits - (p + 1) * radix_bits
+            local = multi_masked_radix_histogram(
                 u,
                 shift=shift,
                 radix_bits=radix_bits,
-                prefix=prefix,
+                prefixes=prefixes,
                 method=hist_method,
                 count_dtype=cdt,
                 chunk=chunk,
@@ -155,18 +179,10 @@ def _jitted_select_many(mesh, n, total_bits, cdt, radix_bits, hist_method, chunk
                 key_op=key_op,
                 key_xor=key_xor,
             )
-
-        hist0 = jax.lax.psum(local_hist(total_bits - radix_bits, None), axis)
-
-        def per_k(carry, kk):
-            kk = jnp.clip(kk.astype(cdt), 1, n)
-            prefix, kk, _ = bucket_walk_step(hist0, kk, None, kdt, radix_bits)
-            for p in range(1, npasses):
-                shift = total_bits - (p + 1) * radix_bits
-                hist = jax.lax.psum(local_hist(shift, prefix), axis)
-                prefix, kk, _ = bucket_walk_step(hist, kk, prefix, kdt, radix_bits)
-            return carry, prefix
-        _, prefixes = jax.lax.scan(per_k, None, ks)
+            hist = jax.lax.psum(local, axis)  # (K, nbuckets), one collective
+            prefixes, kk, _ = bucket_walk_step_multi(
+                hist, kk, prefixes, kdt, radix_bits
+            )
         return _dt.from_sortable_bits(prefixes, xs.dtype)
 
     fn = jax.shard_map(shard_fn, mesh=mesh, in_specs=(P(axis), P()), out_specs=P())

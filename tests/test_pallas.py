@@ -331,3 +331,100 @@ def test_masked_histogram_raw_tiles_reject_non_pallas(rng):
             None, shift=28, radix_bits=4, method="scatter",
             tiles=(tiles,), orig_n=n, key_op="xor", key_xor=1 << 31,
         )
+
+
+# ---------------------------------------------------------------------------
+# Multi-prefix kernels + match-count kernel (the multi-rank fast path) and
+# the cutover ladder (forced small-n cutovers so the collect branches run
+# in CI, where auto disables the cutover below 2^20 elements).
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("dtype", [np.int32, np.float32])
+def test_pallas_multi_histogram_matches_singles(rng, dtype):
+    from mpi_k_selection_tpu.ops.pallas.histogram import (
+        pallas_radix_histogram_multi,
+        prepare_raw_tiles32,
+    )
+    from mpi_k_selection_tpu.utils import dtypes as _dt
+
+    n = 256 * 128 + 55
+    x = _raw_fold_case(rng, dtype, n)
+    xd = jnp.asarray(x)
+    un = np.asarray(_dt.to_sortable_bits(xd)).astype(np.uint64)
+    rt, rn = prepare_raw_tiles32(xd, 256)
+    key_op, *rest = _dt.key_fold(dtype)
+    key_xor = rest[0] if key_op == "xor" else 0
+    shift, rb = 20, 4
+    prefs = np.sort(un)[[n // 4, n // 2, 3 * n // 4]] >> (shift + rb)
+    prefs = jnp.asarray(prefs.astype(np.uint32))
+    hm = pallas_radix_histogram_multi(
+        shift=shift, radix_bits=rb, prefixes=prefs, tiles=rt, orig_n=rn,
+        block_rows=256, key_op=key_op, key_xor=key_xor,
+    )
+    for q in range(3):
+        want = _oracle(un, shift, rb, int(prefs[q]))
+        np.testing.assert_array_equal(np.asarray(hm[q]), want, err_msg=str(q))
+
+
+def test_pallas_match_counts_vs_numpy(rng):
+    from mpi_k_selection_tpu.ops.pallas.histogram import (
+        pallas_match_counts,
+        prepare_raw_tiles32,
+    )
+    from mpi_k_selection_tpu.utils import dtypes as _dt
+
+    n = 2 * 256 * 128 + 99
+    x = rng.integers(-(2**31), 2**31, size=n, dtype=np.int32)
+    xd = jnp.asarray(x)
+    un = np.asarray(_dt.to_sortable_bits(xd)).astype(np.uint64)
+    rt, rn = prepare_raw_tiles32(xd, 256)
+    res = 12
+    prefs_np = (np.sort(un)[[n // 3, n // 2]] >> (32 - res)).astype(np.uint32)
+    cnt = pallas_match_counts(
+        resolved_bits=res, prefixes=jnp.asarray(prefs_np), tiles=rt,
+        orig_n=rn, key_op="xor", key_xor=1 << 31, block_rows=256,
+    )
+    R = rt.shape[0]
+    up = np.zeros(R * 128, np.uint64)
+    up[:n] = un
+    valid = np.arange(R * 128) < n
+    for q, p in enumerate(prefs_np):
+        m = ((up >> np.uint64(32 - res)) == np.uint64(p)) & valid
+        want = m.reshape(R, 128).sum(axis=1)
+        np.testing.assert_array_equal(np.asarray(cnt[q]), want, err_msg=str(q))
+
+
+@pytest.mark.parametrize("dtype", [np.int32, np.float32])
+def test_radix_select_forced_cutover_ladder(rng, dtype):
+    """Forced cutover on small input: rung-1 collect, rung-2 collect (via a
+    tight budget), and the full-branch fallback (dense data) all exact."""
+    n = 2 * 4096 * 128 + 17  # two grid blocks + ragged tail
+    x = _raw_fold_case(rng, dtype, n)
+    want = np.sort(x, kind="stable")
+    for k in (1, n // 2, n):
+        got = np.asarray(
+            radix_select(jnp.asarray(x), k, hist_method="pallas", cutover=2)
+        )[()]
+        assert got == want[k - 1], (dtype, k, "rung1")
+    # tight budget: rung 1 overflows (pop after 2 passes ~ n/256 > 64),
+    # rung 2 or the full branch must still be exact
+    got = np.asarray(
+        radix_select(
+            jnp.asarray(x), n // 2, hist_method="pallas", cutover=2,
+            cutover_budget=64,
+        )
+    )[()]
+    assert got == want[n // 2 - 1], (dtype, "tight-budget")
+
+
+def test_radix_select_many_forced_cutover(rng):
+    from mpi_k_selection_tpu.ops.radix import radix_select_many
+
+    n = 2 * 4096 * 128 + 17
+    x = rng.integers(0, 1 << 24, size=n, dtype=np.int32)  # dense-ish range
+    ks = np.array([1, n // 3, n // 2, n])
+    got = np.asarray(
+        radix_select_many(jnp.asarray(x), ks, hist_method="pallas", cutover=3)
+    )
+    np.testing.assert_array_equal(got, np.sort(x, kind="stable")[ks - 1])
