@@ -77,7 +77,7 @@ def _hist_onehot(digits, mask, nbuckets, count_dtype, chunk):
     return hist
 
 
-def prepare_keys(hist_method: str, keys: jax.Array):
+def prepare_keys(hist_method: str, keys: jax.Array, block_rows: int = 4096):
     """``(tiles, n)`` for the resolved pallas method, or ``(None, None)``.
 
     Pass-loop callers (ops/radix.py, parallel/radix.py) call this once up
@@ -100,17 +100,17 @@ def prepare_keys(hist_method: str, keys: jax.Array):
     if method in ("pallas", "pallas_compare") and keys.dtype.itemsize <= 4:
         from mpi_k_selection_tpu.ops.pallas.histogram import prepare_tiles32
 
-        tiles, n = prepare_tiles32(keys)
+        tiles, n = prepare_tiles32(keys, block_rows)
         return (tiles,), n
     if method in ("pallas64", "pallas64_compare") and keys.dtype == jnp.uint64:
         from mpi_k_selection_tpu.ops.pallas.histogram import prepare_tiles64
 
-        hi2, lo2, n = prepare_tiles64(keys)
+        hi2, lo2, n = prepare_tiles64(keys, block_rows)
         return (hi2, lo2), n
     return None, None
 
 
-def prepare_raw(hist_method: str, x: jax.Array):
+def prepare_raw(hist_method: str, x: jax.Array, block_rows: int = 4096):
     """``(tiles, n, key_op, key_xor)`` for the raw-bits kernel fast path, or
     ``None`` when it does not apply (non-pallas method, or a dtype without
     an in-kernel key transform — see utils/dtypes.py:key_fold).
@@ -135,12 +135,12 @@ def prepare_raw(hist_method: str, x: jax.Array):
     if method in ("pallas", "pallas_compare") and itemsize == 4:
         from mpi_k_selection_tpu.ops.pallas.histogram import prepare_raw_tiles32
 
-        tiles, n = prepare_raw_tiles32(x)
+        tiles, n = prepare_raw_tiles32(x, block_rows)
         return (tiles,), n, key_op, key_xor
     if method in ("pallas64", "pallas64_compare") and itemsize == 8:
         from mpi_k_selection_tpu.ops.pallas.histogram import prepare_raw_tiles64
 
-        hi2, lo2, n = prepare_raw_tiles64(x)
+        hi2, lo2, n = prepare_raw_tiles64(x, block_rows)
         return (hi2, lo2), n, key_op, key_xor
     return None
 
@@ -149,7 +149,7 @@ def prepare_raw(hist_method: str, x: jax.Array):
     jax.jit,
     static_argnames=(
         "shift", "radix_bits", "method", "count_dtype", "chunk", "orig_n",
-        "key_op", "key_xor",
+        "key_op", "key_xor", "block_rows",
     ),
 )
 def multi_masked_radix_histogram(
@@ -165,6 +165,7 @@ def multi_masked_radix_histogram(
     orig_n: int | None = None,
     key_op: str = "none",
     key_xor: int = 0,
+    block_rows: int = 4096,
 ) -> jax.Array:
     """``(K, 2**radix_bits)`` histograms, one per key-space prefix in
     ``prefixes`` (shape (K,), traced) — the shared-sweep primitive of
@@ -184,7 +185,7 @@ def multi_masked_radix_histogram(
         if tiles is None:
             from mpi_k_selection_tpu.ops.pallas.histogram import prepare_tiles32
 
-            tiles_, orig_n = prepare_tiles32(keys.ravel())
+            tiles_, orig_n = prepare_tiles32(keys.ravel(), block_rows)
             tiles = (tiles_,)
         return pallas_radix_histogram_multi(
             shift=shift,
@@ -195,6 +196,7 @@ def multi_masked_radix_histogram(
             orig_n=orig_n,
             key_op=key_op,
             key_xor=key_xor,
+            block_rows=block_rows,
         )
     if method in ("pallas64", "pallas64_compare"):
         from mpi_k_selection_tpu.ops.pallas.histogram import (
@@ -204,7 +206,7 @@ def multi_masked_radix_histogram(
         if tiles is None:
             from mpi_k_selection_tpu.ops.pallas.histogram import prepare_tiles64
 
-            hi2, lo2, orig_n = prepare_tiles64(keys.ravel())
+            hi2, lo2, orig_n = prepare_tiles64(keys.ravel(), block_rows)
             tiles = (hi2, lo2)
         return pallas_radix_histogram64_multi(
             shift=shift,
@@ -215,6 +217,7 @@ def multi_masked_radix_histogram(
             orig_n=orig_n,
             key_op=key_op,
             key_xor=key_xor,
+            block_rows=block_rows,
         )
     if key_op != "none":
         raise ValueError("key_op/raw tiles require a pallas histogram method")
@@ -251,7 +254,7 @@ def resolve_hist_method(method: str, key_dtype=None) -> str:
     jax.jit,
     static_argnames=(
         "shift", "radix_bits", "method", "count_dtype", "chunk", "orig_n",
-        "key_op", "key_xor",
+        "key_op", "key_xor", "block_rows",
     ),
 )
 def masked_radix_histogram(
@@ -267,6 +270,7 @@ def masked_radix_histogram(
     orig_n: int | None = None,
     key_op: str = "none",
     key_xor: int = 0,
+    block_rows: int = 4096,
 ) -> jax.Array:
     """Histogram of the ``radix_bits``-wide digit at ``shift`` over active keys.
 
@@ -306,6 +310,7 @@ def masked_radix_histogram(
             orig_n=orig_n,
             key_op=key_op,
             key_xor=key_xor,
+            block_rows=block_rows,
         )
     if method in ("pallas64", "pallas64_compare"):
         if prefix is not None or shift + radix_bits == 64:
@@ -324,6 +329,7 @@ def masked_radix_histogram(
                 orig_n=orig_n,
                 key_op=key_op,
                 key_xor=key_xor,
+                block_rows=block_rows,
             )
         if key_op != "none":
             # the XLA fallback below reads `keys` in key space; raw tiles

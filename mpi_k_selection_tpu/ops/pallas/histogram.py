@@ -144,6 +144,15 @@ def prepare_raw_tiles64(x: jax.Array, block_rows: int = 4096):
     return prepare_tiles64(raw, block_rows)
 
 
+def _check_block_rows(block_rows: int) -> None:
+    """Every kernel entry point's geometry contract: a power of two >= 8.
+    The SWAR group loop consumes whole 8-row groups (a non-multiple would
+    silently drop tail rows), and the VMEM caps (4096/1024) must divide the
+    prepared tiling in whichever direction the min() resolves."""
+    if block_rows < 8 or block_rows & (block_rows - 1):
+        raise ValueError(f"block_rows={block_rows} must be a power of two >= 8")
+
+
 def _cap_block_rows(block_rows: int, radix_bits: int) -> int:
     """Largest safe block height for the kernel's scoped-VMEM budget.
 
@@ -389,6 +398,7 @@ def pallas_radix_histogram(
         )
     if key_op not in ("none", "xor", "float"):
         raise ValueError(f"unknown key_op {key_op!r}")
+    _check_block_rows(block_rows)
     if key_op != "none" and prefix is None and shift + radix_bits != 32:
         # fold modes compute z by xor only; a prefix-free digit below the
         # top of the key would need the legacy mask path
@@ -548,6 +558,7 @@ def pallas_radix_histogram64(
         raise ValueError(
             "prefix=None needs shift + radix_bits == 64 on the 64-bit kernel"
         )
+    _check_block_rows(block_rows)
     block_rows = _cap_block_rows(block_rows, radix_bits)
     if tiles is not None:
         if orig_n is None:
@@ -764,6 +775,7 @@ def pallas_radix_histogram_multi(
         interpret = jax.default_backend() != "tpu"
     nb = 1 << radix_bits
     nq = int(prefixes.shape[0])
+    _check_block_rows(block_rows)
     block_rows = _multi_block_rows(_cap_block_rows(block_rows, radix_bits), nq)
     if orig_n is None:
         raise ValueError("tiles needs orig_n")
@@ -873,6 +885,7 @@ def pallas_radix_histogram64_multi(
         )
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    _check_block_rows(block_rows)
     block_rows = _multi_block_rows(_cap_block_rows(block_rows, radix_bits), nq)
     if hi2.shape[0] % block_rows or hi2.shape[1] != LANES:
         raise ValueError(
@@ -1014,6 +1027,11 @@ def pallas_match_counts(
         raise ValueError(f"unknown key_op {key_op!r}")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    _check_block_rows(block_rows)
+    if block_rows % 128:
+        # groups = block_rows // 128 must cover the block exactly; a smaller
+        # height would build a degenerate zero-group kernel
+        raise ValueError(f"block_rows={block_rows} must be a multiple of 128")
     nq = int(prefixes.shape[0])
     R = tiles.shape[0]
     if R % block_rows or tiles.shape[1] != LANES:

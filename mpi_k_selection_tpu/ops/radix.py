@@ -271,7 +271,7 @@ class _Descent:
     in-kernel key fold when available, key-space otherwise) and the
     one_pass bucket-walk closure both select entry points drive."""
 
-    def __init__(self, x, radix_bits, hist_method, chunk):
+    def __init__(self, x, radix_bits, hist_method, chunk, block_rows=4096):
         n = x.shape[0]
         if radix_bits is None:
             radix_bits = default_radix_bits(x.dtype, hist_method)
@@ -285,6 +285,16 @@ class _Descent:
         self.npasses = total_bits // radix_bits
         self.cdt = select_count_dtype(n)
         self.kdt = jnp.dtype(_dt.key_dtype(x.dtype))
+        # power-of-two >= 8 keeps every kernel invariant: the SWAR group
+        # loop consumes whole 8-row groups (a non-multiple silently drops
+        # tail rows), and the VMEM caps (_cap_block_rows/_multi_block_rows,
+        # 1024/4096) then always divide the prepared tiling in whichever
+        # direction the min() resolves
+        if block_rows < 8 or block_rows & (block_rows - 1):
+            raise ValueError(
+                f"block_rows={block_rows} must be a power of two >= 8"
+            )
+        self.block_rows = block_rows
 
         from mpi_k_selection_tpu.ops.histogram import prepare_keys, prepare_raw
 
@@ -295,7 +305,7 @@ class _Descent:
         # collect): per-pass views make XLA hold/remat extra full-size
         # temporaries, OOMing 16 GB HBM at the 1B-element config.
         _dt._require_x64(x.dtype)  # 64-bit key math needs x64 in every mode
-        raw = prepare_raw(hist_method, x)
+        raw = prepare_raw(hist_method, x, block_rows)
         if raw is not None:
             self.tiles, self.tiles_n, self.key_op, self.key_xor = raw
             self.u = None
@@ -324,7 +334,7 @@ class _Descent:
         else:
             self.key_op, self.key_xor = "none", 0
             self.u = _dt.to_sortable_bits(x)
-            self.tiles, self.tiles_n = prepare_keys(hist_method, self.u)
+            self.tiles, self.tiles_n = prepare_keys(hist_method, self.u, block_rows)
             self.key_of = None
             if (
                 self.tiles is not None
@@ -349,7 +359,8 @@ class _Descent:
         # plane while resolved_bits <= 32, so the 32-bit kernel serves it.
         self.count_tiles = None
         self.count_key = ("none", 0)
-        if self.tiles is not None:
+        # the match-count kernel's row regrouping needs whole 128-row groups
+        if self.tiles is not None and block_rows % 128 == 0:
             if len(self.tiles) == 2:
                 self.count_tiles = self.tiles[0]  # hi plane
                 if self.key_op == "xor":
@@ -376,6 +387,7 @@ class _Descent:
                 orig_n=self.tiles_n,
                 key_op=self.key_op,
                 key_xor=self.key_xor,
+                block_rows=block_rows,
             )
             return bucket_walk_step(hist, kk, prefix if p else None, kdt, radix_bits)
 
@@ -404,6 +416,10 @@ def _collect_via_counts(prep, resolved_passes: int, prefixes, budget: int):
         key_op=key_op,
         key_xor=key_xor,
         count_dtype=prep.cdt,
+        # cap like the histogram kernels do: 8192-row tiles (valid geometry)
+        # would blow the scoped-VMEM budget at full height; 4096 divides any
+        # larger power-of-two tiling
+        block_rows=min(prep.block_rows, 4096),
     )  # (K, R)
     cdt = prep.cdt
     nq = prefixes.shape[0]
@@ -446,6 +462,7 @@ def _collect_via_counts(prep, resolved_passes: int, prefixes, budget: int):
         "early_exit_budget",
         "cutover",
         "cutover_budget",
+        "block_rows",
     ),
 )
 def radix_select(
@@ -458,6 +475,7 @@ def radix_select(
     early_exit_budget: int | None = None,
     cutover: int | str | None = "auto",
     cutover_budget: int = 8192,
+    block_rows: int = 4096,
 ) -> jax.Array:
     """Exact k-th smallest element of ``x`` (k is 1-indexed, reference semantics).
 
@@ -483,7 +501,7 @@ def radix_select(
     """
     x = x.ravel()
     n = x.shape[0]
-    prep = _Descent(x, radix_bits, hist_method, chunk)
+    prep = _Descent(x, radix_bits, hist_method, chunk, block_rows)
     radix_bits, total_bits, npasses = prep.radix_bits, prep.total_bits, prep.npasses
     cdt, kdt, one_pass = prep.cdt, prep.kdt, prep.one_pass
     u_collect, n_collect, key_of = prep.u_collect, prep.n_collect, prep.key_of
@@ -677,7 +695,10 @@ def _collect_prefix_matches_multi(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("radix_bits", "hist_method", "chunk", "cutover", "cutover_budget"),
+    static_argnames=(
+        "radix_bits", "hist_method", "chunk", "cutover", "cutover_budget",
+        "block_rows",
+    ),
 )
 def radix_select_many(
     x: jax.Array,
@@ -688,6 +709,7 @@ def radix_select_many(
     chunk: int = 32768,
     cutover: int | str | None = "auto",
     cutover_budget: int = 8192,
+    block_rows: int = 4096,
 ) -> jax.Array:
     """Exact k-th smallest for EVERY k in ``ks`` over the same array.
 
@@ -707,7 +729,7 @@ def radix_select_many(
     x = x.ravel()
     n = x.shape[0]
     ks_arr = jnp.atleast_1d(jnp.asarray(ks))
-    prep = _Descent(x, radix_bits, hist_method, chunk)
+    prep = _Descent(x, radix_bits, hist_method, chunk, block_rows)
     radix_bits, total_bits, npasses = prep.radix_bits, prep.total_bits, prep.npasses
     cdt, kdt = prep.cdt, prep.kdt
     kk = jnp.clip(ks_arr.astype(cdt), 1, n).ravel()
@@ -725,6 +747,7 @@ def radix_select_many(
         orig_n=prep.tiles_n,
         key_op=prep.key_op,
         key_xor=prep.key_xor,
+        block_rows=block_rows,
     )
     prefixes, kk, pops = bucket_walk_step_multi(hist0, kk, None, kdt, radix_bits)
 
@@ -742,6 +765,7 @@ def radix_select_many(
             orig_n=prep.tiles_n,
             key_op=prep.key_op,
             key_xor=prep.key_xor,
+            block_rows=block_rows,
         )
         return bucket_walk_step_multi(hist, kk, prefixes, kdt, radix_bits)
 

@@ -31,10 +31,16 @@ def _oracle(keys, shift, radix_bits, prefix):
     return np.bincount(digits[active].astype(np.int64), minlength=nb)
 
 
-@pytest.mark.parametrize("n", [128, 1000, 12345, 1 << 17])
 @pytest.mark.parametrize(
-    "shift,radix_bits,prefix",
-    [(28, 4, None), (24, 4, 7), (0, 4, 2**27 - 5), (24, 8, None), (16, 8, 129)],
+    "n,shift,radix_bits,prefix",
+    # rb=4 at every size (128 / ragged / two-grid-steps); rb=8 once — its
+    # nreg=32 SWAR kernel costs ~19s of TRACE time per distinct shape in
+    # interpret mode, so one representative n covers it (the rb=8 drain
+    # logic is unit-tested shape-independently by test_packed_count_drain)
+    [(n, s, rb, p)
+     for n in (128, 1000, 12345, 1 << 17)
+     for (s, rb, p) in ((28, 4, None), (24, 4, 7), (0, 4, 2**27 - 5))]
+    + [(12345, 24, 8, None), (12345, 16, 8, 129)],
 )
 def test_pallas_histogram_matches_oracle(rng, n, shift, radix_bits, prefix):
     keys = jnp.asarray(rng.integers(0, 2**32, size=n, dtype=np.uint32))
@@ -316,7 +322,9 @@ def test_radix_select_raw_fold_end_to_end(rng, dtype):
     n = 40_000
     x = _raw_fold_case(rng, dtype, n)
     for k in (1, n // 2, n):
-        got = np.asarray(radix_select(jnp.asarray(x), k, hist_method="pallas"))[()]
+        got = np.asarray(
+            radix_select(jnp.asarray(x), k, hist_method="pallas", block_rows=256)
+        )[()]
         want = np.sort(x, kind="stable")[k - 1]
         assert got == want, (dtype, k, got, want)
 
@@ -398,33 +406,56 @@ def test_pallas_match_counts_vs_numpy(rng):
 @pytest.mark.parametrize("dtype", [np.int32, np.float32])
 def test_radix_select_forced_cutover_ladder(rng, dtype):
     """Forced cutover on small input: rung-1 collect, rung-2 collect (via a
-    tight budget), and the full-branch fallback (dense data) all exact."""
-    n = 2 * 4096 * 128 + 17  # two grid blocks + ragged tail
+    tight budget), and the full-branch fallback (dense data) all exact.
+    block_rows=256 (plumbed through radix_select) keeps interpret-mode cost
+    small while still running multi-step grids + the ragged-tail correction."""
+    n = 2 * 256 * 128 + 17  # two grid blocks + ragged tail
     x = _raw_fold_case(rng, dtype, n)
     want = np.sort(x, kind="stable")
     for k in (1, n // 2, n):
         got = np.asarray(
-            radix_select(jnp.asarray(x), k, hist_method="pallas", cutover=2)
+            radix_select(
+                jnp.asarray(x), k, hist_method="pallas", cutover=2, block_rows=256
+            )
         )[()]
         assert got == want[k - 1], (dtype, k, "rung1")
-    # tight budget: rung 1 overflows (pop after 2 passes ~ n/256 > 64),
-    # rung 2 or the full branch must still be exact
+    # tight budget: rung 1 overflows (pop after 2 passes ~ n/256 > 64), rung
+    # 2 (pop after 3 passes ~ n/4096 <= 64 for uniform data) must be exact
     got = np.asarray(
         radix_select(
             jnp.asarray(x), n // 2, hist_method="pallas", cutover=2,
-            cutover_budget=64,
+            cutover_budget=64, block_rows=256,
         )
     )[()]
-    assert got == want[n // 2 - 1], (dtype, "tight-budget")
+    assert got == want[n // 2 - 1], (dtype, "rung2")
+
+
+def test_radix_select_forced_cutover_full_branch(rng):
+    # dense data (values in [0, 200)): the surviving population stays ~n/16
+    # after every early pass, so BOTH rungs overflow a tight budget and the
+    # remaining fixed passes must finish the descent exactly
+    n = 256 * 128 + 9
+    x = rng.integers(0, 200, size=n, dtype=np.int32)
+    want = np.sort(x, kind="stable")
+    for k in (1, n // 2, n):
+        got = np.asarray(
+            radix_select(
+                jnp.asarray(x), k, hist_method="pallas", cutover=2,
+                cutover_budget=64, block_rows=256,
+            )
+        )[()]
+        assert got == want[k - 1], (k, "full-branch")
 
 
 def test_radix_select_many_forced_cutover(rng):
     from mpi_k_selection_tpu.ops.radix import radix_select_many
 
-    n = 2 * 4096 * 128 + 17
+    n = 2 * 256 * 128 + 17
     x = rng.integers(0, 1 << 24, size=n, dtype=np.int32)  # dense-ish range
     ks = np.array([1, n // 3, n // 2, n])
     got = np.asarray(
-        radix_select_many(jnp.asarray(x), ks, hist_method="pallas", cutover=3)
+        radix_select_many(
+            jnp.asarray(x), ks, hist_method="pallas", cutover=3, block_rows=256
+        )
     )
     np.testing.assert_array_equal(got, np.sort(x, kind="stable")[ks - 1])

@@ -141,7 +141,8 @@ def test_radix_select_sub32_dtypes_with_pallas_cutover(rng, dtype):
         x = (rng.standard_normal(120001) * 100).astype(np.float16)
     k = 60000
     got = radix_select(
-        jnp.asarray(x), k, hist_method="pallas", cutover=1, cutover_budget=65536
+        jnp.asarray(x), k, hist_method="pallas", cutover=1, cutover_budget=65536,
+        block_rows=256,
     )
     want = np.sort(x, kind="stable")[k - 1]
     assert np.asarray(got)[()] == want
