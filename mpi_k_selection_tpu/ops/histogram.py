@@ -32,6 +32,17 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def check_block_rows(block_rows: int) -> None:
+    """The kernel tiling geometry contract, shared by every select entry
+    point: a power of two >= 8. The SWAR group loop consumes whole 8-row
+    groups (a non-multiple would silently drop tail rows), and the VMEM
+    caps (4096/1024) must divide the prepared tiling in whichever direction
+    the min() resolves. Lives here (not in ops/pallas) so the pure-XLA
+    paths can validate without importing jax.experimental.pallas."""
+    if block_rows < 8 or block_rows & (block_rows - 1):
+        raise ValueError(f"block_rows={block_rows} must be a power of two >= 8")
+
+
 def _digit_and_mask(keys, shift, radix_bits, prefix):
     kdt = keys.dtype
     digits = jax.lax.shift_right_logical(keys, kdt.type(shift))

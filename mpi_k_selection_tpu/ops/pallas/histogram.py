@@ -140,17 +140,25 @@ def prepare_raw_tiles64(x: jax.Array, block_rows: int = 4096):
     x = x.ravel()
     if np.dtype(x.dtype).itemsize != 8:
         raise ValueError(f"prepare_raw_tiles64 wants an 8-byte dtype, got {x.dtype}")
-    raw = jax.lax.bitcast_convert_type(x, jnp.uint64)
+    if x.dtype == jnp.float64:
+        from mpi_k_selection_tpu.utils.dtypes import f64_to_u64_bits
+
+        raw = f64_to_u64_bits(x)  # f64-source bitcasts crash the TPU compiler
+    else:
+        raw = jax.lax.bitcast_convert_type(x, jnp.uint64)
     return prepare_tiles64(raw, block_rows)
 
 
-def _check_block_rows(block_rows: int) -> None:
-    """Every kernel entry point's geometry contract: a power of two >= 8.
-    The SWAR group loop consumes whole 8-row groups (a non-multiple would
-    silently drop tail rows), and the VMEM caps (4096/1024) must divide the
-    prepared tiling in whichever direction the min() resolves."""
-    if block_rows < 8 or block_rows & (block_rows - 1):
-        raise ValueError(f"block_rows={block_rows} must be a power of two >= 8")
+def _match_vma(x, vma):
+    """Promote ``x``'s varying-manual-axes type to ``vma`` — the SMEM scalar
+    refs are derived from psummed (invariant) walk state, while the tiles
+    are device-varying under shard_map; pallas_call wants them to agree.
+    No-op outside shard_map (both sides empty)."""
+    missing = tuple(sorted(vma - jax.typeof(x).vma))
+    return jax.lax.pcast(x, missing, to="varying") if missing else x
+
+
+from mpi_k_selection_tpu.ops.histogram import check_block_rows as _check_block_rows  # noqa: E402  (shared geometry contract; no cycle — ops.histogram imports pallas lazily)
 
 
 def _cap_block_rows(block_rows: int, radix_bits: int) -> int:
@@ -447,6 +455,10 @@ def pallas_radix_histogram(
         kern, shift=shift, radix_bits=radix_bits, has_prefix=has_prefix,
         key_op=key_op,
     )
+    # under shard_map the tiles are device-varying; the out_shape must carry
+    # the same varying-manual-axes type for check_vma (empty set otherwise)
+    vma = jax.typeof(k2d).vma
+    zref = _match_vma(zref, vma)
     # trace the kernel with x64 off: the kernel is int32-only, and Mosaic
     # fails to legalize programs traced in x64 mode (int64 grid indices)
     with jax.enable_x64(False):
@@ -460,7 +472,7 @@ def pallas_radix_histogram(
                 ),
             ],
             out_specs=pl.BlockSpec((nb, LANES), lambda i: (0, 0), memory_space=pltpu.VMEM),
-            out_shape=jax.ShapeDtypeStruct((nb, LANES), jnp.int32),
+            out_shape=jax.ShapeDtypeStruct((nb, LANES), jnp.int32, vma=vma),
             interpret=interpret,
         )(zref, k2d)
     hist = jnp.sum(lane_hist, axis=1, dtype=count_dtype)
@@ -633,6 +645,9 @@ def pallas_radix_histogram64(
     kernel = functools.partial(
         kern64, shift=shift, radix_bits=radix_bits, key_op=key_op
     )
+    vma = jax.typeof(hi2).vma  # see 32-bit variant
+    phi = _match_vma(phi, vma)
+    zlo = _match_vma(zlo, vma)
     # x64 off while tracing: the kernel is int32-only (see 32-bit variant)
     with jax.enable_x64(False):
         lane_hist = pl.pallas_call(
@@ -649,7 +664,7 @@ def pallas_radix_histogram64(
                 ),
             ],
             out_specs=pl.BlockSpec((nb, LANES), lambda i: (0, 0), memory_space=pltpu.VMEM),
-            out_shape=jax.ShapeDtypeStruct((nb, LANES), jnp.int32),
+            out_shape=jax.ShapeDtypeStruct((nb, LANES), jnp.int32, vma=vma),
             interpret=interpret,
         )(phi, zlo, hi2, lo2)
     hist = jnp.sum(lane_hist, axis=1, dtype=count_dtype)
@@ -802,6 +817,8 @@ def pallas_radix_histogram_multi(
         _hist_kernel_multi_packed,
         shift=shift, radix_bits=radix_bits, key_op=key_op, nq=nq,
     )
+    vma = jax.typeof(k2d).vma  # see pallas_radix_histogram
+    zrefs = _match_vma(zrefs, vma)
     with jax.enable_x64(False):
         lane_hist = pl.pallas_call(
             kernel,
@@ -815,7 +832,7 @@ def pallas_radix_histogram_multi(
             out_specs=pl.BlockSpec(
                 (nq * nb, LANES), lambda i: (0, 0), memory_space=pltpu.VMEM
             ),
-            out_shape=jax.ShapeDtypeStruct((nq * nb, LANES), jnp.int32),
+            out_shape=jax.ShapeDtypeStruct((nq * nb, LANES), jnp.int32, vma=vma),
             interpret=interpret,
         )(zrefs, k2d)
     hist = jnp.sum(
@@ -912,6 +929,9 @@ def pallas_radix_histogram64_multi(
         _hist_kernel64_multi_packed,
         shift=shift, radix_bits=radix_bits, key_op=key_op, nq=nq,
     )
+    vma = jax.typeof(hi2).vma  # see pallas_radix_histogram
+    phis = _match_vma(phis, vma)
+    zlos = _match_vma(zlos, vma)
     with jax.enable_x64(False):
         lane_hist = pl.pallas_call(
             kernel,
@@ -929,7 +949,7 @@ def pallas_radix_histogram64_multi(
             out_specs=pl.BlockSpec(
                 (nq * nb, LANES), lambda i: (0, 0), memory_space=pltpu.VMEM
             ),
-            out_shape=jax.ShapeDtypeStruct((nq * nb, LANES), jnp.int32),
+            out_shape=jax.ShapeDtypeStruct((nq * nb, LANES), jnp.int32, vma=vma),
             interpret=interpret,
         )(phis, zlos, hi2, lo2)
     hist = jnp.sum(
@@ -1050,6 +1070,8 @@ def pallas_match_counts(
     kernel = functools.partial(
         _match_count_kernel, mshift=mshift, key_op=key_op, nq=nq, n=orig_n
     )
+    vma = jax.typeof(tiles).vma  # see pallas_radix_histogram
+    crefs = _match_vma(crefs, vma)
     with jax.enable_x64(False):
         out = pl.pallas_call(
             kernel,
@@ -1063,7 +1085,9 @@ def pallas_match_counts(
             out_specs=pl.BlockSpec(
                 (nq * groups, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM
             ),
-            out_shape=jax.ShapeDtypeStruct((grid * nq * groups, LANES), jnp.int32),
+            out_shape=jax.ShapeDtypeStruct(
+                (grid * nq * groups, LANES), jnp.int32, vma=vma
+            ),
             interpret=interpret,
         )(crefs, tiles)
     # (grid, nq, groups, 128) -> (nq, grid*groups*128) == (nq, R)

@@ -98,6 +98,47 @@ def cutover_passes(n: int, total_bits: int, radix_bits: int, budget: int) -> int
     return ncut
 
 
+def resolve_cutover(cutover, n, total_bits, radix_bits, budget):
+    """Static cutover pass count shared by every select entry point
+    (single-chip and distributed): ``"auto"`` -> :func:`cutover_passes`,
+    ``None`` -> disabled, int -> forced (validated against the pass count)."""
+    npasses = total_bits // radix_bits
+    if cutover == "auto":
+        return cutover_passes(n, total_bits, radix_bits, budget)
+    if cutover is None:
+        return None
+    ncut = int(cutover)
+    if not 1 <= ncut < npasses:
+        raise ValueError(f"cutover={ncut} out of range [1, {npasses - 1}]")
+    return ncut
+
+
+def run_cutover_ladder(ncut, npasses, pop0, pred, step, finish_small, finish_full_from, state):
+    """The 2-rung runtime cutover ladder, shared by all four select paths
+    (radix_select, radix_select_many, and their distributed counterparts in
+    parallel/radix.py): try the collect after ``ncut`` passes; if the
+    surviving population still overflows the budget (dense/skewed data —
+    the static ncut models full-range uniform keys), run ONE more pass and
+    try again; only then fall back to the remaining fixed passes.
+
+    ``pred(pop)`` is the fits-the-budget test; ``step(p, state) -> (state,
+    pop)`` runs pass p; ``finish_small(resolved_passes)`` / ``finish_full_from(p0)``
+    build the cond branch functions over ``state``.
+    """
+    if ncut + 1 < npasses:
+        def rung2(state):
+            state, pop = step(ncut, state)
+            return jax.lax.cond(
+                pred(pop), finish_small(ncut + 1), finish_full_from(ncut + 1),
+                state,
+            )
+
+        return jax.lax.cond(pred(pop0), finish_small(ncut), rung2, state)
+    return jax.lax.cond(
+        pred(pop0), finish_small(ncut), finish_full_from(ncut), state
+    )
+
+
 def _rank_block_search(off, target):
     """First index b with ``off[b] >= target`` for each target — the
     slot->block mapping of the collect. Semantically
@@ -231,6 +272,47 @@ def _collect_prefix_matches(
     return jnp.where(jj < pop, vals, maxkey), pop
 
 
+def collect_view(dtype, u, tiles, tiles_n, key_op):
+    """``(u_collect, n_collect, key_of)`` — the view `_collect_prefix_matches`
+    should scan for a prepared selection state, shared by the single-chip
+    descent (`_Descent`) and the distributed shard functions
+    (parallel/radix.py).
+
+    Raw tiles (``key_op != "none"``) are consumed as-is with an on-the-fly
+    bits->key transform (elementwise, so XLA fuses it into the compares and
+    never materializes the keys); key-space uint32 tiles are consumed
+    directly (sharing the kernels' buffer across the cutover ``cond``);
+    anything else (sub-32-bit keys, non-pallas methods) scans the 1-D key
+    array ``u``.
+    """
+    if key_op != "none":
+        u_collect = tiles[0] if len(tiles) == 1 else (tiles[0], tiles[1])
+
+        def key_of(raw_bits):
+            if isinstance(raw_bits, tuple):
+                hi, lo = raw_bits
+                raw64 = jax.lax.shift_left(
+                    hi.astype(jnp.uint64), jnp.uint64(32)
+                ) | lo.astype(jnp.uint64)
+                # pure integer transform on the recombined bits — no value
+                # round trip (a bitcast to f64 and back would also hit the
+                # TPU compiler's broken f64-source bitcast, utils/dtypes.py)
+                return _dt.sortable_from_raw_bits(raw64, dtype)
+            # 32-bit raw tiles keep x's own dtype — transform directly
+            return _dt.to_sortable_bits(raw_bits)
+
+        return u_collect, tiles_n, key_of
+    kdt = jnp.dtype(_dt.key_dtype(dtype))
+    if tiles is not None and len(tiles) == 1 and kdt == jnp.uint32:
+        # 32-bit: the collect scans the 2-D tiles tensor itself (the same
+        # uint32 buffer the kernels read) so `u` fuses away. Sub-32-bit
+        # keys keep the native-width `u`: the tiles are widened uint32, so
+        # collecting from them would shift by the wrong key width and
+        # return the wrong dtype.
+        return tiles[0], tiles_n, None
+    return u, None, None
+
+
 def bucket_walk_step(hist, kk, prefix, kdt, radix_bits):
     """One descent step on a (global) bucket histogram: pick the bucket
     containing the k-th element, rebase k within it, extend the prefix.
@@ -285,15 +367,9 @@ class _Descent:
         self.npasses = total_bits // radix_bits
         self.cdt = select_count_dtype(n)
         self.kdt = jnp.dtype(_dt.key_dtype(x.dtype))
-        # power-of-two >= 8 keeps every kernel invariant: the SWAR group
-        # loop consumes whole 8-row groups (a non-multiple silently drops
-        # tail rows), and the VMEM caps (_cap_block_rows/_multi_block_rows,
-        # 1024/4096) then always divide the prepared tiling in whichever
-        # direction the min() resolves
-        if block_rows < 8 or block_rows & (block_rows - 1):
-            raise ValueError(
-                f"block_rows={block_rows} must be a power of two >= 8"
-            )
+        from mpi_k_selection_tpu.ops.histogram import check_block_rows
+
+        check_block_rows(block_rows)  # the kernels' shared geometry contract
         self.block_rows = block_rows
 
         from mpi_k_selection_tpu.ops.histogram import prepare_keys, prepare_raw
@@ -309,48 +385,17 @@ class _Descent:
         if raw is not None:
             self.tiles, self.tiles_n, self.key_op, self.key_xor = raw
             self.u = None
-            # the collect scans the raw tiles, mapping bits to keys on the
-            # fly (XLA fuses the elementwise transform into the compare)
-            if len(self.tiles) == 1:
-                self.u_collect = self.tiles[0]
-            else:
-                self.u_collect = (self.tiles[0], self.tiles[1])
-            self.n_collect = self.tiles_n
-            dtype = x.dtype
-
-            def key_of(raw_bits):
-                if isinstance(raw_bits, tuple):
-                    hi, lo = raw_bits
-                    raw64 = jax.lax.shift_left(
-                        hi.astype(jnp.uint64), jnp.uint64(32)
-                    ) | lo.astype(jnp.uint64)
-                    return _dt.to_sortable_bits(
-                        jax.lax.bitcast_convert_type(raw64, dtype)
-                    )
-                # 32-bit raw tiles keep x's own dtype — transform directly
-                return _dt.to_sortable_bits(raw_bits)
-
-            self.key_of = key_of
         else:
             self.key_op, self.key_xor = "none", 0
             self.u = _dt.to_sortable_bits(x)
             self.tiles, self.tiles_n = prepare_keys(hist_method, self.u, block_rows)
-            self.key_of = None
-            if (
-                self.tiles is not None
-                and len(self.tiles) == 1
-                and self.kdt == jnp.uint32
-            ):
-                # 32-bit: the collect scans the 2-D tiles tensor itself
-                # (the same uint32 buffer the kernels read) so `u` fuses
-                # away and the cutover cond's branches share one full-size
-                # buffer. Sub-32-bit keys keep the native-width `u`: the
-                # tiles are widened uint32, so collecting from them would
-                # shift by the wrong key width and return the wrong dtype.
-                self.u_collect = self.tiles[0]
-                self.n_collect = self.tiles_n
-            else:
-                self.u_collect, self.n_collect = self.u, None
+        # the collect consumes the very buffers the kernels read (see
+        # collect_view) so the cutover cond's branches share one full-size
+        # tensor; a separate view made XLA rematerialize a second full-size
+        # copy inside the branch (OOM at the 1B int32 config)
+        self.u_collect, self.n_collect, self.key_of = collect_view(
+            x.dtype, self.u, self.tiles, self.tiles_n, self.key_op
+        )
 
         # count-kernel collect (pallas): per-subblock match counts in one
         # streaming read for all queries — XLA's jnp formulation of the
@@ -453,6 +498,54 @@ def _collect_via_counts(prep, resolved_passes: int, prefixes, budget: int):
     return jnp.where(jj[None, :] < pops[:, None], vals, maxkey), pops
 
 
+def _f64_tpu_host_keys(x):
+    """Exact uint64 sortable keys for a CONCRETE float64 array on the TPU
+    backend, or None when the trick does not apply.
+
+    TPU f64 is double-double emulation (~49-bit effective mantissa): every
+    f64-source bitcast crashes its compiler, computed f64 truncates, and
+    even ``device_put`` of an f64 array loses the low mantissa bits in
+    device storage (all measured on v5e). So the exact route never lets
+    f64 touch the device: a zero-copy numpy view-cast on host, then the
+    order-preserving transform as pure integer ops; the select runs
+    entirely in uint64 key space on device and the answer key converts
+    back on host (:func:`_f64_from_keys_host`).
+
+    Exactness contract: bit-exact for HOST-resident inputs (numpy arrays —
+    the CLI/datagen path). A device-resident f64 input was already
+    truncated by device storage before this function can see it; selection
+    is then exact with respect to the array's actual device contents.
+    """
+    if jax.default_backend() != "tpu":
+        return None
+    if isinstance(x, jax.core.Tracer):
+        return None
+    if np.dtype(x.dtype) != np.float64:
+        return None
+    # same x64 requirement (and error) as the traced path: without it,
+    # jnp.asarray would silently truncate the uint64 keys to uint32
+    _dt._require_x64(np.float64)
+    raw = np.asarray(x).reshape(-1).view(np.uint64)
+    neg = (raw >> np.uint64(63)) != 0
+    keys = np.where(neg, ~raw, raw | np.uint64(1 << 63))
+    return jnp.asarray(keys)
+
+
+def _f64_from_keys_host(ans):
+    """Inverse of :func:`_f64_tpu_host_keys` for the answer key(s), computed
+    on host, returned as a HOST (numpy) array: putting the result back on
+    the TPU would truncate it again — f64 device storage itself is ~49-bit
+    (measured), so the exact value can only live host-side. Callers treat
+    it like any array result (float()/np.asarray() both work)."""
+    k = np.asarray(ans)
+    shape = k.shape
+    k = k.reshape(-1)
+    msb = np.uint64(1) << np.uint64(63)
+    neg = (k & msb) == 0  # keys below MSB came from negative floats
+    raw = np.where(neg, ~k, k & ~msb)
+    return np.ascontiguousarray(raw).view(np.float64).reshape(shape)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(
@@ -465,7 +558,7 @@ def _collect_via_counts(prep, resolved_passes: int, prefixes, budget: int):
         "block_rows",
     ),
 )
-def radix_select(
+def _radix_select_traced(
     x: jax.Array,
     k,
     *,
@@ -510,14 +603,8 @@ def radix_select(
     early = early_exit_budget is not None and n > early_exit_budget
     if early:
         ncut = None  # research path below
-    elif cutover == "auto":
-        ncut = cutover_passes(n, total_bits, radix_bits, cutover_budget)
-    elif cutover is None:
-        ncut = None
     else:
-        ncut = int(cutover)
-        if not 1 <= ncut < npasses:
-            raise ValueError(f"cutover={ncut} out of range [1, {npasses - 1}]")
+        ncut = resolve_cutover(cutover, n, total_bits, radix_bits, cutover_budget)
 
     if ncut is not None:
         prefix = jnp.zeros((), kdt)
@@ -553,40 +640,24 @@ def radix_select(
 
             return fn
 
-        # runtime ladder: try the collect after ncut passes; if the
-        # population still overflows the budget (dense/skewed data — the
-        # static ncut models full-range uniform keys), run ONE more pass
-        # and try again; only then fall back to the remaining fixed passes
-        def rung2(args):
+        def finish_full_from(p0):
+            def fn(args):
+                prefix, kk = args
+                for p in range(p0, npasses):
+                    prefix, kk, _ = one_pass(p, prefix, kk)
+                return prefix
+
+            return fn
+
+        def step(p, args):
             prefix, kk = args
-            prefix, kk, pop = one_pass(ncut, prefix, kk)
+            prefix, kk, pop = one_pass(p, prefix, kk)
+            return (prefix, kk), pop
 
-            def finish_full(args):
-                prefix, kk = args
-                for p in range(ncut + 1, npasses):
-                    prefix, kk, _ = one_pass(p, prefix, kk)
-                return prefix
-
-            return jax.lax.cond(
-                pop <= cutover_budget, finish_small(ncut + 1), finish_full,
-                (prefix, kk),
-            )
-
-        if ncut + 1 < npasses:
-            ans = jax.lax.cond(
-                pop <= cutover_budget, finish_small(ncut), rung2, (prefix, kk)
-            )
-        else:
-            def finish_full(args):
-                prefix, kk = args
-                for p in range(ncut, npasses):
-                    prefix, kk, _ = one_pass(p, prefix, kk)
-                return prefix
-
-            ans = jax.lax.cond(
-                pop <= cutover_budget, finish_small(ncut), finish_full,
-                (prefix, kk),
-            )
+        ans = run_cutover_ladder(
+            ncut, npasses, pop, lambda q: q <= cutover_budget, step,
+            finish_small, finish_full_from, (prefix, kk),
+        )
         return _dt.from_sortable_bits(ans, x.dtype)
 
     if not early:
@@ -623,6 +694,20 @@ def radix_select(
         pop > early_exit_budget, lambda _: prefix, finish_small, operand=None
     )
     return _dt.from_sortable_bits(ans, x.dtype)
+
+
+def radix_select(x, k, **kwargs):
+    """Exact k-th smallest element of ``x`` (1-indexed). Thin eager shell
+    over the jitted descent (:func:`_radix_select_traced` — see it for all
+    keyword options): concrete float64 inputs on TPU are routed through
+    exact host-derived uint64 keys (:func:`_f64_tpu_host_keys`); everything
+    else goes straight through. Inside a user ``jit`` the shell is traced
+    away and f64-on-TPU falls back to the documented ~49-bit key
+    approximation (utils/dtypes.py:f64_raw_bits)."""
+    keys = _f64_tpu_host_keys(x)
+    if keys is not None:
+        return _f64_from_keys_host(_radix_select_traced(keys, k, **kwargs))
+    return _radix_select_traced(x, k, **kwargs)
 
 
 def _collect_prefix_matches_multi(
@@ -700,7 +785,7 @@ def _collect_prefix_matches_multi(
         "block_rows",
     ),
 )
-def radix_select_many(
+def _radix_select_many_traced(
     x: jax.Array,
     ks,
     *,
@@ -769,14 +854,7 @@ def radix_select_many(
         )
         return bucket_walk_step_multi(hist, kk, prefixes, kdt, radix_bits)
 
-    if cutover == "auto":
-        ncut = cutover_passes(n, total_bits, radix_bits, cutover_budget)
-    elif cutover is None:
-        ncut = None
-    else:
-        ncut = int(cutover)
-        if not 1 <= ncut < npasses:
-            raise ValueError(f"cutover={ncut} out of range [1, {npasses - 1}]")
+    ncut = resolve_cutover(cutover, n, total_bits, radix_bits, cutover_budget)
 
     if ncut is None:
         for p in range(1, npasses):
@@ -818,27 +896,24 @@ def radix_select_many(
 
             return fn
 
-        # same 2-rung runtime ladder as radix_select: collect after ncut
-        # passes, else one more pass and a second attempt, else the rest
-        if ncut + 1 < npasses:
-            def rung2(args):
-                prefixes, kk = args
-                prefixes, kk, pops = multi_pass(ncut, prefixes, kk)
-                return jax.lax.cond(
-                    jnp.max(pops) <= cutover_budget,
-                    finish_small(ncut + 1), finish_full_from(ncut + 1),
-                    (prefixes, kk),
-                )
+        def step(p, args):
+            prefixes, kk = args
+            prefixes, kk, pops = multi_pass(p, prefixes, kk)
+            return (prefixes, kk), pops
 
-            ans = jax.lax.cond(
-                jnp.max(pops) <= cutover_budget, finish_small(ncut), rung2,
-                (prefixes, kk),
-            )
-        else:
-            ans = jax.lax.cond(
-                jnp.max(pops) <= cutover_budget,
-                finish_small(ncut), finish_full_from(ncut),
-                (prefixes, kk),
-            )
+        ans = run_cutover_ladder(
+            ncut, npasses, pops, lambda q: jnp.max(q) <= cutover_budget,
+            step, finish_small, finish_full_from, (prefixes, kk),
+        )
     ans = _dt.from_sortable_bits(ans, x.dtype)
     return ans.reshape(ks_arr.shape)
+
+
+def radix_select_many(x, ks, **kwargs):
+    """Exact k-th smallest for every k in ``ks``. Same eager shell as
+    :func:`radix_select` (exact f64-on-TPU via host-derived keys); see
+    :func:`_radix_select_many_traced` for the descent and options."""
+    keys = _f64_tpu_host_keys(x)
+    if keys is not None:
+        return _f64_from_keys_host(_radix_select_many_traced(keys, ks, **kwargs))
+    return _radix_select_many_traced(x, ks, **kwargs)

@@ -32,15 +32,20 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from mpi_k_selection_tpu.ops.histogram import masked_radix_histogram
 from mpi_k_selection_tpu.ops.radix import (
+    _collect_prefix_matches,
+    _collect_prefix_matches_multi,
     bucket_walk_step,
+    collect_view,
     default_radix_bits,
+    resolve_cutover,
+    run_cutover_ladder,
     select_count_dtype,
 )
 from mpi_k_selection_tpu.parallel import mesh as mesh_lib
 from mpi_k_selection_tpu.utils import debug as _debug, dtypes as _dt
 
 
-def _prep_shard(hist_method, xs):
+def _prep_shard(hist_method, xs, block_rows=4096):
     """Per-shard kernel-view prep: raw tiles + in-kernel key fold when
     available (saves the per-shard to_sortable pass — see
     ops/histogram.py:prepare_raw), key-space tiles otherwise. Returns
@@ -48,37 +53,91 @@ def _prep_shard(hist_method, xs):
     path."""
     from mpi_k_selection_tpu.ops.histogram import prepare_keys, prepare_raw
 
-    raw = prepare_raw(hist_method, xs)
+    raw = prepare_raw(hist_method, xs, block_rows)
     if raw is not None:
         tiles, tiles_n, key_op, key_xor = raw
         return None, tiles, tiles_n, key_op, key_xor
     u = _dt.to_sortable_bits(xs)
-    tiles, tiles_n = prepare_keys(hist_method, u)
+    tiles, tiles_n = prepare_keys(hist_method, u, block_rows)
     return u, tiles, tiles_n, "none", 0
 
 
+def _shard_map_check_vma(hist_method, total_bits) -> bool:
+    """shard_map's varying-manual-axes checking stays on everywhere except
+    interpret-mode pallas: interpret re-evaluates the kernel jaxpr under vma
+    tracking, where in-kernel constants (traced without vma) cannot be
+    reconciled with the varying block operands (JAX's own error suggests
+    check_vma=False as the workaround). On real TPU the kernel is an opaque
+    custom call and checking works."""
+    import numpy as np
+
+    from mpi_k_selection_tpu.ops.histogram import resolve_hist_method
+
+    kd = np.dtype(np.uint32) if total_bits <= 32 else np.dtype(np.uint64)
+    method = resolve_hist_method(hist_method, kd)
+    is_pallas = method in ("pallas", "pallas_compare", "pallas64", "pallas64_compare")
+    return not (is_pallas and jax.default_backend() != "tpu")
+
+
+def _f64_host_key_route(x):
+    """(keys, decode) when the f64-on-TPU exact route applies, else
+    (x, None): the distributed entries are eager (k must be concrete), so
+    the same host view-cast trick the single-chip wrapper uses
+    (ops/radix.py:_f64_tpu_host_keys) keeps the two public entry points
+    consistent — without it, device_put would truncate the f64 input to
+    the TPU's ~49-bit storage and the distributed result would disagree
+    with radix_select on identical input."""
+    from mpi_k_selection_tpu.ops.radix import _f64_from_keys_host, _f64_tpu_host_keys
+
+    keys = _f64_tpu_host_keys(x)
+    if keys is None:
+        return x, None
+    return keys, _f64_from_keys_host
+
+
 @functools.lru_cache(maxsize=64)
-def _jitted_select(mesh, n, total_bits, cdt, radix_bits, hist_method, chunk):
+def _jitted_select(
+    mesh, n, total_bits, cdt, radix_bits, hist_method, chunk, ncut,
+    cutover_budget, block_rows,
+):
     """Build-and-cache the jitted sharded program for one (mesh, config).
 
     Rebuilding shard_map + jit per call would force a retrace/recompile on
     every invocation (jit caches are per jit *object*); caching here makes
     repeat calls hit the XLA executable cache like any other jitted fn.
+
+    ``ncut`` enables the distributed cutover ladder: after ``ncut`` passes
+    one replicated ``lax.cond`` on the surviving population (free — it is
+    the chosen bucket's psummed count) either collects up to
+    ``cutover_budget`` candidates PER SHARD, ``all_gather``s them (still
+    O(budget) comm — the population bound is global, so every shard's match
+    count fits the budget) and sort-indexes the replicated result, or runs
+    one more pass and tries again, or falls back to the remaining fixed
+    passes. This is the reference CGM's sequential finish
+    (``TODO-kth-problem-cgm.c:122, 236-280``) — gather the small survivor
+    set, solve locally — with the survivors identified by radix prefix
+    instead of physical discards: 64-bit keys run ~6-8 psum rounds instead
+    of 16.
     """
     axis = mesh.axis_names[0]
+    npasses = total_bits // radix_bits
+    check_vma = _shard_map_check_vma(hist_method, total_bits)
 
     def shard_fn(xs, kk):
-        u, tiles, tiles_n, key_op, key_xor = _prep_shard(hist_method, xs.ravel())
+        xs = xs.ravel()
+        u, tiles, tiles_n, key_op, key_xor = _prep_shard(
+            hist_method, xs, block_rows
+        )
         kdt = jnp.dtype(_dt.key_dtype(xs.dtype))
         kk = jnp.clip(kk.astype(cdt), 1, n)
-        prefix = None
-        for p in range(total_bits // radix_bits):
+
+        def one_pass(p, prefix, kk):
             shift = total_bits - (p + 1) * radix_bits
             local = masked_radix_histogram(
                 u,
                 shift=shift,
                 radix_bits=radix_bits,
-                prefix=prefix,
+                prefix=prefix if p else None,
                 method=hist_method,
                 count_dtype=cdt,
                 chunk=chunk,
@@ -86,12 +145,77 @@ def _jitted_select(mesh, n, total_bits, cdt, radix_bits, hist_method, chunk):
                 orig_n=tiles_n,
                 key_op=key_op,
                 key_xor=key_xor,
+                block_rows=block_rows,
             )
             hist = jax.lax.psum(local, axis)  # the MPI_Allreduce analogue (TODO-…:190)
-            prefix, kk, _ = bucket_walk_step(hist, kk, prefix, kdt, radix_bits)
-        return _dt.from_sortable_bits(prefix, xs.dtype)
+            return bucket_walk_step(hist, kk, prefix if p else None, kdt, radix_bits)
 
-    fn = jax.shard_map(shard_fn, mesh=mesh, in_specs=(P(axis), P()), out_specs=P())
+        prefix = jnp.zeros((), kdt)
+        if ncut is None:
+            for p in range(npasses):
+                prefix, kk, _ = one_pass(p, prefix, kk)
+            return _dt.from_sortable_bits(prefix, xs.dtype)
+
+        pop = jnp.asarray(n, cdt)
+        for p in range(ncut):
+            prefix, kk, pop = one_pass(p, prefix, kk)
+
+        u_collect, n_collect, key_of = collect_view(
+            xs.dtype, u, tiles, tiles_n, key_op
+        )
+
+        def finish_small(resolved_passes):
+            resolved = jnp.asarray(resolved_passes * radix_bits, jnp.int32)
+
+            def fn(args):
+                prefix, kk = args
+                cand, _pop = _collect_prefix_matches(
+                    u_collect, resolved, prefix, cutover_budget, block=128,
+                    n_valid=n_collect, key_of=key_of,
+                )
+                # the final-gather analogue (TODO-…:270): O(budget) values
+                # per shard, replicated result — no bulk data movement
+                allc = jax.lax.all_gather(cand, axis, tiled=True)
+                return jax.lax.sort(allc)[
+                    jnp.clip(kk - 1, 0, allc.shape[0] - 1)
+                ]
+
+            return fn
+
+        def finish_full_from(p0):
+            def fn(args):
+                prefix, kk = args
+                for p in range(p0, npasses):
+                    prefix, kk, _ = one_pass(p, prefix, kk)
+                # match the collect branch's varying-manual-axes type (the
+                # all_gather output is device-varying to the type system
+                # even though its value is replicated)
+                return jax.lax.pcast(prefix, axis, to="varying") if check_vma else prefix
+
+            return fn
+
+        def step(p, args):
+            prefix, kk = args
+            prefix, kk, pop = one_pass(p, prefix, kk)
+            return (prefix, kk), pop
+
+        # the predicate is a psummed (replicated) scalar, so every shard
+        # takes the same branch and in-branch collectives stay collective
+        ans = run_cutover_ladder(
+            ncut, npasses, pop, lambda q: q <= cutover_budget, step,
+            finish_small, finish_full_from, (prefix, kk),
+        )
+        # every shard holds the same answer; the pmax re-establishes the
+        # invariant (replicated) type for out_specs=P() at the cost of one
+        # scalar collective
+        if check_vma:
+            ans = jax.lax.pmax(ans, axis)
+        return _dt.from_sortable_bits(ans, xs.dtype)
+
+    fn = jax.shard_map(
+        shard_fn, mesh=mesh, in_specs=(P(axis), P()), out_specs=P(),
+        check_vma=check_vma,
+    )
     return jax.jit(fn)
 
 
@@ -103,12 +227,23 @@ def distributed_radix_select(
     radix_bits: int | None = None,
     hist_method: str = "auto",
     chunk: int = 32768,
+    cutover: int | str | None = "auto",
+    cutover_budget: int = 8192,
+    block_rows: int = 4096,
 ):
-    """Exact k-th smallest (1-indexed) of sharded ``x``; replicated scalar out."""
+    """Exact k-th smallest (1-indexed) of sharded ``x``; replicated scalar out.
+
+    ``cutover``/``cutover_budget`` enable the distributed sequential-finish
+    ladder (see ``_jitted_select``); semantics match
+    ops/radix.py:radix_select. Collected sentinel pads are value-safe: they
+    carry the order-maximal key, so they sort after every real candidate
+    (or tie it exactly, in which case the value is right either way).
+    """
     if mesh is None:
         mesh = mesh_lib.make_mesh()
     mesh_lib.require_distributed(mesh)
 
+    x, decode = _f64_host_key_route(x)
     x = jnp.ravel(jnp.asarray(x))
     _debug.check_concrete_k(k, x.shape[0])
     if radix_bits is None:
@@ -120,30 +255,52 @@ def distributed_radix_select(
     total_bits = _dt.key_bits(x.dtype)
     if total_bits % radix_bits:
         raise ValueError(f"radix_bits={radix_bits} must divide {total_bits}")
+    from mpi_k_selection_tpu.ops.histogram import check_block_rows
 
-    fn = _jitted_select(mesh, n, total_bits, cdt, radix_bits, hist_method, chunk)
+    check_block_rows(block_rows)
+    ncut = resolve_cutover(
+        cutover, x.shape[0], total_bits, radix_bits, cutover_budget
+    )
+
+    fn = _jitted_select(
+        mesh, n, total_bits, cdt, radix_bits, hist_method, chunk, ncut,
+        cutover_budget, block_rows,
+    )
     xs = jax.device_put(x, NamedSharding(mesh, P(mesh.axis_names[0])))
     kk = jnp.asarray(k, cdt)
-    return fn(xs, kk)
+    ans = fn(xs, kk)
+    return decode(ans) if decode is not None else ans
 
 
 @functools.lru_cache(maxsize=64)
-def _jitted_select_many(mesh, n, total_bits, cdt, radix_bits, hist_method, chunk):
+def _jitted_select_many(
+    mesh, n, total_bits, cdt, radix_bits, hist_method, chunk, ncut,
+    cutover_budget, block_rows,
+):
     """Sharded multi-rank selection: the shard's tiled view and the
     prefix-free first pass (one local histogram + one ``psum``) are shared
     by every query, and each later pass runs ALL K queries through one
     shared sweep of the shard (the multi-prefix kernels) followed by one
     ``psum`` of the (K, nbuckets) counts — the shard is read ``npasses``
     times total instead of ``1 + K * (npasses - 1)``, and communication
-    stays one small psum per pass for the whole batch."""
+    stays one small psum per pass for the whole batch.
+
+    ``ncut``: the distributed cutover ladder, batched — one replicated cond
+    on the LARGEST query population; the collect branch gathers
+    ``cutover_budget`` candidates per query per shard and finishes every
+    query with one replicated batched sort (see ``_jitted_select``)."""
     axis = mesh.axis_names[0]
     npasses = total_bits // radix_bits
+    check_vma = _shard_map_check_vma(hist_method, total_bits)
 
     def shard_fn(xs, ks):
         from mpi_k_selection_tpu.ops.histogram import multi_masked_radix_histogram
         from mpi_k_selection_tpu.ops.radix import bucket_walk_step_multi
 
-        u, tiles, tiles_n, key_op, key_xor = _prep_shard(hist_method, xs.ravel())
+        xs = xs.ravel()
+        u, tiles, tiles_n, key_op, key_xor = _prep_shard(
+            hist_method, xs, block_rows
+        )
         kdt = jnp.dtype(_dt.key_dtype(xs.dtype))
 
         hist0 = jax.lax.psum(
@@ -159,12 +316,14 @@ def _jitted_select_many(mesh, n, total_bits, cdt, radix_bits, hist_method, chunk
                 orig_n=tiles_n,
                 key_op=key_op,
                 key_xor=key_xor,
+                block_rows=block_rows,
             ),
             axis,
         )
         kk = jnp.clip(ks.astype(cdt), 1, n)
-        prefixes, kk, _ = bucket_walk_step_multi(hist0, kk, None, kdt, radix_bits)
-        for p in range(1, npasses):
+        prefixes, kk, pops = bucket_walk_step_multi(hist0, kk, None, kdt, radix_bits)
+
+        def multi_pass(p, prefixes, kk):
             shift = total_bits - (p + 1) * radix_bits
             local = multi_masked_radix_histogram(
                 u,
@@ -178,14 +337,66 @@ def _jitted_select_many(mesh, n, total_bits, cdt, radix_bits, hist_method, chunk
                 orig_n=tiles_n,
                 key_op=key_op,
                 key_xor=key_xor,
+                block_rows=block_rows,
             )
             hist = jax.lax.psum(local, axis)  # (K, nbuckets), one collective
-            prefixes, kk, _ = bucket_walk_step_multi(
-                hist, kk, prefixes, kdt, radix_bits
-            )
-        return _dt.from_sortable_bits(prefixes, xs.dtype)
+            return bucket_walk_step_multi(hist, kk, prefixes, kdt, radix_bits)
 
-    fn = jax.shard_map(shard_fn, mesh=mesh, in_specs=(P(axis), P()), out_specs=P())
+        if ncut is None:
+            for p in range(1, npasses):
+                prefixes, kk, pops = multi_pass(p, prefixes, kk)
+            return _dt.from_sortable_bits(prefixes, xs.dtype)
+
+        for p in range(1, ncut):
+            prefixes, kk, pops = multi_pass(p, prefixes, kk)
+
+        u_collect, n_collect, key_of = collect_view(
+            xs.dtype, u, tiles, tiles_n, key_op
+        )
+
+        def finish_small(resolved_passes):
+            resolved = jnp.asarray(resolved_passes * radix_bits, jnp.int32)
+
+            def fn(args):
+                prefixes, kk = args
+                cand, _pops = _collect_prefix_matches_multi(
+                    u_collect, resolved, prefixes, cutover_budget,
+                    n_valid=n_collect, key_of=key_of,
+                )  # (K, budget) per shard
+                allc = jax.lax.all_gather(cand, axis, axis=1, tiled=True)
+                s = jnp.sort(allc, axis=1)  # (K, mesh_size * budget)
+                idx = jnp.clip(kk - 1, 0, s.shape[1] - 1)
+                return jnp.take_along_axis(s, idx[:, None], axis=1)[:, 0]
+
+            return fn
+
+        def finish_full_from(p0):
+            def fn(args):
+                prefixes, kk = args
+                for p in range(p0, npasses):
+                    prefixes, kk, _ = multi_pass(p, prefixes, kk)
+                # type-match the collect branch (see _jitted_select)
+                return jax.lax.pcast(prefixes, axis, to="varying") if check_vma else prefixes
+
+            return fn
+
+        def step(p, args):
+            prefixes, kk = args
+            prefixes, kk, pops = multi_pass(p, prefixes, kk)
+            return (prefixes, kk), pops
+
+        ans = run_cutover_ladder(
+            ncut, npasses, pops, lambda q: jnp.max(q) <= cutover_budget,
+            step, finish_small, finish_full_from, (prefixes, kk),
+        )
+        if check_vma:
+            ans = jax.lax.pmax(ans, axis)  # replicated value -> invariant type
+        return _dt.from_sortable_bits(ans, xs.dtype)
+
+    fn = jax.shard_map(
+        shard_fn, mesh=mesh, in_specs=(P(axis), P()), out_specs=P(),
+        check_vma=check_vma,
+    )
     return jax.jit(fn)
 
 
@@ -197,6 +408,9 @@ def distributed_radix_select_many(
     radix_bits: int | None = None,
     hist_method: str = "auto",
     chunk: int = 32768,
+    cutover: int | str | None = "auto",
+    cutover_budget: int = 8192,
+    block_rows: int = 4096,
 ):
     """Exact k-th smallest of sharded ``x`` for every (1-indexed) k in
     ``ks``; replicated vector out, in ``ks`` order."""
@@ -204,6 +418,7 @@ def distributed_radix_select_many(
         mesh = mesh_lib.make_mesh()
     mesh_lib.require_distributed(mesh)
 
+    x, decode = _f64_host_key_route(x)
     x = jnp.ravel(jnp.asarray(x))
     ks_arr = jnp.atleast_1d(jnp.asarray(ks))
     _debug.check_concrete_ks(ks_arr, x.shape[0])
@@ -214,7 +429,17 @@ def distributed_radix_select_many(
     total_bits = _dt.key_bits(x.dtype)
     if total_bits % radix_bits:
         raise ValueError(f"radix_bits={radix_bits} must divide {total_bits}")
+    from mpi_k_selection_tpu.ops.histogram import check_block_rows
 
-    fn = _jitted_select_many(mesh, n, total_bits, cdt, radix_bits, hist_method, chunk)
+    check_block_rows(block_rows)
+    ncut = resolve_cutover(
+        cutover, x.shape[0], total_bits, radix_bits, cutover_budget
+    )
+
+    fn = _jitted_select_many(
+        mesh, n, total_bits, cdt, radix_bits, hist_method, chunk, ncut,
+        cutover_budget, block_rows,
+    )
     xs = jax.device_put(x, NamedSharding(mesh, P(mesh.axis_names[0])))
-    return fn(xs, ks_arr.astype(cdt).ravel()).reshape(ks_arr.shape)
+    ans = fn(xs, ks_arr.astype(cdt).ravel()).reshape(ks_arr.shape)
+    return decode(ans) if decode is not None else ans

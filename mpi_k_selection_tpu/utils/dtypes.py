@@ -88,6 +88,69 @@ def key_fold(dtype):
     return ("float",)
 
 
+def f64_raw_bits(x: jax.Array) -> jax.Array:
+    """IEEE-754 binary64 bit pattern of ``x`` as uint64, computed WITHOUT a
+    float64-source bitcast.
+
+    The TPU toolchain rejects every ``bitcast_convert_type`` whose source is
+    f64 (the compile helper crashes — f64 is software-emulated on the VPU and
+    its storage has no bitcast lowering), which would make float64 selection
+    impossible on the very backend this framework targets. This reconstructs
+    the bits arithmetically from primitives that DO lower: f64 compares,
+    exact power-of-two multiplies, and value-converts to uint64.
+
+    Method: predicated binary normalization of ``|x|`` into ``v * 2^e`` with
+    ``v in [1, 2)`` (descending power-of-two ladder, every multiply exact),
+    then mantissa = ``(v - 1) * 2^52``. Exact for every NORMAL value
+    including -0.0 (sign recovered via ``1/x`` when ``x == 0``) and for
+    infinities; NaNs canonicalize to +0x7FF8000000000000 (payload and NaN
+    sign not preserved — the same deviation class the NaN-ordering note
+    above documents). Denormals collapse to the matching signed zero: XLA
+    flushes f64 denormals to zero in compiled arithmetic (measured on both
+    CPU and TPU), so no arithmetic reconstruction can see their bits —
+    order degrades only by tying denormals with +-0.0, and a selection
+    whose k-th order statistic IS a denormal returns +-0.0 instead. The
+    bitcast backends (CPU/seq oracle) remain bit-exact.
+    """
+    ax = jnp.abs(x)
+    neg = jnp.where(x != 0.0, x < 0.0, (1.0 / x) < 0.0)
+    v = ax
+    e = jnp.zeros(x.shape, jnp.int32)
+    for k in (512, 256, 128, 64, 32, 16, 8, 4, 2, 1):
+        big = v >= 2.0**k
+        v = jnp.where(big, v * 2.0**-k, v)
+        e = jnp.where(big, e + k, e)
+    # scale small values up (normals only reach 2^-1022; denormals are
+    # already flushed to zero by XLA before this ladder can see them)
+    for k in (512, 512, 256, 128, 64, 32, 16, 8, 4, 2, 1):
+        small = v < 2.0 ** (1 - k)
+        v = jnp.where(small, v * 2.0**k, v)
+        e = jnp.where(small, e - k, e)
+    normal = e >= -1022
+    f_norm = ((v - 1.0) * 2.0**52).astype(jnp.uint64)
+    E = jnp.where(normal, e + 1023, 0).astype(jnp.uint64)
+    # non-normal finite = zero or a denormal FTZ'd to zero upstream: bits 0
+    bits = jnp.where(
+        normal,
+        jax.lax.shift_left(E, jnp.uint64(52)) | f_norm,
+        jnp.uint64(0),
+    )
+    bits = jnp.where(jnp.isinf(x), jnp.uint64(0x7FF) << jnp.uint64(52), bits)
+    bits = jnp.where(neg, bits | jnp.uint64(1) << jnp.uint64(63), bits)
+    # NaN last (and unsigned): canonical quiet NaN
+    bits = jnp.where(jnp.isnan(x), jnp.uint64(0x7FF8000000000000), bits)
+    return bits
+
+
+def f64_to_u64_bits(x: jax.Array) -> jax.Array:
+    """Raw uint64 bits of a float64 array: a plain bitcast everywhere except
+    TPU, where bitcasts FROM f64 crash the compiler (see
+    :func:`f64_raw_bits`)."""
+    if jax.default_backend() == "tpu":
+        return f64_raw_bits(x)
+    return jax.lax.bitcast_convert_type(x, jnp.uint64)
+
+
 def _require_x64(dtype):
     if np.dtype(dtype).itemsize == 8 and not jax.config.jax_enable_x64:
         raise ValueError(
@@ -109,13 +172,39 @@ def to_sortable_bits(x: jax.Array) -> jax.Array:
     msb = kdt.type(msb)
     if jnp.issubdtype(dtype, jnp.unsignedinteger):
         return x
-    u = jax.lax.bitcast_convert_type(x, kdt)
+    if dtype == np.dtype(np.float64):
+        u = f64_to_u64_bits(x)  # f64-source bitcasts crash the TPU compiler
+    else:
+        u = jax.lax.bitcast_convert_type(x, kdt)
     if jnp.issubdtype(dtype, jnp.signedinteger):
         return u ^ msb
     # floating point
     all_ones = kdt.type(~np.uint64(0) >> np.uint64(64 - bits))
     neg = (u >> kdt.type(bits - 1)) != kdt.type(0)
     return jnp.where(neg, u ^ all_ones, u | msb)
+
+
+def sortable_from_raw_bits(raw: jax.Array, dtype) -> jax.Array:
+    """:func:`to_sortable_bits` taking the RAW bit pattern (already widened
+    to the key dtype) instead of values. Lets the collect paths map raw
+    kernel tiles to key space with pure integer ops — no value round trip,
+    and (for float64) no f64-source bitcast anywhere near the TPU compiler.
+    """
+    dtype = np.dtype(dtype)
+    kdt, bits = _KEY_INFO.get(dtype, (None, None))
+    if kdt is None:
+        raise TypeError(f"unsupported dtype for k-selection: {dtype}")
+    kdt = np.dtype(kdt)
+    if raw.dtype != kdt:
+        raise ValueError(f"raw bits must be {kdt}, got {raw.dtype}")
+    msb = kdt.type(np.uint64(1) << np.uint64(bits - 1))
+    if jnp.issubdtype(dtype, jnp.unsignedinteger):
+        return raw
+    if jnp.issubdtype(dtype, jnp.signedinteger):
+        return raw ^ msb
+    all_ones = kdt.type(~np.uint64(0) >> np.uint64(64 - bits))
+    neg = jax.lax.shift_right_logical(raw, kdt.type(bits - 1)) != kdt.type(0)
+    return jnp.where(neg, raw ^ all_ones, raw | msb)
 
 
 def from_sortable_bits(u: jax.Array, dtype) -> jax.Array:

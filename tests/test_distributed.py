@@ -178,3 +178,77 @@ def test_distributed_topk_outputs_replicated_on_all_devices(mesh8):
     _assert_replicated(vals)
     _assert_replicated(idx)
     np.testing.assert_array_equal(np.asarray(vals), np.sort(x)[::-1][:16])
+
+
+# ---------------------------------------------------------------------------
+# Distributed cutover ladder (the reference CGM's sequential finish,
+# TODO-kth-problem-cgm.c:122, 236-280, rebuilt as collect + all_gather):
+# forced small-n cutovers so every rung runs in CI — auto disables the
+# cutover below 2^20 elements.
+# ---------------------------------------------------------------------------
+
+
+def test_distributed_cutover_rung1(mesh8, rng):
+    n = 100_003  # ragged: sentinel padding composes with the collect
+    x = rng.integers(-(2**31), 2**31 - 1, size=n, dtype=np.int32)
+    want = np.sort(x, kind="stable")
+    for k in (1, n // 2, n):
+        got = int(distributed_radix_select(x, k, mesh=mesh8, cutover=2))
+        assert got == want[k - 1], k
+
+
+def test_distributed_cutover_rung2_and_full_branch(mesh8, rng):
+    n = 100_003
+    x = rng.integers(-(2**31), 2**31 - 1, size=n, dtype=np.int32)
+    # budget 64: rung 1 overflows (~n/256 survivors), rung 2 fits (~n/4096)
+    got = int(
+        distributed_radix_select(x, n // 2, mesh=mesh8, cutover=2, cutover_budget=64)
+    )
+    assert got == np.sort(x, kind="stable")[n // 2 - 1]
+    # dense data: both rungs overflow, the remaining fixed passes finish
+    xd = rng.integers(0, 200, size=50_001, dtype=np.int32)
+    got = int(
+        distributed_radix_select(xd, 25_000, mesh=mesh8, cutover=2, cutover_budget=64)
+    )
+    assert got == np.sort(xd, kind="stable")[24_999]
+
+
+def test_distributed_cutover_int64(mesh8, rng):
+    from mpi_k_selection_tpu.utils import x64
+
+    with x64.enable_x64():
+        n = 77_777
+        x = rng.integers(-(2**62), 2**62, size=n, dtype=np.int64)
+        want = np.sort(x, kind="stable")
+        for k in (1, n // 2, n):
+            got = int(distributed_radix_select(x, k, mesh=mesh8, cutover=3))
+            assert got == want[k - 1], k
+
+
+def test_distributed_select_many_cutover(mesh8, rng):
+    from mpi_k_selection_tpu.parallel import distributed_radix_select_many
+    from mpi_k_selection_tpu.utils import x64
+
+    with x64.enable_x64():
+        n = 77_777
+        x = rng.integers(-(2**62), 2**62, size=n, dtype=np.int64)
+        ks = np.array([1, n // 4, n // 2, n])
+        want = np.sort(x, kind="stable")[ks - 1]
+        got = np.asarray(
+            distributed_radix_select_many(x, ks, mesh=mesh8, cutover=3)
+        )
+        np.testing.assert_array_equal(got, want)
+        # tight budget: the batched ladder's rung-2/full branches
+        got = np.asarray(
+            distributed_radix_select_many(
+                x, ks, mesh=mesh8, cutover=3, cutover_budget=16
+            )
+        )
+        np.testing.assert_array_equal(got, want)
+
+
+def test_distributed_cutover_float32_ragged(mesh8, rng):
+    n = 64_007
+    x = rng.standard_normal(n).astype(np.float32)
+    got = float(distributed_radix_select(x, n // 2, mesh=mesh8, cutover=2))
+    assert got == np.sort(x, kind="stable")[n // 2 - 1]

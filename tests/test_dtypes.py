@@ -69,3 +69,67 @@ def test_int64_roundtrip_under_x64():
         np.testing.assert_array_equal(back, np.asarray(x))
         order_u = np.argsort(np.asarray(u), kind="stable")
         np.testing.assert_array_equal(np.asarray(x)[order_u], np.sort(np.asarray(x)))
+
+
+def test_f64_raw_bits_matches_bitcast_exhaustive():
+    """The arithmetic IEEE-bit construction (the TPU path — f64-source
+    bitcasts crash that compiler) must be bit-exact vs the real bitcast for
+    every exponent, both signs, denormals, -0.0, infinities; NaN
+    canonicalizes to +0x7FF8000000000000 by contract."""
+    import jax
+    import jax.numpy as jnp
+
+    from mpi_k_selection_tpu.utils.dtypes import f64_raw_bits
+
+    with jax.enable_x64(True):
+        rng = np.random.default_rng(99)
+        # every NORMAL binary exponent (XLA flushes f64 denormals to zero in
+        # compiled arithmetic, so the contract maps them to signed zero)
+        mant = 1.0 + rng.random(2046)          # [1, 2)
+        exps = np.arange(-1022, 1024)
+        vals = np.ldexp(mant, exps)
+        vals = np.concatenate([
+            vals, -vals,
+            np.array([0.0, -0.0, np.inf, -np.inf, np.finfo(np.float64).max,
+                      np.finfo(np.float64).tiny]),
+            rng.standard_normal(4096),
+        ])
+        got = np.asarray(f64_raw_bits(jnp.asarray(vals)))
+        want = vals.view(np.uint64)
+        np.testing.assert_array_equal(got, want)
+        # denormals -> signed zero (FTZ contract), NaN -> canonical quiet NaN
+        spec = np.array([5e-324, -5e-324, 1e-310, np.nan, -np.nan])
+        got_s = np.asarray(f64_raw_bits(jnp.asarray(spec)))
+        want_s = np.array(
+            [0, 1 << 63, 0, 0x7FF8000000000000, 0x7FF8000000000000],
+            dtype=np.uint64,
+        )
+        np.testing.assert_array_equal(got_s, want_s)
+
+
+def test_sortable_from_raw_bits_matches_to_sortable():
+    import jax
+    import jax.numpy as jnp
+
+    from mpi_k_selection_tpu.utils.dtypes import (
+        sortable_from_raw_bits,
+        to_sortable_bits,
+    )
+
+    rng = np.random.default_rng(7)
+    with jax.enable_x64(True):
+        for dtype in (np.int32, np.uint32, np.float32, np.int64, np.uint64,
+                      np.float64):
+            dtype = np.dtype(dtype)
+            if dtype.kind == "f":
+                x = rng.standard_normal(4096).astype(dtype)
+                x[:2048] = -np.abs(x[:2048])
+            elif dtype.kind == "u":
+                x = rng.integers(0, 2**(8*dtype.itemsize) - 1, size=4096, dtype=dtype)
+            else:
+                x = rng.integers(-(2**(8*dtype.itemsize-1)), 2**(8*dtype.itemsize-1) - 1, size=4096, dtype=dtype)
+            kdt = np.dtype(f"uint{8*dtype.itemsize}")
+            raw = jnp.asarray(x.view(kdt))
+            got = np.asarray(sortable_from_raw_bits(raw, dtype))
+            want = np.asarray(to_sortable_bits(jnp.asarray(x)))
+            np.testing.assert_array_equal(got, want, err_msg=str(dtype))

@@ -21,7 +21,10 @@ def test_entry_compiles_and_runs():
     assert out.shape == ()
 
 
-def test_dryrun_gauntlet_inprocess():
+def test_dryrun_gauntlet_inprocess(monkeypatch):
     import __graft_entry__ as g
 
+    # the config-5 case (N=2^27 int64) is driver-run territory: ~2.5 min on
+    # one CPU core. The fast cases (incl. pallas-under-sharding) all run.
+    monkeypatch.setenv("_MPIKSEL_GAUNTLET_SKIP_SLOW", "1")
     g.dryrun_multichip(8)  # asserts internally across the case matrix

@@ -459,3 +459,123 @@ def test_radix_select_many_forced_cutover(rng):
         )
     )
     np.testing.assert_array_equal(got, np.sort(x, kind="stable")[ks - 1])
+
+
+# ---------------------------------------------------------------------------
+# 64-bit fast paths: the lo-plane multi-prefix kernel, the planes branch of
+# the counts-collect, and float64/uint64 end-to-end (VERDICT r3 item 2 —
+# these variants previously had zero in-repo executions).
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("dtype", [np.int64, np.uint64, np.float64])
+@pytest.mark.parametrize("shift", [36, 20, 0])
+def test_pallas64_multi_histogram_matches_singles(rng, dtype, shift):
+    """shift>=32 routes through the hi-plane 32-bit multi kernel; shift<32
+    runs _hist_kernel64_multi_packed (the lo-plane variant)."""
+    import jax
+
+    from mpi_k_selection_tpu.ops.pallas.histogram import (
+        pallas_radix_histogram64_multi,
+        prepare_raw_tiles64,
+    )
+    from mpi_k_selection_tpu.utils import dtypes as _dt
+
+    with jax.enable_x64(True):
+        n = 256 * 128 + 55
+        x = _raw_fold_case(rng, dtype, n)
+        xd = jnp.asarray(x)
+        un = np.asarray(_dt.to_sortable_bits(xd)).astype(np.uint64)
+        hi_r, lo_r, rn = prepare_raw_tiles64(xd, 256)
+        key_op, *rest = _dt.key_fold(dtype)
+        key_xor = rest[0] if key_op == "xor" else 0
+        rb = 4
+        prefs_np = np.sort(un)[[n // 4, n // 2, 3 * n // 4]] >> np.uint64(shift + rb)
+        prefs = jnp.asarray(prefs_np)
+        hm = pallas_radix_histogram64_multi(
+            shift=shift, radix_bits=rb, prefixes=prefs, tiles=(hi_r, lo_r),
+            orig_n=rn, block_rows=256, key_op=key_op, key_xor=key_xor,
+        )
+        for q in range(3):
+            want = _oracle(un, shift, rb, int(prefs_np[q]))
+            np.testing.assert_array_equal(np.asarray(hm[q]), want, err_msg=str(q))
+
+
+@pytest.mark.parametrize("dtype", [np.int64, np.float64, np.uint64])
+def test_radix_select_pallas64_forced_cutover(rng, dtype):
+    """int64/float64/uint64 end-to-end through the pallas64 kernels with a
+    forced cutover: exercises the PLANES branch of the collect — and, for
+    the counts path, pallas_match_counts over the hi plane ((ncut+1)*rb <=
+    32 holds at ncut=2, rb=4, so _collect_via_counts serves rung 1)."""
+    import jax
+
+    with jax.enable_x64(True):
+        n = 2 * 256 * 128 + 17
+        x = _raw_fold_case(rng, dtype, n)
+        want = np.sort(x, kind="stable")
+        for k in (1, n // 2, n):
+            got = np.asarray(
+                radix_select(
+                    jnp.asarray(x), k, hist_method="pallas64", cutover=2,
+                    block_rows=256,
+                )
+            )[()]
+            assert got == want[k - 1], (dtype, k)
+
+
+def test_radix_select_many_pallas64_forced_cutover(rng):
+    import jax
+
+    from mpi_k_selection_tpu.ops.radix import radix_select_many
+
+    with jax.enable_x64(True):
+        n = 2 * 256 * 128 + 17
+        x = _raw_fold_case(rng, np.int64, n)
+        ks = np.array([1, n // 3, n // 2, n])
+        got = np.asarray(
+            radix_select_many(
+                jnp.asarray(x), ks, hist_method="pallas64", cutover=2,
+                block_rows=256,
+            )
+        )
+        np.testing.assert_array_equal(got, np.sort(x, kind="stable")[ks - 1])
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.uint64])
+def test_radix_select_e2e_float64_uint64_auto(rng, dtype):
+    """Plain end-to-end selection for the two dtypes that previously had no
+    e2e test anywhere (auto method; scatter on CPU)."""
+    import jax
+
+    from mpi_k_selection_tpu.ops.radix import radix_select_many
+
+    with jax.enable_x64(True):
+        n = 54_321
+        x = _raw_fold_case(rng, dtype, n)
+        want = np.sort(x, kind="stable")
+        for k in (1, n // 2, n):
+            got = np.asarray(radix_select(jnp.asarray(x), k))[()]
+            assert got == want[k - 1], (dtype, k)
+        ks = np.array([n // 4, n // 2, 3 * n // 4])
+        got_m = np.asarray(radix_select_many(jnp.asarray(x), ks))
+        np.testing.assert_array_equal(got_m, want[ks - 1])
+
+
+def test_radix_select_pallas64_deep_cutover_planes_collect(rng):
+    """cutover=9 resolves 36 bits > 32, so use_counts is off and the collect
+    runs _collect_prefix_matches' PLANES branch (hi/lo tuple + key_of) —
+    unreachable from the counts path."""
+    import jax
+
+    with jax.enable_x64(True):
+        n = 256 * 128 + 13
+        x = _raw_fold_case(rng, np.int64, n)
+        want = np.sort(x, kind="stable")
+        for k in (1, n // 2, n):
+            got = np.asarray(
+                radix_select(
+                    jnp.asarray(x), k, hist_method="pallas64", cutover=9,
+                    block_rows=256,
+                )
+            )[()]
+            assert got == want[k - 1], k

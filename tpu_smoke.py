@@ -118,9 +118,43 @@ def main() -> int:
         want = np.sort(np.asarray(xh), kind="stable")[149_999]
         check(f"{np.dtype(dt).name} median", np.asarray(got)[()], want)
     with enable_x64():
-        x64v = rng.integers(-(2**62), 2**62, size=1_000_000, dtype=np.int64)
-        got = int(radix_select(jax.device_put(jnp.asarray(x64v)), 123_456))
-        check("int64 k=123456", got, int(np.sort(x64v)[123_455]))
+        # n > 2^20: the production cutover gate (ops/radix.py:cutover_passes)
+        # is OPEN, so the compiled path includes the collect ladder — the
+        # round-3 smoke's n=1,000,000 sat just below the gate and never ran
+        # the cutover the headline numbers depend on (VERDICT r3 weak #5)
+        n64 = (1 << 21) + 4097
+        x64v = rng.integers(-(2**62), 2**62, size=n64, dtype=np.int64)
+        xd64 = jax.device_put(jnp.asarray(x64v))
+        for k in (123_456, n64 // 2, n64):
+            got = int(radix_select(xd64, k))
+            check(f"int64 k={k} (cutover path)", got, int(np.sort(x64v)[k - 1]))
+        # float64/uint64: the remaining claimed dtypes (docs/API.md), e2e on
+        # chip. float64 goes in as a HOST array: TPU f64 storage truncates
+        # to ~49 bits at device_put (measured), so the exact path view-casts
+        # the bits on host and selects in u64 key space on device
+        # (ops/radix.py:_f64_tpu_host_keys)
+        xf64 = rng.standard_normal(n64).astype(np.float64)
+        xf64[: n64 // 2] = -np.abs(xf64[: n64 // 2])
+        got = float(radix_select(xf64, n64 // 2))
+        check("float64 median (host-exact path)", got,
+              float(np.sort(xf64)[n64 // 2 - 1]))
+        # device-resident f64: exact w.r.t. the device's (truncated) contents
+        xdev = jax.device_put(jnp.asarray(xf64))
+        got = float(radix_select(xdev, n64 // 2))
+        check("float64 median (device contents)", got,
+              float(np.sort(np.asarray(xdev))[n64 // 2 - 1]))
+        xu64 = rng.integers(0, 2**64, size=n64, dtype=np.uint64)
+        got = int(radix_select(jax.device_put(jnp.asarray(xu64)), n64 // 3))
+        check("uint64 k=n/3 (cutover path)", got, int(np.sort(xu64)[n64 // 3 - 1]))
+        # multi-rank through the 64-bit multi-prefix kernels (lo-plane
+        # variant included: passes below shift 32 run
+        # _hist_kernel64_multi_packed compiled)
+        from mpi_k_selection_tpu.ops.radix import radix_select_many
+
+        ksq = np.array([1, n64 // 2, n64 - 7])
+        got_m = np.asarray(radix_select_many(xd64, ksq, cutover=None))
+        check("int64 select_many (multi kernels, all passes)",
+              got_m, np.sort(x64v)[ksq - 1])
 
     if failures:
         print(f"tpu_smoke: {len(failures)} FAILURES")
