@@ -1,0 +1,501 @@
+"""Experiment: Pallas block top-k kernel for the batched config (r4 item 3).
+
+Measures, on the real chip, at B=4096 x D=32768 f32 k=8:
+  1. max-only streaming kernel  -> the achievable data-touch floor
+  2. insert-chain top-8 kernel  -> per-(row,lane) running sorted-8, final
+     XLA top_k merge over 8*128 candidates/row
+vs the current production path (ops/topk.py chunked) and lax.top_k.
+
+Scratch harness — findings land in ops/topk.py + docs; file kept as the
+measurement record for the accept/reject decision.
+"""
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+B, D, K = 4096, 32768, 8
+
+
+def timeit(fn, *args, reps=5):
+    fn(*args)[0].block_until_ready() if isinstance(fn(*args), tuple) else fn(*args).block_until_ready()
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        r = fn(*args)
+        (r[0] if isinstance(r, tuple) else r).block_until_ready()
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+# --- 1. max-only kernel: the floor ---------------------------------------
+
+
+def _max_kernel(x_ref, o_ref, *, nd):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        o_ref[:] = jnp.full_like(o_ref, -jnp.inf)
+
+    bb, bd = x_ref.shape
+    x = x_ref[:].reshape(bb, bd // 128, 128)
+    o_ref[:] = jnp.maximum(o_ref[:], jnp.max(x, axis=1))
+
+
+@functools.partial(jax.jit, static_argnames=("bb", "bd"))
+def pallas_row_max(x, bb=256, bd=4096):
+    nb, nd = B // bb, D // bd
+    out = pl.pallas_call(
+        functools.partial(_max_kernel, nd=nd),
+        grid=(nb, nd),
+        in_specs=[pl.BlockSpec((bb, bd), lambda i, j: (i, j), memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((bb, 128), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((B, 128), jnp.float32),
+        interpret=False,
+    )(x)
+    return jnp.max(out, axis=1)
+
+
+# --- 2. insert-chain top-8: per-(row,lane) sorted-8 registers ------------
+# tile (bb, bd) viewed as (bb, bd//128, 128): stream sublane slabs through
+# an 8-deep compare-insert chain kept in the output block (bb, 8, 128),
+# accumulated across the d-grid (index_map pins the out block per row).
+
+
+def _top8_kernel(x_ref, o_ref, *, bd):
+    j = pl.program_id(1)
+    slabs = bd // 128
+
+    @pl.when(j == 0)
+    def _():
+        o_ref[:] = jnp.full_like(o_ref, -jnp.inf)
+
+    bb = x_ref.shape[0]
+    x = x_ref[:].reshape(bb, slabs, 128)
+    regs = [o_ref[i * bb:(i + 1) * bb, :] for i in range(8)]
+    for s in range(slabs):
+        t = x[:, s, :]
+        for i in range(8):
+            ri = regs[i]
+            new_ri = jnp.maximum(ri, t)
+            t = jnp.minimum(ri, t)
+            regs[i] = new_ri
+    o_ref[:] = jnp.concatenate(regs, axis=0)
+
+
+@functools.partial(jax.jit, static_argnames=("bb", "bd"))
+def pallas_batched_top8(x, bb=256, bd=2048):
+    nb, nd = B // bb, D // bd
+    cand = pl.pallas_call(
+        functools.partial(_top8_kernel, bd=bd),
+        grid=(nb, nd),
+        in_specs=[pl.BlockSpec((bb, bd), lambda i, j: (i, j), memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((8 * bb, 128), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((8 * B, 128), jnp.float32),
+        interpret=False,
+    )(x)
+    # block i rows [8*bb*i, 8*bb*(i+1)): reg r at [r*bb, (r+1)*bb) within
+    cand = cand.reshape(nb, 8, bb, 128).transpose(0, 2, 1, 3).reshape(B, 8 * 128)
+    vals, _ = jax.lax.top_k(cand, K)
+    return vals
+
+
+def measure(fn, xd, reps=(2, 8)):
+    """bench.py's differential perturb-chain timing (defeats the tunnel's
+    repeat-elision that made naive block_until_ready timing report 17 TB/s)."""
+    from bench import _perturb_chain, _timed_chain
+
+    return _timed_chain(
+        lambda r: _perturb_chain(fn, r), xd, lambda i: jnp.uint32(i + 1), reps
+    )
+
+
+def main():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((B, D)).astype(np.float32))
+
+    t = measure(lambda a: pallas_row_max(a), x)
+    print(f"max-only floor: {t*1e3:.3f} ms  ({B*D*4/t/1e9:.0f} GB/s)")
+
+    from mpi_k_selection_tpu.ops.topk import topk
+
+    t_prod = measure(lambda a: topk(a, K)[0], x)
+    print(f"current production topk: {t_prod*1e3:.3f} ms")
+
+    want = np.sort(np.asarray(x), axis=1)[:, ::-1][:, :K]
+
+    for bb, bd in ((256, 2048), (512, 2048), (256, 4096), (128, 8192)):
+        try:
+            got = np.asarray(pallas_batched_top8(x, bb=bb, bd=bd))
+            ok = np.array_equal(got, want)
+            t = measure(lambda a, bb=bb, bd=bd: pallas_batched_top8(a, bb=bb, bd=bd), x)
+            print(f"insert-chain top8 bb={bb} bd={bd}: {t*1e3:.3f} ms exact={ok}")
+        except Exception as e:
+            print(f"insert-chain top8 bb={bb} bd={bd}: FAIL {str(e)[:120]}")
+
+
+if __name__ == "__main__":
+    main()
+
+
+# --- 3. depth-t chain (model calibration) + sort8-group variant ----------
+
+
+def _topt_kernel(x_ref, o_ref, *, bd, depth):
+    j = pl.program_id(1)
+    slabs = bd // 128
+
+    @pl.when(j == 0)
+    def _():
+        o_ref[:] = jnp.full_like(o_ref, -jnp.inf)
+
+    bb = x_ref.shape[0]
+    x = x_ref[:].reshape(bb, slabs, 128)
+    regs = [o_ref[i * bb:(i + 1) * bb, :] for i in range(depth)]
+    for s in range(slabs):
+        t = x[:, s, :]
+        for i in range(depth):
+            ri = regs[i]
+            new_ri = jnp.maximum(ri, t)
+            t = jnp.minimum(ri, t)
+            regs[i] = new_ri
+    o_ref[:] = jnp.concatenate(regs, axis=0)
+
+
+@functools.partial(jax.jit, static_argnames=("bb", "bd", "depth"))
+def pallas_topt(x, bb=512, bd=2048, depth=4):
+    nb, nd = B // bb, D // bd
+    cand = pl.pallas_call(
+        functools.partial(_topt_kernel, bd=bd, depth=depth),
+        grid=(nb, nd),
+        in_specs=[pl.BlockSpec((bb, bd), lambda i, j: (i, j), memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((depth * bb, 128), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((depth * B, 128), jnp.float32),
+        interpret=False,
+    )(x)
+    return cand  # candidates only; merge cost measured separately
+
+
+def _sort8_group_kernel(x_ref, o_ref, *, bd):
+    """Per 8-slab group: bitonic-sort the 8 slabs per (row,lane) descending,
+    then merge with the running sorted-8 (compare r_i vs g_{7-i} + bitonic
+    clean). ~(19 + 8 + 9) CE per 8 slabs ≈ 9 ops/elem vs the chain's 16."""
+    j = pl.program_id(1)
+    slabs = bd // 128
+
+    @pl.when(j == 0)
+    def _():
+        o_ref[:] = jnp.full_like(o_ref, -jnp.inf)
+
+    bb = x_ref.shape[0]
+    x = x_ref[:].reshape(bb, slabs, 128)
+    regs = [o_ref[i * bb:(i + 1) * bb, :] for i in range(8)]
+
+    def ce(a, b):  # descending compare-exchange
+        return jnp.maximum(a, b), jnp.minimum(a, b)
+
+    for g in range(slabs // 8):
+        v = [x[:, g * 8 + i, :] for i in range(8)]
+        # bitonic sort8 descending (19 CEs)
+        for (a, b) in ((0,1),(2,3),(4,5),(6,7)):
+            v[a], v[b] = ce(v[a], v[b])
+        for (a, b) in ((0,2),(1,3),(4,6),(5,7)):
+            v[a], v[b] = ce(v[a], v[b])
+        for (a, b) in ((1,2),(5,6)):
+            v[a], v[b] = ce(v[a], v[b])
+        for (a, b) in ((0,4),(1,5),(2,6),(3,7)):
+            v[a], v[b] = ce(v[a], v[b])
+        for (a, b) in ((2,4),(3,5)):
+            v[a], v[b] = ce(v[a], v[b])
+        for (a, b) in ((1,2),(3,4),(5,6)):
+            v[a], v[b] = ce(v[a], v[b])
+        # merge with running top-8: winners of (r_i, v_{7-i}) form a bitonic
+        # sequence; clean with a log network (12 CEs)
+        w = [jnp.maximum(regs[i], v[7 - i]) for i in range(8)]
+        for (a, b) in ((0,4),(1,5),(2,6),(3,7)):
+            w[a], w[b] = ce(w[a], w[b])
+        for (a, b) in ((0,2),(1,3),(4,6),(5,7)):
+            w[a], w[b] = ce(w[a], w[b])
+        for (a, b) in ((0,1),(2,3),(4,5),(6,7)):
+            w[a], w[b] = ce(w[a], w[b])
+        regs = w
+    o_ref[:] = jnp.concatenate(regs, axis=0)
+
+
+@functools.partial(jax.jit, static_argnames=("bb", "bd"))
+def pallas_sort8_group(x, bb=512, bd=2048):
+    nb, nd = B // bb, D // bd
+    cand = pl.pallas_call(
+        functools.partial(_sort8_group_kernel, bd=bd),
+        grid=(nb, nd),
+        in_specs=[pl.BlockSpec((bb, bd), lambda i, j: (i, j), memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((8 * bb, 128), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((8 * B, 128), jnp.float32),
+        interpret=False,
+    )(x)
+    cand = cand.reshape(nb, 8, bb, 128).transpose(0, 2, 1, 3).reshape(B, 8 * 128)
+    vals, _ = jax.lax.top_k(cand, K)
+    return vals
+
+
+# --- 4. depth-8 chain + IN-KERNEL bitonic lane fold (no XLA merge) -------
+
+
+def _ce(a, b):
+    return jnp.maximum(a, b), jnp.minimum(a, b)
+
+
+def _lane_fold_top8(regs, bb):
+    """Merge the per-lane sorted-8 columns across lanes: at each fold the
+    left/right lane halves hold independent sorted-8 runs; winners of
+    (a_i, b_{7-i}) form a bitonic sequence, cleaned with a 3-stage network.
+    Returns 8 (bb, 1) arrays: the row's true top-8, sorted."""
+    w = regs[0].shape[1] // 2
+    while w >= 1:
+        a = [r[:, :w] for r in regs]
+        b = [r[:, w:2 * w] for r in regs]
+        m = [jnp.maximum(a[i], b[7 - i]) for i in range(8)]
+        for (i, j) in ((0, 4), (1, 5), (2, 6), (3, 7)):
+            m[i], m[j] = _ce(m[i], m[j])
+        for (i, j) in ((0, 2), (1, 3), (4, 6), (5, 7)):
+            m[i], m[j] = _ce(m[i], m[j])
+        for (i, j) in ((0, 1), (2, 3), (4, 5), (6, 7)):
+            m[i], m[j] = _ce(m[i], m[j])
+        regs = m
+        w //= 2
+    return regs
+
+
+def _top8_fold_kernel(x_ref, o_ref, acc, *, bd, nd):
+    j = pl.program_id(1)
+    slabs = bd // 128
+    bb = x_ref.shape[0]
+
+    @pl.when(j == 0)
+    def _():
+        acc[:] = jnp.full_like(acc, -jnp.inf)
+
+    x = x_ref[:].reshape(bb, slabs, 128)
+    regs = [acc[i * bb:(i + 1) * bb, :] for i in range(8)]
+    for s in range(slabs):
+        t = x[:, s, :]
+        for i in range(8):
+            ri = regs[i]
+            new_ri = jnp.maximum(ri, t)
+            t = jnp.minimum(ri, t)
+            regs[i] = new_ri
+    acc[:] = jnp.concatenate(regs, axis=0)
+
+    @pl.when(j == nd - 1)
+    def _():
+        top = _lane_fold_top8(regs, bb)
+        o_ref[:] = jnp.concatenate(top, axis=1)  # (bb, 8), sorted desc
+
+
+@functools.partial(jax.jit, static_argnames=("bb", "bd"))
+def pallas_top8_fold(x, bb=512, bd=2048):
+    nb, nd = B // bb, D // bd
+    out = pl.pallas_call(
+        functools.partial(_top8_fold_kernel, bd=bd, nd=nd),
+        grid=(nb, nd),
+        in_specs=[pl.BlockSpec((bb, bd), lambda i, j: (i, j), memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((bb, 8), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((B, 8), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((8 * bb, 128), jnp.float32)],
+        interpret=False,
+    )(x)
+    return out
+
+
+# --- 5. two-kernel variant: chain (no scratch) + tiny fold kernel --------
+
+
+def _fold_only_kernel(c_ref, o_ref, *, bb):
+    regs = [c_ref[i * bb:(i + 1) * bb, :] for i in range(8)]
+    top = _lane_fold_top8(regs, bb)
+    o_ref[:] = jnp.concatenate(top, axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("bb", "bd"))
+def pallas_top8_twokernel(x, bb=512, bd=2048):
+    nb, nd = B // bb, D // bd
+    cand = pl.pallas_call(
+        functools.partial(_top8_kernel, bd=bd),
+        grid=(nb, nd),
+        in_specs=[pl.BlockSpec((bb, bd), lambda i, j: (i, j), memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((8 * bb, 128), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((8 * B, 128), jnp.float32),
+        interpret=False,
+    )(x)
+    out = pl.pallas_call(
+        functools.partial(_fold_only_kernel, bb=bb),
+        grid=(nb,),
+        in_specs=[pl.BlockSpec((8 * bb, 128), lambda i: (i, 0), memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((bb, 8), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((B, 8), jnp.float32),
+        interpret=False,
+    )(cand)
+    return out
+
+
+# --- 6. depth-3 chain + fold + suspect-row rescue (target <= 1.2 ms) -----
+# Exactness: if no lane's 3rd-kept value is > t8_hat (the 8th of the folded
+# candidate top-8), every row value > t8_hat is among the candidates, which
+# forces fold(candidates) == true top-8 BY VALUE. Suspect rows (a lane
+# holding >= 4 of the row's top 8 — P ~ 3e-3 per batch row for random
+# data) are re-solved exactly by lax.top_k on a gathered bounded subset,
+# with a cond full-fallback if the budget overflows.
+
+
+def _chain_kernel_t(x_ref, o_ref, *, bd, depth):
+    j = pl.program_id(1)
+    slabs = bd // 128
+    bb = x_ref.shape[0]
+
+    @pl.when(j == 0)
+    def _():
+        o_ref[:] = jnp.full_like(o_ref, -jnp.inf)
+
+    x = x_ref[:].reshape(bb, slabs, 128)
+    regs = [o_ref[i * bb:(i + 1) * bb, :] for i in range(depth)]
+    for s in range(slabs):
+        t = x[:, s, :]
+        for i in range(depth):
+            ri = regs[i]
+            regs[i] = jnp.maximum(ri, t)
+            t = jnp.minimum(ri, t)
+    o_ref[:] = jnp.concatenate(regs, axis=0)
+
+
+def _fold3_kernel(c_ref, o_ref, s_ref, *, bb):
+    neg = jnp.full((bb, 128), -jnp.inf, jnp.float32)
+    regs = [c_ref[i * bb:(i + 1) * bb, :] for i in range(3)] + [neg] * 5
+    lane3 = regs[2]
+    top = _lane_fold_top8(regs, bb)
+    o_ref[:] = jnp.concatenate(top, axis=1)
+    t8 = top[7]  # (bb, 1)
+    s = jnp.where(lane3 > t8, jnp.float32(1), jnp.float32(0))
+    w = 64
+    while w >= 1:
+        s = jnp.maximum(s[:, :w], s[:, w:2 * w])
+        w //= 2
+    s_ref[:] = s
+
+
+@functools.partial(jax.jit, static_argnames=("bb", "bd", "rescue_rows"))
+def pallas_top8_rescue(x, bb=512, bd=2048, rescue_rows=128):
+    nb, nd = B // bb, D // bd
+    cand = pl.pallas_call(
+        functools.partial(_chain_kernel_t, bd=bd, depth=3),
+        grid=(nb, nd),
+        in_specs=[pl.BlockSpec((bb, bd), lambda i, j: (i, j), memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((3 * bb, 128), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((3 * B, 128), jnp.float32),
+        interpret=False,
+    )(x)
+    top, susp = pl.pallas_call(
+        functools.partial(_fold3_kernel, bb=bb),
+        grid=(nb,),
+        in_specs=[pl.BlockSpec((3 * bb, 128), lambda i: (i, 0), memory_space=pltpu.VMEM)],
+        out_specs=[
+            pl.BlockSpec((bb, 8), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bb, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, 8), jnp.float32),
+            jax.ShapeDtypeStruct((B, 1), jnp.float32),
+        ],
+        interpret=False,
+    )(cand)
+    sflag = susp[:, 0] > 0
+    nsusp = jnp.sum(sflag.astype(jnp.int32))
+
+    # bounded rescue: re-solve the suspect rows exactly
+    sval, sidx = jax.lax.top_k(sflag.astype(jnp.int32), rescue_rows)
+    rows = x[sidx]  # (rescue_rows, D) gather
+    rtop, _ = jax.lax.top_k(rows, 8)
+    fixed = jnp.where(sval[:, None] > 0, rtop, top[sidx])
+    top = top.at[sidx].set(fixed)
+
+    def full_fallback(_):
+        v, _ = jax.lax.top_k(x, 8)
+        return v
+
+    return jax.lax.cond(nsusp <= rescue_rows, lambda _: top, full_fallback, 0)
+
+
+# --- 7. fused single-kernel: chain + fold/suspect at last grid step ------
+
+
+def _fused3_kernel(x_ref, c_ref, o_ref, s_ref, *, bd, nd):
+    j = pl.program_id(1)
+    slabs = bd // 128
+    bb = x_ref.shape[0]
+
+    @pl.when(j == 0)
+    def _():
+        c_ref[:] = jnp.full_like(c_ref, -jnp.inf)
+
+    x = x_ref[:].reshape(bb, slabs, 128)
+    regs = [c_ref[i * bb:(i + 1) * bb, :] for i in range(3)]
+    for s in range(slabs):
+        t = x[:, s, :]
+        for i in range(3):
+            ri = regs[i]
+            regs[i] = jnp.maximum(ri, t)
+            t = jnp.minimum(ri, t)
+    c_ref[:] = jnp.concatenate(regs, axis=0)
+
+    @pl.when(j == nd - 1)
+    def _():
+        neg = jnp.full((bb, 128), -jnp.inf, jnp.float32)
+        lane3 = regs[2]
+        top = _lane_fold_top8(list(regs) + [neg] * 5, bb)
+        o_ref[:] = jnp.concatenate(top, axis=1)
+        t8 = top[7]
+        s = jnp.where(lane3 > t8, jnp.float32(1), jnp.float32(0))
+        w = 64
+        while w >= 1:
+            s = jnp.maximum(s[:, :w], s[:, w:2 * w])
+            w //= 2
+        s_ref[:] = s
+
+
+@functools.partial(jax.jit, static_argnames=("bb", "bd", "rescue_rows"))
+def pallas_top8_fused(x, bb=512, bd=2048, rescue_rows=64):
+    nb, nd = B // bb, D // bd
+    _cand, top, susp = pl.pallas_call(
+        functools.partial(_fused3_kernel, bd=bd, nd=nd),
+        grid=(nb, nd),
+        in_specs=[pl.BlockSpec((bb, bd), lambda i, j: (i, j), memory_space=pltpu.VMEM)],
+        out_specs=[
+            pl.BlockSpec((3 * bb, 128), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bb, 8), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bb, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((3 * B, 128), jnp.float32),
+            jax.ShapeDtypeStruct((B, 8), jnp.float32),
+            jax.ShapeDtypeStruct((B, 1), jnp.float32),
+        ],
+        interpret=False,
+    )(x)
+    sflag = susp[:, 0] > 0
+    nsusp = jnp.sum(sflag.astype(jnp.int32))
+    sval, sidx = jax.lax.top_k(sflag.astype(jnp.int32), rescue_rows)
+    rows = x[sidx]
+    rtop, _ = jax.lax.top_k(rows, 8)
+    fixed = jnp.where(sval[:, None] > 0, rtop, top[sidx])
+    top = top.at[sidx].set(fixed)
+
+    def full_fallback(_):
+        v, _ = jax.lax.top_k(x, 8)
+        return v
+
+    return jax.lax.cond(nsusp <= rescue_rows, lambda _: top, full_fallback, 0)

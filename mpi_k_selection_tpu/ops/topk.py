@@ -96,8 +96,28 @@ def topk(
     if not 1 <= k <= d:
         raise ValueError(f"k={k} out of range for last axis of size {d}")
     keys, native = _signed_keys(x, largest)
+    from mpi_k_selection_tpu.ops.pallas.topk import (
+        batched_topk_supported,
+        pallas_batched_topk_values,
+    )
+
     if method == "auto":
-        if x.ndim == 1 and d >= 1 << 18 and d >= 64 * k and d < 2**31:
+        if (
+            x.ndim == 2
+            and largest
+            and jax.default_backend() == "tpu"
+            and batched_topk_supported(x.shape, x.dtype, k)
+        ):
+            # the Pallas depth-3-chain + lane-fold + rescue kernel
+            # (ops/pallas/topk.py): ~2x XLA TopK at the BASELINE batched
+            # config. Values come from the kernel; indices from the XLA key
+            # path below. Callers that use only the values (vocab pruning,
+            # beam-score thresholds — the BASELINE metric) never pay for
+            # indices (XLA DCEs them); callers that materialize the indices
+            # pay kernel + XLA TopK (~1.5x the flat path) — pass
+            # method="flat" there if latency matters more than values speed.
+            method = "block"
+        elif x.ndim == 1 and d >= 1 << 18 and d >= 64 * k and d < 2**31:
             method = "threshold"
         elif d >= 1 << 16 and d >= 64 * k and jax.default_backend() != "tpu":
             # chunked wins ~90x over lax.top_k on CPU; on TPU the XLA TopK
@@ -111,6 +131,17 @@ def topk(
     # take_along_axis gather lowers catastrophically on TPU (see
     # _decode_keys); the 1-D threshold/tournament paths produce indices
     # only, and a 1-D gather of k elements is cheap
+    if method == "block":
+        if x.ndim != 2 or not largest:
+            raise ValueError("block method applies to 2-D inputs, largest=True")
+        values = pallas_batched_topk_values(x, k)
+        # tie order matches lax.top_k: both produce the exact sorted top-k
+        # value sequence for NaN-free rows, so values[i] == x[row, idx[i]].
+        # NaN-containing rows take the kernel's lax.top_k rescue (NaNs rank
+        # first on both paths; payload-level order carries the same caveat
+        # as utils/dtypes.py's NaN note)
+        _, idx = jax.lax.top_k(keys, k)
+        return values, idx
     if method == "threshold":
         if x.ndim != 1:
             raise ValueError("threshold method applies to 1-D inputs")

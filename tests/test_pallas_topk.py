@@ -1,0 +1,102 @@
+"""Pallas batched top-k kernel (ops/pallas/topk.py) vs numpy, interpret mode.
+
+Covers the three runtime paths: non-suspect fold, bounded rescue (rows with
+a lane hiding a 4th top-8 member), and the full lax.top_k fallback (suspect
+count over the rescue budget), plus ties, k < 8, and -inf rows.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_k_selection_tpu.ops.pallas.topk import (
+    batched_topk_supported,
+    pallas_batched_topk_values,
+)
+from mpi_k_selection_tpu.ops.topk import topk
+
+B, D = 64, 4096
+
+
+def _want(x, k):
+    return np.sort(x, axis=1)[:, ::-1][:, :k].astype(np.float32)
+
+
+@pytest.mark.parametrize("k", [1, 5, 8])
+def test_block_topk_random(rng, k):
+    x = rng.standard_normal((B, D)).astype(np.float32)
+    got = np.asarray(pallas_batched_topk_values(jnp.asarray(x), k))
+    np.testing.assert_array_equal(got, _want(x, k))
+
+
+def test_block_topk_duplicates(rng):
+    x = (rng.integers(0, 13, size=(B, D))).astype(np.float32)
+    got = np.asarray(pallas_batched_topk_values(jnp.asarray(x), 8))
+    np.testing.assert_array_equal(got, _want(x, 8))
+
+
+def test_block_topk_rescue_path(rng):
+    # top-8 of a few rows clustered in ONE lane (stride-128 positions):
+    # those rows MUST flag suspect and be rescued exactly
+    x = rng.standard_normal((B, D)).astype(np.float32)
+    big = 100.0 + np.arange(8, dtype=np.float32)
+    for r in (3, 17, 40):
+        x[r, 5 + 128 * np.arange(8)] = big  # same lane (col % 128 == 5)
+    got = np.asarray(pallas_batched_topk_values(jnp.asarray(x), 8))
+    np.testing.assert_array_equal(got, _want(x, 8))
+
+
+def test_block_topk_fallback_path(rng):
+    # EVERY row clustered => suspects exceed the rescue budget => the cond
+    # takes the full lax.top_k fallback; result must still be exact
+    x = rng.standard_normal((128, D)).astype(np.float32)
+    big = 50.0 + np.arange(8, dtype=np.float32)
+    for r in range(128):
+        x[r, 7 + 128 * np.arange(8)] = big
+    got = np.asarray(
+        pallas_batched_topk_values(jnp.asarray(x), 8, rescue_rows=16)
+    )
+    np.testing.assert_array_equal(got, _want(x, 8))
+
+
+def test_block_topk_neg_inf_rows(rng):
+    x = rng.standard_normal((B, D)).astype(np.float32)
+    x[5, :] = -np.inf  # top-8 all -inf: suspect logic degrades to rescue
+    x[9, :D - 4] = -np.inf  # fewer finite values than k
+    got = np.asarray(pallas_batched_topk_values(jnp.asarray(x), 8))
+    np.testing.assert_array_equal(got, _want(x, 8))
+
+
+def test_block_topk_dispatch_contract():
+    assert batched_topk_supported((4096, 32768), np.float32, 8)
+    assert not batched_topk_supported((4096, 32768), np.float64, 8)
+    assert not batched_topk_supported((4096, 32768), np.float32, 9)
+    assert not batched_topk_supported((100, 32768), np.float32, 8)  # B % 64
+    assert not batched_topk_supported((4096, 2048), np.float32, 8)  # D < 4096
+    assert not batched_topk_supported((4096,), np.float32, 8)
+
+
+def test_topk_block_method_values_and_indices(rng):
+    # the public topk() pairing: kernel values + XLA-path indices agree
+    x = rng.standard_normal((B, D)).astype(np.float32)
+    vals, idx = topk(jnp.asarray(x), 8, method="block")
+    want = _want(x, 8)
+    np.testing.assert_array_equal(np.asarray(vals), want)
+    np.testing.assert_array_equal(
+        np.take_along_axis(x, np.asarray(idx), axis=1), want
+    )
+
+
+def test_block_topk_nan_rows(rng):
+    # NaN floods a lane's chain registers; isnan(lane3) must flag the row
+    # so the lax.top_k rescue handles it instead of returning flood garbage
+    x = rng.standard_normal((B, D)).astype(np.float32)
+    x[11, 77] = np.nan
+    x[30, 3999] = np.nan
+    got = np.asarray(pallas_batched_topk_values(jnp.asarray(x), 8))
+    want = np.asarray(
+        __import__("jax").lax.top_k(jnp.asarray(x), 8)[0]
+    )  # rescue contract: same as lax.top_k for NaN rows
+    np.testing.assert_array_equal(got[[11, 30]], want[[11, 30]])
+    clean = np.setdiff1d(np.arange(B), [11, 30])
+    np.testing.assert_array_equal(got[clean], _want(x, 8)[clean])
