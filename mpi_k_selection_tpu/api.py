@@ -48,18 +48,32 @@ def kselect_many(x, ks, **kwargs):
     if x.size == 0:
         raise ValueError("kselect_many requires a non-empty input")
     check_concrete_ks(ks, x.size)
-    if x.size <= 1 << 14:
+    n_queries = int(np.prod(np.shape(ks), dtype=np.int64)) if np.shape(ks) else 1
+    # Measured dispatch constant (r4, v5e, n=2^27 int32): the multi-prefix
+    # walk costs ~3.4 ms per query (the per-query masked SWAR accumulate is
+    # linear in K) while one lax.sort of the whole array costs 409 ms — the
+    # crossover sits near K~110, so radix wins for every K below 112 and
+    # one K-independent sort + K gathers wins above. The constant encodes
+    # that one measured shape: walk cost scales ~K*n and sort ~n log n, so
+    # the true crossover drifts slowly with n; 112 keeps radix preferred
+    # everywhere it measured faster.
+    if x.size <= 1 << 14 or n_queries >= 112:
         if kwargs:
             import warnings
 
             warnings.warn(
-                f"kselect_many: small input takes the sort path; radix "
-                f"options {sorted(kwargs)} are ignored",
+                f"kselect_many: this shape takes the sort path (small input "
+                f"or >= 96 queries); radix options {sorted(kwargs)} are "
+                "ignored",
                 stacklevel=2,
             )
+        from mpi_k_selection_tpu.ops.radix import select_count_dtype
+
         ks_arr = jnp.atleast_1d(jnp.asarray(ks))
         s = jnp.sort(x.ravel())
-        idx = jnp.clip(ks_arr.astype(jnp.int32) - 1, 0, x.size - 1)
+        # rank dtype sized to n: an int32 cast would silently wrap int64
+        # ranks for n >= 2^31 (this path is reachable at any n via K >= 112)
+        idx = jnp.clip(ks_arr.astype(select_count_dtype(x.size)) - 1, 0, x.size - 1)
         out = s[idx.ravel()].reshape(ks_arr.shape)
     else:
         out = radix_select_many(x, ks, **kwargs)

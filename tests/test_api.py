@@ -81,3 +81,15 @@ def test_quantiles_nearest_rank(rng):
     np.testing.assert_array_equal(got, want)
     with pytest.raises(ValueError):
         ks.quantiles(jnp.asarray(x), [0.5, 1.5])
+
+
+def test_kselect_many_large_k_sort_dispatch(rng):
+    # >= 112 queries take the one-sort-K-gathers path (measured crossover
+    # ~K=110 at n=2^27 on v5e; see api.kselect_many) — exactness unchanged
+    import mpi_k_selection_tpu as pkg
+
+    n = 50_000
+    x = rng.integers(-(2**31), 2**31 - 1, size=n, dtype=np.int32)
+    ks = np.linspace(1, n, 128).astype(np.int64)
+    got = np.asarray(pkg.kselect_many(x, ks))
+    np.testing.assert_array_equal(got, np.sort(x, kind="stable")[ks - 1])
