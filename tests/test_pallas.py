@@ -44,9 +44,12 @@ def _oracle(keys, shift, radix_bits, prefix):
 )
 def test_pallas_histogram_matches_oracle(rng, n, shift, radix_bits, prefix):
     keys = jnp.asarray(rng.integers(0, 2**32, size=n, dtype=np.uint32))
+    # rb=8 kernels trace nreg=32 SWAR groups — 64-row blocks cut the unroll
+    # (and the ~19 s/case trace time) 4x while still spanning whole grids
+    br = 256 if radix_bits <= 4 else 64
     got = np.asarray(
         pallas_radix_histogram(
-            keys, shift=shift, radix_bits=radix_bits, prefix=prefix, block_rows=256
+            keys, shift=shift, radix_bits=radix_bits, prefix=prefix, block_rows=br
         )
     )
     want = _oracle(keys, shift, radix_bits, prefix)
@@ -531,7 +534,9 @@ def test_radix_select_many_pallas64_forced_cutover(rng):
     with jax.enable_x64(True):
         n = 2 * 256 * 128 + 17
         x = _raw_fold_case(rng, np.int64, n)
-        ks = np.array([1, n // 3, n // 2, n])
+        # K=2: the full-branch trace unrolls ~28 multi passes whose kernel
+        # trace cost is linear in K — K=2 halves the 41 s this test took
+        ks = np.array([n // 3, n])
         got = np.asarray(
             radix_select_many(
                 jnp.asarray(x), ks, hist_method="pallas64", cutover=2,
