@@ -17,10 +17,34 @@ from mpi_k_selection_tpu.utils.debug import check_concrete_k, check_concrete_ks
 ALGORITHMS = ("auto", "radix", "sort")
 
 
+def as_selection_array(x):
+    """``jnp.asarray`` for selection inputs, EXCEPT host float64 on the TPU
+    backend, which stays host-side (a numpy array): committing f64 to the
+    device truncates it to the TPU's ~49-bit f64 storage (measured — see
+    ops/radix.py:_f64_tpu_host_keys), and the exact selection route needs
+    the untruncated host bits. Every selection entry layer (api, backends,
+    CLI) converts through here so the exact route is reachable from all of
+    them, not only from a direct radix_select call. jax arrays and tracers
+    pass through untouched (a device-resident f64 array was already
+    truncated; selection is then exact w.r.t. its actual contents)."""
+    import jax
+
+    if isinstance(x, (jax.Array, jax.core.Tracer)):
+        return x
+    x = np.asarray(x)
+    if x.dtype == np.float64 and jax.default_backend() == "tpu":
+        return x
+    return jnp.asarray(x)
+
+
+def _host_f64(x) -> bool:
+    return isinstance(x, np.ndarray) and x.dtype == np.float64
+
+
 def kselect(x, k, *, algorithm: str = "auto", **kwargs):
     """Exact k-th smallest element (1-indexed k, reference semantics:
     ``kth-problem-seq.c:32-33``)."""
-    x = jnp.asarray(x)
+    x = as_selection_array(x)
     if x.size == 0:
         raise ValueError("kselect requires a non-empty input")
     # concrete k raises here; traced k is clamped inside the ops
@@ -31,6 +55,14 @@ def kselect(x, k, *, algorithm: str = "auto", **kwargs):
     if algorithm == "radix":
         return radix_select(x, k, **kwargs)
     if algorithm == "sort":
+        if _host_f64(x):
+            # stay host-side end-to-end (device sort would truncate);
+            # traced k can't index numpy — the radix route handles it
+            import jax
+
+            if isinstance(k, jax.core.Tracer):
+                return radix_select(x, k, **kwargs)
+            return np.sort(x.ravel(), kind="stable")[int(k) - 1]
         return sort_select(x, k)
     raise ValueError(f"unknown algorithm {algorithm!r}; choose from {ALGORITHMS}")
 
@@ -44,7 +76,7 @@ def kselect_many(x, ks, **kwargs):
     once and gather. Returns answers in ``ks`` order, with ``ks``'s shape
     (a scalar k returns a scalar, matching :func:`kselect`).
     """
-    x = jnp.asarray(x)
+    x = as_selection_array(x)
     if x.size == 0:
         raise ValueError("kselect_many requires a non-empty input")
     check_concrete_ks(ks, x.size)
@@ -69,6 +101,18 @@ def kselect_many(x, ks, **kwargs):
             )
         from mpi_k_selection_tpu.ops.radix import select_count_dtype
 
+        if _host_f64(x):
+            import jax
+
+            if any(
+                isinstance(kv, jax.core.Tracer) for kv in np.atleast_1d(ks)
+            ) or isinstance(ks, jax.core.Tracer):
+                out = radix_select_many(x, ks, **kwargs)  # exact host route
+            else:
+                ks_np = np.atleast_1d(np.asarray(ks, dtype=np.int64))
+                s_np = np.sort(x.ravel(), kind="stable")
+                out = s_np[np.clip(ks_np - 1, 0, x.size - 1)].reshape(ks_np.shape)
+            return restore_k_shape(out, ks)
         ks_arr = jnp.atleast_1d(jnp.asarray(ks))
         s = jnp.sort(x.ravel())
         # rank dtype sized to n: an int32 cast would silently wrap int64

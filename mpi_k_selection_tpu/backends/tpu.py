@@ -94,8 +94,10 @@ def kselect(x, k: int, *, algorithm: str = "auto", distribute: str = "auto", **k
 
         if algorithm == "cgm":
             return pcgm.distributed_cgm_select(jnp.asarray(x), k, **kwargs)
-        return pradix.distributed_radix_select(jnp.asarray(x), k, **kwargs)
-    return api.kselect(jnp.asarray(x), k, algorithm=algorithm, **kwargs)
+        # raw x: the distributed entry runs the f64-on-TPU host-key route
+        # before any device commitment (parallel/radix.py)
+        return pradix.distributed_radix_select(x, k, **kwargs)
+    return api.kselect(x, k, algorithm=algorithm, **kwargs)
 
 
 def plan_many(n: int, distribute: str = "auto", devices: int | None = None):
@@ -131,17 +133,15 @@ def kselect_many(x, ks, *, distribute: str = "auto", devices: int | None = None,
     if mesh is not None:
         from mpi_k_selection_tpu.parallel import radix as pradix
 
-        out = pradix.distributed_radix_select_many(
-            jnp.asarray(x), ks, mesh=mesh, **kwargs
-        )
+        out = pradix.distributed_radix_select_many(x, ks, mesh=mesh, **kwargs)
         return api.restore_k_shape(out, ks)
-    return api.kselect_many(jnp.asarray(x), ks, **kwargs)
+    return api.kselect_many(x, ks, **kwargs)
 
 
 def quantiles(x, qs, *, distribute: str = "auto", devices: int | None = None, **kwargs):
     """Exact nearest-rank order statistics at quantiles ``qs``; distributes
     like :func:`kselect_many`."""
-    x = jnp.asarray(x)
+    x = api.as_selection_array(x)
     ks = api.quantile_ks(qs, x.size)
     return kselect_many(x, ks, distribute=distribute, devices=devices, **kwargs)
 
@@ -153,5 +153,5 @@ def topk(x, k: int, *, largest: bool = True, **kwargs):
 
 
 def median(x, **kwargs):
-    x = jnp.asarray(x)
+    x = api.as_selection_array(x)
     return kselect(x, max(1, x.size // 2), **kwargs)

@@ -134,9 +134,9 @@ def _run_kth(args, x):
             :2
         ]
     else:
-        import jax.numpy as jnp
+        from mpi_k_selection_tpu import api as _api
 
-        xd = jnp.asarray(x)
+        xd = _api.as_selection_array(x)
         effective_algorithm, distributed = backend.plan(
             n, args.algorithm, args.distribute
         )
@@ -199,7 +199,9 @@ def _run_quantiles(args, x):
             f"(multi-rank selection is a radix-descent path), not "
             f"{args.algorithm!r}"
         )
-    xd = jnp.asarray(x)
+    from mpi_k_selection_tpu import api as _api
+
+    xd = _api.as_selection_array(x)
     backend = get_backend("tpu")
     # the backend owns the whole dispatch (plan_many + rank conversion +
     # mesh path); the CLI re-plans only to label the result record —

@@ -93,3 +93,37 @@ def test_kselect_many_large_k_sort_dispatch(rng):
     ks = np.linspace(1, n, 128).astype(np.int64)
     got = np.asarray(pkg.kselect_many(x, ks))
     np.testing.assert_array_equal(got, np.sort(x, kind="stable")[ks - 1])
+
+
+def test_f64_host_route_reachable_from_api(monkeypatch, rng):
+    """api.kselect/kselect_many must NOT device-commit host float64 on the
+    TPU backend (device f64 storage truncates, measured on v5e): the host
+    array flows through as_selection_array to the exact host-key route.
+    Emulated off-TPU by patching the backend name — the route itself is
+    pure host numpy + uint64 device select, so it runs anywhere."""
+    import jax
+
+    import mpi_k_selection_tpu as pkg
+    from mpi_k_selection_tpu import api
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    with jax.enable_x64(True):
+        # large-n radix route
+        x = rng.standard_normal(70_001)
+        kept = api.as_selection_array(x)
+        assert isinstance(kept, np.ndarray) and kept.dtype == np.float64
+        # scatter method: the patched backend name would otherwise make
+        # the pallas wrappers pick compiled (non-interpret) mode on CPU
+        got = float(pkg.kselect(x, 35_000, hist_method="scatter"))
+        assert got == float(np.sort(x, kind="stable")[34_999])
+        # small-n sort route stays host-side too
+        xs = rng.standard_normal(1_000)
+        got = float(pkg.kselect(xs, 500))
+        assert got == float(np.sort(xs, kind="stable")[499])
+        # multi-rank: radix route and the large-K sort route
+        ks = np.array([1, 35_000, 70_001])
+        gm = np.asarray(pkg.kselect_many(x, ks, hist_method="scatter"))
+        np.testing.assert_array_equal(gm, np.sort(x, kind="stable")[ks - 1])
+        ks_big = np.linspace(1, 70_001, 128).astype(np.int64)
+        gm = np.asarray(pkg.kselect_many(x, ks_big))
+        np.testing.assert_array_equal(gm, np.sort(x, kind="stable")[ks_big - 1])

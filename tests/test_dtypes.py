@@ -133,3 +133,41 @@ def test_sortable_from_raw_bits_matches_to_sortable():
             got = np.asarray(sortable_from_raw_bits(raw, dtype))
             want = np.asarray(to_sortable_bits(jnp.asarray(x)))
             np.testing.assert_array_equal(got, want, err_msg=str(dtype))
+
+
+def test_f64_tpu_host_keys_and_decode_roundtrip(monkeypatch):
+    """The f64-on-TPU exact route's host-side halves, unit-tested off-TPU:
+    keys must equal the bitcast to_sortable transform, the decode must
+    invert bit-exactly (incl. -0.0 and infinities), and without x64 the
+    route must raise instead of silently truncating keys to uint32."""
+    import jax
+    import jax.numpy as jnp
+
+    from mpi_k_selection_tpu.ops import radix as radix_mod
+    from mpi_k_selection_tpu.utils.dtypes import to_sortable_bits
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    rng = np.random.default_rng(3)
+    x = np.concatenate([
+        rng.standard_normal(4096),
+        np.array([0.0, -0.0, np.inf, -np.inf, np.finfo(np.float64).max]),
+    ])
+    with jax.enable_x64(True):
+        keys = radix_mod._f64_tpu_host_keys(x)
+        assert keys is not None and keys.dtype == jnp.uint64
+        want = np.asarray(to_sortable_bits(jnp.asarray(x)))
+        np.testing.assert_array_equal(np.asarray(keys), want)
+        # key order == value order
+        order_k = np.argsort(np.asarray(keys), kind="stable")
+        order_v = np.argsort(x, kind="stable")
+        np.testing.assert_array_equal(x[order_k], x[order_v])
+        # decode inverts bit-exactly (host-side, no device round trip)
+        back = radix_mod._f64_from_keys_host(keys)
+        np.testing.assert_array_equal(back.view(np.uint64), x.view(np.uint64))
+        # non-f64 and non-tpu inputs decline the route
+        assert radix_mod._f64_tpu_host_keys(x.astype(np.float32)) is None
+    # x64 off: must raise the clear error, not truncate
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="64-bit"):
+        radix_mod._f64_tpu_host_keys(x)
