@@ -88,9 +88,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--batch", type=int, default=None, help="batch dimension for top-k")
     p.add_argument(
         "--topk-method",
-        choices=("auto", "flat", "chunked", "threshold", "tournament"),
+        choices=("auto", "flat", "chunked", "threshold", "tournament", "block"),
         default="auto",
-        help="top-k algorithm (see ops/topk.py)",
+        help="top-k algorithm (see ops/topk.py; block = the Pallas batched "
+        "values kernel, 2-D float32 largest k<=8)",
     )
     p.add_argument("--repeats", type=int, default=1)
     p.add_argument("--verify", action="store_true", help="check against the seq oracle")
