@@ -16,6 +16,10 @@ from mpi_k_selection_tpu.utils.debug import check_concrete_k, check_concrete_ks
 
 ALGORITHMS = ("auto", "radix", "sort")
 
+# Measured sort/radix crossover for kselect_many (see the dispatch comment
+# there); module-level so the warning text below cannot drift from the code.
+MANY_SORT_DISPATCH_QUERIES = 112
+
 
 def as_selection_array(x):
     """``jnp.asarray`` for selection inputs, EXCEPT host float64 on the TPU
@@ -39,6 +43,30 @@ def as_selection_array(x):
 
 def _host_f64(x) -> bool:
     return isinstance(x, np.ndarray) and x.dtype == np.float64
+
+
+def _contains_tracer(ks) -> bool:
+    """True when ``ks`` is, or contains, a jax Tracer — WITHOUT converting
+    to numpy: ``np.atleast_1d`` on a traced scalar (or on a Python list
+    holding one) raises TracerArrayConversionError before any isinstance
+    check downstream could route around it."""
+    import jax
+
+    if isinstance(ks, jax.core.Tracer):
+        return True
+    if isinstance(ks, (np.ndarray, jax.Array)):
+        return False  # concrete arrays cannot hold tracers
+    if isinstance(ks, (list, tuple)):
+        return any(_contains_tracer(kv) for kv in ks)
+    return False
+
+
+def _count_query_leaves(ks) -> int:
+    """Query count of a (possibly nested) container of ks without numpy
+    conversion — tracers expose ``.shape``, containers recurse."""
+    if isinstance(ks, (list, tuple)):
+        return sum(_count_query_leaves(kv) for kv in ks)
+    return int(np.prod(np.shape(ks), dtype=np.int64)) if np.shape(ks) else 1
 
 
 def kselect(x, k, *, algorithm: str = "auto", **kwargs):
@@ -80,7 +108,13 @@ def kselect_many(x, ks, **kwargs):
     if x.size == 0:
         raise ValueError("kselect_many requires a non-empty input")
     check_concrete_ks(ks, x.size)
-    n_queries = int(np.prod(np.shape(ks), dtype=np.int64)) if np.shape(ks) else 1
+    if isinstance(ks, (list, tuple)) and _contains_tracer(ks):
+        # np.shape on a container of tracers would convert (and crash);
+        # count leaves recursively so nested containers dispatch the same
+        # as their concrete twins
+        n_queries = _count_query_leaves(ks)
+    else:
+        n_queries = int(np.prod(np.shape(ks), dtype=np.int64)) if np.shape(ks) else 1
     # Measured dispatch constant (r4, v5e, n=2^27 int32): the multi-prefix
     # walk costs ~3.4 ms per query (the per-query masked SWAR accumulate is
     # linear in K) while one lax.sort of the whole array costs 409 ms — the
@@ -89,30 +123,34 @@ def kselect_many(x, ks, **kwargs):
     # that one measured shape: walk cost scales ~K*n and sort ~n log n, so
     # the true crossover drifts slowly with n; 112 keeps radix preferred
     # everywhere it measured faster.
-    if x.size <= 1 << 14 or n_queries >= 112:
-        if kwargs:
-            import warnings
+    if x.size <= 1 << 14 or n_queries >= MANY_SORT_DISPATCH_QUERIES:
+        def warn_kwargs_ignored():
+            # only the sort branches drop kwargs; the host-f64 traced-ks
+            # branch below routes back to radix where they are honored
+            if kwargs:
+                import warnings
 
-            warnings.warn(
-                f"kselect_many: this shape takes the sort path (small input "
-                f"or >= 96 queries); radix options {sorted(kwargs)} are "
-                "ignored",
-                stacklevel=2,
-            )
+                warnings.warn(
+                    f"kselect_many: this shape takes the sort path (small "
+                    f"input or >= {MANY_SORT_DISPATCH_QUERIES} queries); "
+                    f"radix options {sorted(kwargs)} are ignored",
+                    stacklevel=3,
+                )
+
         from mpi_k_selection_tpu.ops.radix import select_count_dtype
 
         if _host_f64(x):
-            import jax
-
-            if any(
-                isinstance(kv, jax.core.Tracer) for kv in np.atleast_1d(ks)
-            ) or isinstance(ks, jax.core.Tracer):
-                out = radix_select_many(x, ks, **kwargs)  # exact host route
+            if _contains_tracer(ks):
+                # radix shell: exact host route eagerly, documented
+                # approximation under an active trace; kwargs honored
+                out = radix_select_many(x, ks, **kwargs)
             else:
+                warn_kwargs_ignored()
                 ks_np = np.atleast_1d(np.asarray(ks, dtype=np.int64))
                 s_np = np.sort(x.ravel(), kind="stable")
                 out = s_np[np.clip(ks_np - 1, 0, x.size - 1)].reshape(ks_np.shape)
             return restore_k_shape(out, ks)
+        warn_kwargs_ignored()
         ks_arr = jnp.atleast_1d(jnp.asarray(ks))
         s = jnp.sort(x.ravel())
         # rank dtype sized to n: an int32 cast would silently wrap int64
@@ -151,6 +189,9 @@ def quantile_ks(qs, n: int) -> jnp.ndarray:
 def restore_k_shape(out, ks):
     """Shape contract of the *_many entry points: answers carry ``ks``'s
     shape, so a scalar k returns a scalar (matching :func:`kselect`)."""
+    if isinstance(ks, (list, tuple)):
+        return out  # containers are 1-D query lists (np.ndim would convert
+        # and crash on a list holding tracers)
     return out.reshape(()) if np.ndim(ks) == 0 else out
 
 

@@ -498,6 +498,78 @@ def _collect_via_counts(prep, resolved_passes: int, prefixes, budget: int):
     return jnp.where(jj[None, :] < pops[:, None], vals, maxkey), pops
 
 
+def _trace_state_clean() -> bool:
+    """True when no jax trace is active (we are in eager context). Private
+    jax API; if it moves, the True fallback alone would reinstate the
+    concrete-f64-in-jit crash, so the host route's callers ALSO wrap the
+    host decode in a TracerArrayConversionError rescue (belt and braces —
+    see radix_select)."""
+    try:
+        from jax._src.core import trace_state_clean
+
+        return trace_state_clean()
+    except Exception:  # pragma: no cover - jax internals moved
+        return True
+
+
+_f64_tpu_approx_warned = False
+
+
+def _warn_f64_tpu_approx(x):
+    """One-time warning when an f64-on-TPU selection takes the traced
+    ~49-bit key approximation (utils/dtypes.py:f64_raw_bits) instead of the
+    exact host-key route — the one dtype/backend pair where a jit silently
+    changes the answer's guarantee. Fires for traced f64 inputs and for
+    concrete f64 closed over inside a user jit; never on the exact host
+    route (``_f64_tpu_host_keys`` succeeded) and never off-TPU."""
+    global _f64_tpu_approx_warned
+    if _f64_tpu_approx_warned:
+        return
+    try:
+        is_f64 = np.dtype(x.dtype) == np.float64
+    except Exception:
+        return
+    if is_f64 and jax.default_backend() == "tpu":
+        _f64_tpu_approx_warned = True
+        import inspect
+        import warnings
+
+        # attribute the warning to the first frame OUTSIDE this package so
+        # a user with several f64 selection sites sees which one fired
+        # (the shells are reached at varying depth: directly, via api.*,
+        # via backends/CLI)
+        level, pkg = 2, __name__.split(".")[0]
+        for level, frame in enumerate(inspect.stack()[1:], start=2):
+            if pkg not in frame.frame.f_globals.get("__name__", ""):
+                break
+        warnings.warn(
+            "float64 selection inside jit on TPU uses an approximate ~49-bit "
+            "key (TPU f64 is double-double; exact f64 bitcasts crash its "
+            "compiler). For bit-exact f64 results call the selection "
+            "eagerly with a host (numpy) array — see docs/API.md. "
+            "This warning is emitted once per process.",
+            stacklevel=level,
+        )
+
+
+def _f64_exact_shell(traced_fn, x, *args, **kwargs):
+    """The eager f64-on-TPU shell shared by :func:`radix_select` and
+    :func:`radix_select_many`: exact host-derived uint64 keys when the host
+    route applies, otherwise the traced-path approximation with the
+    one-time warning. The TracerArrayConversionError rescue wraps ONLY the
+    host decode (not the select itself), so a genuine conversion bug inside
+    the traced select still surfaces from its real path."""
+    keys = _f64_tpu_host_keys(x)
+    if keys is not None:
+        res = traced_fn(keys, *args, **kwargs)
+        try:
+            return _f64_from_keys_host(res)
+        except jax.errors.TracerArrayConversionError:
+            pass  # trace active but undetected (jax internals moved)
+    _warn_f64_tpu_approx(x)
+    return traced_fn(x, *args, **kwargs)
+
+
 def _f64_tpu_host_keys(x):
     """Exact uint64 sortable keys for a CONCRETE float64 array on the TPU
     backend, or None when the trick does not apply.
@@ -521,6 +593,12 @@ def _f64_tpu_host_keys(x):
     if isinstance(x, jax.core.Tracer):
         return None
     if np.dtype(x.dtype) != np.float64:
+        return None
+    # Inside a user trace the host route cannot work even for a CONCRETE x
+    # (a closure constant): the select result is a tracer, and the host-side
+    # decode (np.asarray in _f64_from_keys_host) would raise
+    # TracerArrayConversionError. Fall through to the traced approximation.
+    if not _trace_state_clean():
         return None
     # same x64 requirement (and error) as the traced path: without it,
     # jnp.asarray would silently truncate the uint64 keys to uint32
@@ -704,10 +782,7 @@ def radix_select(x, k, **kwargs):
     else goes straight through. Inside a user ``jit`` the shell is traced
     away and f64-on-TPU falls back to the documented ~49-bit key
     approximation (utils/dtypes.py:f64_raw_bits)."""
-    keys = _f64_tpu_host_keys(x)
-    if keys is not None:
-        return _f64_from_keys_host(_radix_select_traced(keys, k, **kwargs))
-    return _radix_select_traced(x, k, **kwargs)
+    return _f64_exact_shell(_radix_select_traced, x, k, **kwargs)
 
 
 def _collect_prefix_matches_multi(
@@ -913,7 +988,4 @@ def radix_select_many(x, ks, **kwargs):
     """Exact k-th smallest for every k in ``ks``. Same eager shell as
     :func:`radix_select` (exact f64-on-TPU via host-derived keys); see
     :func:`_radix_select_many_traced` for the descent and options."""
-    keys = _f64_tpu_host_keys(x)
-    if keys is not None:
-        return _f64_from_keys_host(_radix_select_many_traced(keys, ks, **kwargs))
-    return _radix_select_many_traced(x, ks, **kwargs)
+    return _f64_exact_shell(_radix_select_many_traced, x, ks, **kwargs)

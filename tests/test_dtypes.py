@@ -171,3 +171,59 @@ def test_f64_tpu_host_keys_and_decode_roundtrip(monkeypatch):
 
     with _pytest.raises(ValueError, match="64-bit"):
         radix_mod._f64_tpu_host_keys(x)
+
+
+def test_f64_tpu_host_route_declines_under_trace_and_warns(monkeypatch):
+    """ADVICE r4 (medium) + VERDICT r4 item 4: a CONCRETE f64 array closed
+    over inside a user jit must NOT take the host-key route (the host-side
+    decode of a traced select result would raise
+    TracerArrayConversionError); it falls through to the traced
+    approximation and emits the one-time approximate-f64 warning. The
+    eager exact route must stay silent."""
+    import warnings
+
+    import jax
+    import jax.numpy as jnp
+    import pytest
+
+    from mpi_k_selection_tpu.ops import radix as radix_mod
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(radix_mod, "_f64_tpu_approx_warned", False)
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal(4096)
+    want = float(np.sort(x, kind="stable")[499])
+    with jax.enable_x64(True):
+        # the gate itself: concrete x, active trace -> route declined
+        seen = {}
+
+        def probe():
+            seen["keys"] = radix_mod._f64_tpu_host_keys(x)
+            return jnp.zeros(())
+
+        jax.jit(probe)()
+        assert seen["keys"] is None
+
+        # end-to-end: the advisor's reproducer must not crash, and must
+        # warn once (scatter: the patched backend name would otherwise pick
+        # the compiled pallas path on the CPU test host). On real CPU
+        # devices the "approximation" is bit-exact, so the value checks.
+        with pytest.warns(UserWarning, match="approximate"):
+            got = jax.jit(
+                lambda: radix_mod.radix_select(x, 500, hist_method="scatter")
+            )()
+        assert float(got) == want
+        # one-time: a second traced call stays silent
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            jax.jit(
+                lambda: radix_mod.radix_select_many(
+                    x, jnp.asarray([500]), hist_method="scatter"
+                )
+            )()
+        # the eager exact host route never warns
+        monkeypatch.setattr(radix_mod, "_f64_tpu_approx_warned", False)
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            got = radix_mod.radix_select(x, 500, hist_method="scatter")
+        assert float(np.asarray(got)) == want
