@@ -1093,3 +1093,116 @@ def pallas_match_counts(
     # (grid, nq, groups, 128) -> (nq, grid*groups*128) == (nq, R)
     cnt = out.reshape(grid, nq, groups, LANES).transpose(1, 0, 2, 3).reshape(nq, -1)
     return cnt.astype(count_dtype)
+
+
+# ---------------------------------------------------------------------------
+# Tau-threshold counts: the top-k winner collect's streaming counter (r5).
+#
+# The top-k threshold path (ops/topk.py:_threshold_topk_indices) needs, for
+# ONE full-width key tau, how many elements per tile row compare strictly
+# beyond tau and how many equal it — the two numbers that route every winner
+# slot to its subblock. Same tile geometry and in-kernel key transform as
+# the match-count kernel above; the order compare runs in signed space by
+# folding the uint32->int32 bias (^0x80000000) into both the key transform
+# and the reference.
+# ---------------------------------------------------------------------------
+
+
+def _tau_count_kernel(tau_ref, keys_ref, out_ref, *, key_op, key_xor, largest, n):
+    i = pl.program_id(0)
+    rows = keys_ref.shape[0]
+    groups = rows // 128
+    k = jax.lax.bitcast_convert_type(keys_ref[:], jnp.int32)
+    if key_op == "float":
+        # sortable key ^ 0x80000000: raw ^ (raw < 0 ? 0x7FFFFFFF : 0)
+        s = k ^ jnp.where(k < jnp.int32(0), jnp.int32(_i32const(0x7FFFFFFF)), jnp.int32(0))
+    elif key_op == "xor":
+        s = k ^ jnp.int32(_i32const((key_xor ^ 0x80000000) & 0xFFFFFFFF))
+    else:  # key-space uint32 tiles
+        s = k ^ jnp.int32(_i32const(0x80000000))
+    base = i * rows
+    gpos = (
+        (base + jax.lax.broadcasted_iota(jnp.int32, (rows, LANES), 0)) * LANES
+        + jax.lax.broadcasted_iota(jnp.int32, (rows, LANES), 1)
+    )
+    valid = gpos < jnp.int32(n)
+    tau = tau_ref[0, 0]
+    beyond = (s > tau) if largest else (s < tau)
+    mg = jnp.logical_and(beyond, valid).astype(jnp.int32)
+    me = jnp.logical_and(s == tau, valid).astype(jnp.int32)
+    out_ref[0:groups, :] = jnp.sum(mg.reshape(groups, 128, LANES), axis=2)
+    out_ref[groups:2 * groups, :] = jnp.sum(me.reshape(groups, 128, LANES), axis=2)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("block_rows", "interpret", "orig_n", "key_op", "key_xor",
+                     "largest", "count_dtype"),
+)
+def pallas_tau_counts(
+    *,
+    tau_key: jax.Array,
+    tiles: jax.Array,
+    orig_n: int,
+    key_op: str = "none",
+    key_xor: int = 0,
+    largest: bool = True,
+    count_dtype=jnp.int32,
+    block_rows: int = 4096,
+    interpret: bool | None = None,
+):
+    """``(beyond, eq)`` counts per tile ROW for one full-width key ``tau_key``
+    (uint32 key space): ``beyond[r]`` = elements in row r whose key is
+    strictly greater (``largest=True``) or strictly less (``largest=False``)
+    than tau; ``eq[r]`` = exact key matches. One streaming read; 32-bit
+    tiles only. Row r covers elements ``[r*128, r*128+128)`` in lane order;
+    pad positions past ``orig_n`` are excluded in kernel."""
+    if pltpu is None:
+        raise NotImplementedError(
+            "the pallas histogram kernel is not available in this jax build"
+        )
+    if key_op not in ("none", "xor", "float"):
+        raise ValueError(f"unknown key_op {key_op!r}")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    _check_block_rows(block_rows)
+    if block_rows % 128:
+        raise ValueError(f"block_rows={block_rows} must be a multiple of 128")
+    R = tiles.shape[0]
+    if R % block_rows or tiles.shape[1] != LANES:
+        raise ValueError(f"tiles shape {tiles.shape} vs block_rows={block_rows}")
+    if np.dtype(tiles.dtype).itemsize != 4:
+        raise ValueError(f"tiles must be a 4-byte dtype, got {tiles.dtype}")
+    grid = R // block_rows
+    groups = block_rows // 128
+    # signed-comparable reference: key ^ 0x80000000, bitcast to int32
+    tau = jax.lax.bitcast_convert_type(
+        tau_key.astype(jnp.uint32) ^ jnp.uint32(0x80000000), jnp.int32
+    ).reshape(1, 1)
+    kernel = functools.partial(
+        _tau_count_kernel, key_op=key_op, key_xor=key_xor, largest=largest,
+        n=orig_n,
+    )
+    vma = jax.typeof(tiles).vma  # see pallas_radix_histogram
+    tau = _match_vma(tau, vma)
+    with jax.enable_x64(False):
+        out = pl.pallas_call(
+            kernel,
+            grid=(grid,),
+            in_specs=[
+                pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+                pl.BlockSpec(
+                    (block_rows, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM
+                ),
+            ],
+            out_specs=pl.BlockSpec(
+                (2 * groups, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM
+            ),
+            out_shape=jax.ShapeDtypeStruct(
+                (grid * 2 * groups, LANES), jnp.int32, vma=vma
+            ),
+            interpret=interpret,
+        )(tau, tiles)
+    # (grid, 2, groups, 128) -> (2, grid*groups*128) == (2, R)
+    cnt = out.reshape(grid, 2, groups, LANES).transpose(1, 0, 2, 3).reshape(2, -1)
+    return cnt[0].astype(count_dtype), cnt[1].astype(count_dtype)

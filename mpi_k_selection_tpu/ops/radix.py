@@ -671,8 +671,32 @@ def _radix_select_traced(
     11.4ms at N=134M) — use ``cutover`` instead.
     """
     x = x.ravel()
-    n = x.shape[0]
     prep = _Descent(x, radix_bits, hist_method, chunk, block_rows)
+    ans = _select_key_on_prep(
+        prep,
+        x.shape[0],
+        k,
+        early_exit_budget=early_exit_budget,
+        cutover=cutover,
+        cutover_budget=cutover_budget,
+    )
+    return _dt.from_sortable_bits(ans, x.dtype)
+
+
+def _select_key_on_prep(
+    prep: "_Descent",
+    n: int,
+    k,
+    *,
+    early_exit_budget: int | None = None,
+    cutover: int | str | None = "auto",
+    cutover_budget: int = 8192,
+):
+    """The radix descent on a prebuilt :class:`_Descent`, returning the
+    answer in KEY space. Split out of :func:`_radix_select_traced` (r5) so
+    the top-k threshold path can run the select AND the winner collect on
+    ONE prepared tile set — building a second `_Descent` (or re-deriving
+    ``to_sortable_bits(x)``) costs a full read+write pass of x."""
     radix_bits, total_bits, npasses = prep.radix_bits, prep.total_bits, prep.npasses
     cdt, kdt, one_pass = prep.cdt, prep.kdt, prep.one_pass
     u_collect, n_collect, key_of = prep.u_collect, prep.n_collect, prep.key_of
@@ -736,13 +760,13 @@ def _radix_select_traced(
             ncut, npasses, pop, lambda q: q <= cutover_budget, step,
             finish_small, finish_full_from, (prefix, kk),
         )
-        return _dt.from_sortable_bits(ans, x.dtype)
+        return ans
 
     if not early:
         prefix = jnp.zeros((), kdt)
         for p in range(npasses):
             prefix, kk, _ = one_pass(p, prefix, kk)
-        return _dt.from_sortable_bits(prefix, x.dtype)
+        return prefix
 
     # pass 0 always runs (n > budget); later passes are cond-skipped once the
     # matching population fits the budget
@@ -768,10 +792,9 @@ def _radix_select_traced(
     # population never fit the budget => every key bit is resolved and all
     # matching elements equal the prefix itself; the collection only runs
     # (cond) when the early exit actually fired
-    ans = jax.lax.cond(
+    return jax.lax.cond(
         pop > early_exit_budget, lambda _: prefix, finish_small, operand=None
     )
-    return _dt.from_sortable_bits(ans, x.dtype)
 
 
 def radix_select(x, k, **kwargs):

@@ -184,6 +184,82 @@ def test_block_topk_index_recovery_nan_rescue(rng):
     np.testing.assert_array_equal(full, np.asarray(refidx))
 
 
+def test_pallas_tau_counts_kernel(rng):
+    """The r5 tau-threshold count kernel (interpret mode) vs numpy: per
+    tile-row counts of keys strictly beyond / equal to a full-width tau,
+    across key_op variants, both directions, and pad masking."""
+    from mpi_k_selection_tpu.ops.pallas.histogram import pallas_tau_counts
+    from mpi_k_selection_tpu.utils.dtypes import to_sortable_bits
+
+    R = 128  # tile rows (must be a multiple of block_rows)
+    n = 128 * R - 37  # ragged: the last row is partly pad
+    for name, x, key_op, key_xor in [
+        ("float", rng.standard_normal(n).astype(np.float32), "float", 0),
+        (
+            "xor",
+            rng.integers(-(2**31), 2**31 - 1, size=n, dtype=np.int32),
+            "xor",
+            0x80000000,
+        ),
+    ]:
+        raw = x.view(np.uint32)
+        tiles = jnp.asarray(
+            np.pad(raw, (0, R * 128 - n)).reshape(R, 128).view(np.int32)
+        )
+        u = np.asarray(to_sortable_bits(jnp.asarray(x)))
+        tauk = np.asarray(u[n // 3])
+        for largest in (True, False):
+            cgt, ceq = pallas_tau_counts(
+                tau_key=jnp.asarray(tauk),
+                tiles=tiles,
+                orig_n=n,
+                key_op=key_op,
+                key_xor=key_xor,
+                largest=largest,
+                block_rows=128,
+                interpret=True,
+            )
+            up = np.pad(u, (0, R * 128 - n)).reshape(R, 128)
+            valid = (np.arange(R * 128) < n).reshape(R, 128)
+            want_b = (((up > tauk) if largest else (up < tauk)) & valid).sum(1)
+            want_e = ((up == tauk) & valid).sum(1)
+            np.testing.assert_array_equal(
+                np.asarray(cgt), want_b, err_msg=f"{name} largest={largest}"
+            )
+            np.testing.assert_array_equal(np.asarray(ceq), want_e, err_msg=name)
+
+
+def test_threshold_indices_via_counts_path(rng):
+    """The r5 prepared-tiles winner collect (interpret-mode kernel) must
+    reproduce lax.top_k indices exactly, incl. ties and smallest-k; off-TPU
+    the public topk() takes the jnp fallback, so this drives the fast path
+    directly."""
+    import jax
+
+    from mpi_k_selection_tpu.ops.radix import _Descent, _select_key_on_prep
+    from mpi_k_selection_tpu.ops.topk import _threshold_indices_via_counts
+
+    n, k = 1 << 14, 32
+    for name, x in [
+        ("random", rng.standard_normal(n).astype(np.float32)),
+        ("ties", rng.integers(0, 40, size=n).astype(np.float32)),
+    ]:
+        xj = jnp.asarray(x)
+        # force the pallas raw-tile preparation (interpret mode off-TPU) —
+        # "auto" resolves to tile-less jnp methods on the CPU test host
+        prep = _Descent(xj, None, "pallas", 32768, block_rows=128)
+        assert prep.count_tiles is not None and len(prep.tiles) == 1
+        tauk = _select_key_on_prep(prep, n, jnp.asarray(n - k + 1))
+        idx = np.asarray(_threshold_indices_via_counts(prep, tauk, k, True))
+        _, ref = jax.lax.top_k(xj, k)
+        np.testing.assert_array_equal(idx, np.asarray(ref), err_msg=name)
+        # smallest-k: mirror rank + direction
+        tauk2 = _select_key_on_prep(prep, n, jnp.asarray(k))
+        idx2 = np.asarray(_threshold_indices_via_counts(prep, tauk2, k, False))
+        want2 = np.argsort(x, kind="stable")[:k]
+        np.testing.assert_array_equal(idx2, want2, err_msg=name)
+
+
 def test_block_topk_nan_rows(rng):
     # NaN floods a lane's chain registers; isnan(lane3) must flag the row
     # so the lax.top_k rescue handles it instead of returning flood garbage

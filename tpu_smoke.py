@@ -156,6 +156,28 @@ def main() -> int:
         check("int64 select_many (multi kernels, all passes)",
               got_m, np.sort(x64v)[ksq - 1])
 
+    # --- top-k paths over the compiled kernels (r5: both return indices) ---
+    print("topk end-to-end (compiled kernels):")
+    from mpi_k_selection_tpu.ops.topk import topk
+
+    # threshold via-counts: the pallas_tau_counts kernel + tile collect
+    xtf = rng.standard_normal((1 << 21) + 123).astype(np.float32)
+    xtd = jax.device_put(jnp.asarray(xtf))
+    v, i = topk(xtd, 128, method="threshold")
+    order = np.argsort(-xtf, kind="stable")[:128]
+    check("threshold 2M f32 k=128 values", v, xtf[order])
+    check("threshold 2M f32 k=128 indices", i, order)
+    v, i = topk(xtd, 64, method="threshold", largest=False)
+    order_s = np.argsort(xtf, kind="stable")[:64]
+    check("threshold smallest k=64 indices", i, order_s)
+    # block kernel + streaming index recovery at a reduced batched shape
+    xb = rng.standard_normal((256, 8192)).astype(np.float32)
+    xbd = jax.device_put(jnp.asarray(xb))
+    v, i = topk(xbd, 8, method="block")
+    rv, ri = jax.lax.top_k(xbd, 8)
+    check("block 256x8192 k=8 values", v, rv)
+    check("block 256x8192 k=8 indices", i, ri)
+
     if failures:
         print(f"tpu_smoke: {len(failures)} FAILURES")
         return 1
