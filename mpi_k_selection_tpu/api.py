@@ -16,9 +16,23 @@ from mpi_k_selection_tpu.utils.debug import check_concrete_k, check_concrete_ks
 
 ALGORITHMS = ("auto", "radix", "sort")
 
-# Measured sort/radix crossover for kselect_many (see the dispatch comment
-# there); module-level so the warning text below cannot drift from the code.
-MANY_SORT_DISPATCH_QUERIES = 112
+def many_sort_dispatch_queries(n: int) -> int:
+    """Query count above which :func:`kselect_many` takes the one-sort-
+    K-gathers path instead of the shared radix walk, as a function of n.
+
+    Measured crossovers (v5e, int32, differential chains, r5): K* ~= 82
+    at n=2^24 (sort 36.8 ms / walk 0.44 ms/query) and ~134 at 2^28 (sort
+    914 ms / walk 6.83 ms/query). The per-query walk costs ~c1*n (the
+    masked multi-prefix accumulate is linear in K and n) while the
+    one-shot sort costs ~c2*n*log n, so K* = c2/c1 * log n — linear in
+    log2(n). Fit through those two points: ``K* = 13*log2(n) - 230``,
+    clamped to [64, 192] outside the measured range. At n=2^27 the rule
+    gives 121, consistent with the r4 component measurements there (sort
+    409 ms / walk ~3.4 ms/query ~= 120; r4's rounder "~110, constant
+    112" estimate sat inside the same ±15% noise band)."""
+    import math
+
+    return int(min(192, max(64, round(13 * math.log2(max(n, 2)) - 230))))
 
 
 def as_selection_array(x):
@@ -115,15 +129,12 @@ def kselect_many(x, ks, **kwargs):
         n_queries = _count_query_leaves(ks)
     else:
         n_queries = int(np.prod(np.shape(ks), dtype=np.int64)) if np.shape(ks) else 1
-    # Measured dispatch constant (r4, v5e, n=2^27 int32): the multi-prefix
-    # walk costs ~3.4 ms per query (the per-query masked SWAR accumulate is
-    # linear in K) while one lax.sort of the whole array costs 409 ms — the
-    # crossover sits near K~110, so radix wins for every K below 112 and
-    # one K-independent sort + K gathers wins above. The constant encodes
-    # that one measured shape: walk cost scales ~K*n and sort ~n log n, so
-    # the true crossover drifts slowly with n; 112 keeps radix preferred
-    # everywhere it measured faster.
-    if x.size <= 1 << 14 or n_queries >= MANY_SORT_DISPATCH_QUERIES:
+    # n-aware dispatch (r5): the multi-prefix walk costs ~c1*n per query
+    # (the per-query masked SWAR accumulate is linear in K) while one
+    # lax.sort of the whole array costs ~c2*n*log n, so the crossover
+    # grows with log2(n) — 82/110/134 queries measured at n=2^24/27/28.
+    sort_at = many_sort_dispatch_queries(x.size)
+    if x.size <= 1 << 14 or n_queries >= sort_at:
         def warn_kwargs_ignored():
             # only the sort branches drop kwargs; the host-f64 traced-ks
             # branch below routes back to radix where they are honored
@@ -132,7 +143,7 @@ def kselect_many(x, ks, **kwargs):
 
                 warnings.warn(
                     f"kselect_many: this shape takes the sort path (small "
-                    f"input or >= {MANY_SORT_DISPATCH_QUERIES} queries); "
+                    f"input or >= {sort_at} queries at this n); "
                     f"radix options {sorted(kwargs)} are ignored",
                     stacklevel=3,
                 )
@@ -154,7 +165,8 @@ def kselect_many(x, ks, **kwargs):
         ks_arr = jnp.atleast_1d(jnp.asarray(ks))
         s = jnp.sort(x.ravel())
         # rank dtype sized to n: an int32 cast would silently wrap int64
-        # ranks for n >= 2^31 (this path is reachable at any n via K >= 112)
+        # ranks for n >= 2^31 (this path is reachable at any n via K >= 192,
+        # the dispatch clamp's ceiling)
         idx = jnp.clip(ks_arr.astype(select_count_dtype(x.size)) - 1, 0, x.size - 1)
         out = s[idx.ravel()].reshape(ks_arr.shape)
     else:
