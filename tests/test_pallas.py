@@ -40,7 +40,10 @@ def _oracle(keys, shift, radix_bits, prefix):
     [(n, s, rb, p)
      for n in (128, 1000, 12345, 1 << 17)
      for (s, rb, p) in ((28, 4, None), (24, 4, 7), (0, 4, 2**27 - 5))]
-    + [(12345, 24, 8, None), (12345, 16, 8, 129)],
+    # ONE prefixed rb=8 case (r5: the unprefixed twin cost another ~16 s of
+    # interpret trace for strictly less logic — masking supersets it — and
+    # the unprefixed compiled kernel runs on hardware in tpu_smoke.py)
+    + [(12345, 16, 8, 129)],
 )
 def test_pallas_histogram_matches_oracle(rng, n, shift, radix_bits, prefix):
     keys = jnp.asarray(rng.integers(0, 2**32, size=n, dtype=np.uint32))
@@ -77,7 +80,10 @@ def test_pallas_histogram_small_block_multigrid(rng):
 )
 def test_pallas_histogram_default_block_adversarial_skew(rng, radix_bits, block_rows):
     # every element in ONE bucket: the SWAR byte-field overflow case
-    # (counts per field >> 255 without the periodic drain at flushes==17)
+    # (counts per field >> 255 without the periodic drain at flushes==17).
+    # NOTE (r5): do not shrink n for the rb=8 case — n=66_000 measured 3x
+    # SLOWER than 300_000 standalone (interpret-mode cost is not monotone
+    # in n at this geometry)
     n = 300_000
     keys = jnp.asarray(np.full(n, 0x12345678, dtype=np.uint32))
     got = np.asarray(
@@ -455,7 +461,8 @@ def test_radix_select_many_forced_cutover(rng):
 
     n = 2 * 256 * 128 + 17
     x = rng.integers(0, 1 << 24, size=n, dtype=np.int32)  # dense-ish range
-    ks = np.array([1, n // 3, n // 2, n])
+    # K=2 (the boundary ranks): the multi-pass trace cost is linear in K
+    ks = np.array([1, n])
     got = np.asarray(
         radix_select_many(
             jnp.asarray(x), ks, hist_method="pallas", cutover=3, block_rows=256
@@ -624,11 +631,23 @@ def test_pallas64_compare_variant_matches_oracle(rng):
         np.testing.assert_array_equal(got, want)
 
 
-def test_radix_select_pallas_compare_method_e2e(rng):
-    # the "pallas_compare" hist_method string end-to-end through dispatch
-    x = rng.integers(-(2**31), 2**31, size=40_001, dtype=np.int32)
+def test_radix_select_pallas_compare_method_dispatch(rng):
+    # the "pallas_compare" hist_method string through the masked-histogram
+    # dispatcher (r5: the former full-select e2e cost 35-48 s of interpret
+    # traces — one trace per descent pass — for coverage the per-variant
+    # oracle tests already give; the compiled full select through this
+    # string runs on hardware in tpu_smoke.py every round)
+    from mpi_k_selection_tpu.ops.histogram import masked_radix_histogram
+
+    # keys < 2^20: every key matches prefix 0 above shift+rb=20, so all 16
+    # buckets hold ~256 elements — a full-range draw left <= 1 match per
+    # bucket and the count would be vacuous (any broken accumulate passes)
+    keys = jnp.asarray(rng.integers(0, 2**20, size=4096, dtype=np.uint32))
     got = np.asarray(
-        radix_select(jnp.asarray(x), 20_000, hist_method="pallas_compare",
-                     block_rows=256)
-    )[()]
-    assert got == np.sort(x, kind="stable")[19_999]
+        masked_radix_histogram(
+            keys, shift=16, radix_bits=4, prefix=jnp.uint32(0),
+            method="pallas_compare",
+        )
+    )
+    assert int(got.sum()) == 4096  # non-vacuous: every element counted
+    np.testing.assert_array_equal(got, _oracle(keys, 16, 4, 0))

@@ -161,10 +161,15 @@ def test_disable_jit_python_paths(rng):
 
 def test_property_fuzz_random_configs(rng):
     # randomized sweep over (n, k, dtype, duplicates) vs the oracle —
-    # SURVEY.md §4 "property tests (random N, k, dtypes, duplicates-heavy)"
+    # SURVEY.md §4 "property tests (random N, k, dtypes, duplicates-heavy)".
+    # n is drawn from a fixed odd-size grid: k is a TRACED operand, so
+    # repeats of an (n, dtype) pair hit the jit cache — 25 fully-random n
+    # meant 25 fresh compiles (~15 s of this test's runtime for no extra
+    # path coverage; data and k stay random per trial)
     dtypes = [np.int32, np.uint32, np.int16, np.float32]
-    for trial in range(25):
-        n = int(rng.integers(1, 70_000))
+    sizes = [1, 977, 12_347, 69_999]
+    for trial in range(24):
+        n = sizes[(trial // 4) % len(sizes)]
         k = int(rng.integers(1, n + 1))
         dt = dtypes[trial % len(dtypes)]
         if rng.integers(0, 2):  # duplicates-heavy half the time

@@ -112,6 +112,14 @@ def main() -> int:
     xf = rng.standard_normal(1_000_000).astype(np.float32)
     got = float(radix_select(jax.device_put(jnp.asarray(xf)), 500_000))
     check("float32 median", got, float(np.sort(xf)[499_999]))
+    # the compare-per-bucket variant end-to-end (its interpret-mode e2e was
+    # retired from the CPU suite in r5 — each descent pass cost a multi-
+    # second interpret trace; compiled it is one cheap run)
+    xc = rng.integers(-(2**31), 2**31, size=300_001, dtype=np.int32)
+    got = int(radix_select(
+        jax.device_put(jnp.asarray(xc)), 150_000, hist_method="pallas_compare"
+    ))
+    check("int32 pallas_compare e2e", got, int(np.sort(xc)[149_999]))
     for dt in (np.float16, jnp.bfloat16):
         xh = (rng.standard_normal(300_001) * 8).astype(dt)
         got = radix_select(jax.device_put(jnp.asarray(xh)), 150_000)
