@@ -9,38 +9,47 @@ depth-3 + rescue ~1.2 ms — the variant below).
 
 Design (VPU-shaped, not a port of any CPU/GPU heap scheme):
 
-1. **Depth-3 insert chain** (`_chain3_kernel`): the (bb, bd) tile is viewed
-   as (bb, bd/128, 128) sublane slabs; each slab streams through a 3-deep
+1. **Depth-d insert chain** (`_chain_kernel`; d=3 for k <= 8, d=4 for
+   k <= 16 — r5 widened the envelope): the (bb, bd) tile is viewed
+   as (bb, bd/128, 128) sublane slabs; each slab streams through a d-deep
    compare-insert chain kept per (row, lane) in the output block, which the
-   d-grid revisits as an accumulator. 6 VPU ops/element — the whole reason
+   d-grid revisits as an accumulator. 2d VPU ops/element — the whole reason
    this beats both XLA TopK and a full 8-deep chain (16 ops/element,
    measured 2x slower end-to-end).
-2. **Bitonic lane fold** (`_fold3_kernel`): the per-lane sorted-3 columns
-   (padded to sorted-8 with -inf) are merged across lanes by halving:
-   winners of (a_i, b_{7-i}) form a bitonic sequence, cleaned by a 3-stage
-   network — 7 fold levels turn (3, 128) candidates/row into the row's
-   top-8 IF no lane hid a 4th member of the true top-8. The same kernel
-   emits a per-row suspect flag: some lane's 3rd-kept value > the folded
-   8th value.
-3. **Bounded rescue**: suspect rows (a lane holding >= 4 of the row's top
-   8 — P ~ C(8,4)/128^3 per row, ~1e-3 per 4096-row batch for random data;
-   adversarial stride-128 layouts can force it) are re-solved exactly by
+2. **Bitonic lane fold** (`_fold_kernel`): the per-lane sorted-d columns
+   (padded to sorted-m with -inf; m = 8 or 16 per the k band) are merged
+   across lanes by halving: winners of (a_i, b_{m-1-i}) form a bitonic
+   sequence, cleaned by a log2(m)-stage half-cleaner network — 7 fold
+   levels turn (depth, 128) candidates/row into the row's top-m IF no
+   lane hid a (depth+1)-th member of the true top-m. The same kernel
+   emits a per-row suspect flag: some lane's depth-th kept value > the
+   folded m-th value.
+3. **Bounded rescue**: suspect rows (a lane holding >= depth+1 of the
+   row's top-m — P ~ C(m, depth+1)/128^depth per row: ~1e-3 per 4096-row
+   batch at (3, 8), ~6e-2 at (4, 16), for random data; adversarial
+   stride-128 layouts can force it) are re-solved exactly by
    ``lax.top_k`` on a gathered <= ``rescue_rows`` subset; if even that
    budget overflows, one ``lax.cond`` falls back to full ``lax.top_k``.
    Exactness therefore never depends on the data distribution.
 
 Exactness proof of the non-suspect case (by value, duplicates included):
-with no suspect lane, every hidden element is <= its lane's 3rd-kept
-<= t8_hat (the folded 8th value), so all row values > t8_hat are among the
-candidates; if the true 8th value were > t8_hat, the >= 8 values above
-t8_hat would all be candidates and the folded 8th would exceed t8_hat —
-contradiction. Hence the candidate top-8 equals the true top-8 by value.
+with no suspect lane, every hidden element is <= its lane's depth-th kept
+<= tm_hat (the folded m-th value), so all row values > tm_hat are among
+the candidates; if the true m-th value were > tm_hat, the >= m values
+above tm_hat would all be candidates and the folded m-th would exceed
+tm_hat — contradiction. Hence the candidate top-m equals the true top-m
+by value.
 
-Values only: the chain carries no positions (indices would double the ops).
-ops/topk.py pairs these values with indices from the XLA path; when the
-caller uses only values (vocab pruning, thresholds, beam scores against a
-bound), XLA dead-code-eliminates the index path and the kernel's speed is
-the call's speed.
+Values only: the chain carries no positions (an index-carrying chain
+measured ~2.5x the ops). ops/topk.py recovers indices post-hoc with the
+streaming threshold pass (`_block_topk_indices`, r5); when the caller
+uses only values (vocab pruning, thresholds, beam scores against a
+bound), XLA dead-code-eliminates the recovery and the kernel's speed is
+the call's speed. bfloat16 inputs are upcast to f32 in-register (Mosaic
+on v5e rejects bf16 vector compares); the final downcast is exact.
+Measured (r5, 4096x32768): f32 k=16 values 1.25-1.5 ms / lax 6.3 ms;
+bf16 k=8 values ~1.1 ms / lax-bf16 9.0 ms; tuples 5.1 / 3.8 ms vs the
+~138 ms index-carrying XLA class.
 
 Reference anchor: the reference has no batched dimension at all (one
 IntVector, ``vector.h:7-11``); this is north-star scope (BASELINE.md
@@ -61,7 +70,12 @@ except ImportError:  # pragma: no cover
     pltpu = None
 
 LANES = 128
-_DEPTH = 3  # candidates kept per (row, lane); see suspect-rate analysis
+# candidates kept per (row, lane) / fold width by k band (see the
+# suspect-rate analysis in the module docstring): k <= 8 uses the
+# measured depth-3 + fold-8 design; 8 < k <= 16 uses depth-4 + fold-16
+# (P(lane hides a 5th top-16 member) ~ C(16,5)/128^4 ~ 1.6e-5 per row)
+def _depth_fold(k: int):
+    return (3, 8) if k <= 8 else (4, 16)
 
 
 def _ce(a, b):
@@ -69,7 +83,7 @@ def _ce(a, b):
     return jnp.maximum(a, b), jnp.minimum(a, b)
 
 
-def _chain3_kernel(x_ref, c_ref, *, bd):
+def _chain_kernel(x_ref, c_ref, *, bd, depth):
     j = pl.program_id(1)
     slabs = bd // LANES
     bb = x_ref.shape[0]
@@ -78,53 +92,60 @@ def _chain3_kernel(x_ref, c_ref, *, bd):
     def _():
         c_ref[:] = jnp.full_like(c_ref, -jnp.inf)
 
-    x = x_ref[:].reshape(bb, slabs, LANES)
-    regs = [c_ref[i * bb:(i + 1) * bb, :] for i in range(_DEPTH)]
+    # compute in f32: Mosaic on v5e rejects bf16 vector compares ("Target
+    # does not support this comparison"); the in-register upcast is exact
+    # for bf16 values and free for f32
+    x = x_ref[:].astype(jnp.float32).reshape(bb, slabs, LANES)
+    regs = [c_ref[i * bb:(i + 1) * bb, :] for i in range(depth)]
     for s in range(slabs):
         t = x[:, s, :]
-        for i in range(_DEPTH):
+        for i in range(depth):
             ri = regs[i]
             regs[i] = jnp.maximum(ri, t)
             t = jnp.minimum(ri, t)
     c_ref[:] = jnp.concatenate(regs, axis=0)
 
 
-def _lane_fold_top8(regs, bb):
-    """Merge 8 per-lane sorted-descending columns across the lane axis.
+def _lane_fold_topm(regs, bb, m_out):
+    """Merge ``m_out`` per-lane sorted-descending columns across the lane
+    axis (m_out a power of two).
 
-    At each fold the left/right lane halves hold independent sorted-8 runs
-    per lane; ``max(a_i, b_{7-i})`` yields a bitonic sequence containing
-    the merged top-8, cleaned by compare-exchanges at strides 4, 2, 1.
-    Returns 8 ``(bb, 1)`` arrays — the fold target's top-8, sorted.
+    At each fold the left/right lane halves hold independent sorted-m runs
+    per lane; ``max(a_i, b_{m-1-i})`` yields a bitonic sequence containing
+    the merged top-m, cleaned by a bitonic half-cleaner network (strides
+    m/2, m/4, ..., 1). Returns m_out ``(bb, 1)`` arrays — the fold
+    target's top-m, sorted.
     """
     w = regs[0].shape[1] // 2
     while w >= 1:
         a = [r[:, :w] for r in regs]
         b = [r[:, w:2 * w] for r in regs]
-        m = [jnp.maximum(a[i], b[7 - i]) for i in range(8)]
-        for (i, j) in ((0, 4), (1, 5), (2, 6), (3, 7)):
-            m[i], m[j] = _ce(m[i], m[j])
-        for (i, j) in ((0, 2), (1, 3), (4, 6), (5, 7)):
-            m[i], m[j] = _ce(m[i], m[j])
-        for (i, j) in ((0, 1), (2, 3), (4, 5), (6, 7)):
-            m[i], m[j] = _ce(m[i], m[j])
+        m = [jnp.maximum(a[i], b[m_out - 1 - i]) for i in range(m_out)]
+        s = m_out // 2
+        while s >= 1:
+            for i in range(m_out):
+                if (i // s) % 2 == 0:
+                    m[i], m[i + s] = _ce(m[i], m[i + s])
+            s //= 2
         regs = m
         w //= 2
     return regs
 
 
-def _fold3_kernel(c_ref, o_ref, s_ref, *, bb):
-    neg = jnp.full((bb, LANES), -jnp.inf, jnp.float32)
-    regs = [c_ref[i * bb:(i + 1) * bb, :] for i in range(_DEPTH)]
-    lane3 = regs[-1]
-    top = _lane_fold_top8(regs + [neg] * (8 - _DEPTH), bb)
+def _fold_kernel(c_ref, o_ref, s_ref, *, bb, depth, m_out):
+    dt = jnp.float32  # candidates are carried in f32 (see _chain_kernel)
+    neg = jnp.full((bb, LANES), -jnp.inf, dt)
+    regs = [c_ref[i * bb:(i + 1) * bb, :] for i in range(depth)]
+    lane_last = regs[-1]
+    top = _lane_fold_topm(regs + [neg] * (m_out - depth), bb, m_out)
     o_ref[:] = jnp.concatenate(top, axis=1)
-    t8 = top[7]  # (bb, 1): the folded 8th value
+    tm = top[m_out - 1]  # (bb, 1): the folded m-th value
     # NaN anywhere in a lane floods that lane's registers (max/min both
-    # propagate NaN), so isnan(lane3) catches every contaminated row and
-    # routes it to the exact lax.top_k rescue — without this, `lane3 > t8`
-    # is False for NaN and the flood would return silently wrong values
-    suspect = jnp.logical_or(lane3 > t8, jnp.isnan(lane3))
+    # propagate NaN), so isnan(lane_last) catches every contaminated row
+    # and routes it to the exact lax.top_k rescue — without this,
+    # `lane_last > tm` is False for NaN and the flood would return
+    # silently wrong values
+    suspect = jnp.logical_or(lane_last > tm, jnp.isnan(lane_last))
     s = jnp.where(suspect, jnp.float32(1), jnp.float32(0))
     w = LANES // 2
     while w >= 1:  # lane-axis max: any suspect lane flags the row
@@ -142,10 +163,12 @@ def _pick_block(size, options):
 
 def batched_topk_supported(shape, dtype, k) -> bool:
     """Static dispatch test for :func:`pallas_batched_topk_values`."""
-    if pltpu is None or len(shape) != 2 or jnp.dtype(dtype) != jnp.float32:
+    if pltpu is None or len(shape) != 2:
+        return False
+    if jnp.dtype(dtype) not in (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16)):
         return False
     b, d = shape
-    if not 1 <= k <= 8:
+    if not 1 <= k <= 16:  # k <= 8: depth-3/fold-8; k <= 16: depth-4/fold-16
         return False
     if _pick_block(b, (512, 256, 128, 64)) is None:
         return False
@@ -162,7 +185,9 @@ def pallas_batched_topk_values(
     rescue_rows: int = 64,
     interpret: bool | None = None,
 ) -> jax.Array:
-    """Exact top-k VALUES (sorted descending) per row of 2-D float32 ``x``.
+    """Exact top-k VALUES (sorted descending) per row of 2-D float32 or
+    bfloat16 ``x``, k <= 16 (bf16 computes in f32 in-register and the
+    returned values are bitwise the original bf16 elements).
 
     Use :func:`batched_topk_supported` to gate dispatch; out-of-envelope
     shapes should take the XLA paths in ops/topk.py.
@@ -178,40 +203,42 @@ def pallas_batched_topk_values(
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     B, D = x.shape
+    depth, m_out = _depth_fold(k)
     bb = _pick_block(B, (512, 256, 128, 64))
     bd = _pick_block(D, (2048, 1024))
     nb, nd = B // bb, D // bd
     rescue_rows = min(rescue_rows, B)
+    dt = x.dtype
 
     with jax.enable_x64(False):
         cand = pl.pallas_call(
-            functools.partial(_chain3_kernel, bd=bd),
+            functools.partial(_chain_kernel, bd=bd, depth=depth),
             grid=(nb, nd),
             in_specs=[
                 pl.BlockSpec((bb, bd), lambda i, j: (i, j), memory_space=pltpu.VMEM)
             ],
             out_specs=pl.BlockSpec(
-                (_DEPTH * bb, LANES), lambda i, j: (i, 0), memory_space=pltpu.VMEM
+                (depth * bb, LANES), lambda i, j: (i, 0), memory_space=pltpu.VMEM
             ),
             out_shape=jax.ShapeDtypeStruct(
-                (_DEPTH * B, LANES), jnp.float32, vma=jax.typeof(x).vma
+                (depth * B, LANES), jnp.float32, vma=jax.typeof(x).vma
             ),
             interpret=interpret,
         )(x)
         top, susp = pl.pallas_call(
-            functools.partial(_fold3_kernel, bb=bb),
+            functools.partial(_fold_kernel, bb=bb, depth=depth, m_out=m_out),
             grid=(nb,),
             in_specs=[
                 pl.BlockSpec(
-                    (_DEPTH * bb, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM
+                    (depth * bb, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM
                 )
             ],
             out_specs=[
-                pl.BlockSpec((bb, 8), lambda i: (i, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((bb, m_out), lambda i: (i, 0), memory_space=pltpu.VMEM),
                 pl.BlockSpec((bb, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
             ],
             out_shape=[
-                jax.ShapeDtypeStruct((B, 8), jnp.float32, vma=jax.typeof(x).vma),
+                jax.ShapeDtypeStruct((B, m_out), jnp.float32, vma=jax.typeof(x).vma),
                 jax.ShapeDtypeStruct((B, 1), jnp.float32, vma=jax.typeof(x).vma),
             ],
             interpret=interpret,
@@ -220,14 +247,17 @@ def pallas_batched_topk_values(
     sflag = susp[:, 0] > 0
     nsusp = jnp.sum(sflag.astype(jnp.int32))
     # bounded exact rescue: lax.top_k over the <= rescue_rows gathered rows
+    # (rescue values upcast to the candidates' f32 carrier — exact for bf16)
     sval, sidx = jax.lax.top_k(sflag.astype(jnp.int32), rescue_rows)
-    rtop, _ = jax.lax.top_k(x[sidx], 8)
-    fixed = jnp.where(sval[:, None] > 0, rtop, top[sidx])
+    rtop, _ = jax.lax.top_k(x[sidx], m_out)
+    fixed = jnp.where(sval[:, None] > 0, rtop.astype(jnp.float32), top[sidx])
     top = top.at[sidx].set(fixed)
 
     def full_fallback(_):
-        v, _ = jax.lax.top_k(x, 8)
-        return v
+        v, _ = jax.lax.top_k(x, m_out)
+        return v.astype(jnp.float32)
 
     top = jax.lax.cond(nsusp <= rescue_rows, lambda _: top, full_fallback, 0)
-    return top[:, :k]
+    # the f32 -> bf16 downcast is exact: every candidate is (an upcast of)
+    # an original bf16 element
+    return top[:, :k].astype(dt)

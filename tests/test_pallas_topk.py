@@ -69,11 +69,57 @@ def test_block_topk_neg_inf_rows(rng):
 
 def test_block_topk_dispatch_contract():
     assert batched_topk_supported((4096, 32768), np.float32, 8)
+    # r5 widened envelope: k <= 16 (depth-4 + fold-16) and bfloat16
+    assert batched_topk_supported((4096, 32768), np.float32, 9)
+    assert batched_topk_supported((4096, 32768), np.float32, 16)
+    assert batched_topk_supported((4096, 32768), jnp.bfloat16, 8)
+    assert not batched_topk_supported((4096, 32768), np.float32, 17)
     assert not batched_topk_supported((4096, 32768), np.float64, 8)
-    assert not batched_topk_supported((4096, 32768), np.float32, 9)
+    assert not batched_topk_supported((4096, 32768), np.float16, 8)
     assert not batched_topk_supported((100, 32768), np.float32, 8)  # B % 64
     assert not batched_topk_supported((4096, 2048), np.float32, 8)  # D < 4096
     assert not batched_topk_supported((4096,), np.float32, 8)
+
+
+@pytest.mark.parametrize("k", [16])
+def test_block_topk_depth4_band(rng, k):
+    """The r5 k <= 16 envelope: depth-4 chain + 16-wide bitonic fold,
+    random and tie-heavy data, plus the one-lane-hides-winners rescue.
+    (k=9..15 run the identical depth-4/fold-16 path with a final slice —
+    one k covers it; k=9 is exercised compiled in tpu_smoke.)"""
+    x = rng.standard_normal((B, D)).astype(np.float32)
+    got = np.asarray(pallas_batched_topk_values(jnp.asarray(x), k))
+    np.testing.assert_array_equal(got, _want(x, k))
+    xt = rng.integers(0, 11, size=(B, D)).astype(np.float32)
+    got = np.asarray(pallas_batched_topk_values(jnp.asarray(xt), k))
+    np.testing.assert_array_equal(got, _want(xt, k))
+    xa = rng.standard_normal((B, D)).astype(np.float32)
+    big = 100.0 + np.arange(16, dtype=np.float32)
+    xa[7, 3 + 128 * np.arange(16)] = big  # one lane holds the whole top-16
+    got = np.asarray(pallas_batched_topk_values(jnp.asarray(xa), k))
+    np.testing.assert_array_equal(got, _want(xa, k))
+
+
+def test_block_topk_bfloat16(rng):
+    """bf16 inputs (r5): the kernels upcast to f32 in-register (Mosaic on
+    v5e rejects bf16 vector compares) and the downcast back is exact.
+    Values must be BITWISE the bf16 elements; indices pair through the
+    public topk()."""
+    import jax
+
+    xb = rng.standard_normal((B, D)).astype(jnp.bfloat16)
+    for k in (8, 16):
+        got = np.asarray(pallas_batched_topk_values(jnp.asarray(xb), k))
+        want = np.asarray(jax.lax.top_k(jnp.asarray(xb), k)[0])
+        np.testing.assert_array_equal(
+            got.view(np.uint16), want.view(np.uint16), err_msg=str(k)
+        )
+    vals, idx = topk(jnp.asarray(xb), 8, method="block")
+    rv, ri = jax.lax.top_k(jnp.asarray(xb), 8)
+    np.testing.assert_array_equal(
+        np.asarray(vals).view(np.uint16), np.asarray(rv).view(np.uint16)
+    )
+    np.testing.assert_array_equal(np.asarray(idx), np.asarray(ri))
 
 
 def test_topk_block_method_values_and_indices(rng):

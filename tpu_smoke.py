@@ -185,6 +185,19 @@ def main() -> int:
     rv, ri = jax.lax.top_k(xbd, 8)
     check("block 256x8192 k=8 values", v, rv)
     check("block 256x8192 k=8 indices", i, ri)
+    # r5 widened envelope: depth-4/fold-16 band (k=9 exercises the slice)
+    for kk in (9, 16):
+        v, i = topk(xbd, kk, method="block")
+        rv, ri = jax.lax.top_k(xbd, kk)
+        check(f"block k={kk} values", v, rv)
+        check(f"block k={kk} indices", i, ri)
+    # bf16 (in-kernel f32 upcast; values bitwise-exact bf16)
+    xb16 = jnp.asarray(xb).astype(jnp.bfloat16)
+    v, i = topk(xb16, 8, method="block")
+    rv, ri = jax.lax.top_k(xb16, 8)
+    check("block bf16 k=8 values", np.asarray(v).view(np.uint16),
+          np.asarray(rv).view(np.uint16))
+    check("block bf16 k=8 indices", i, ri)
 
     if failures:
         print(f"tpu_smoke: {len(failures)} FAILURES")
