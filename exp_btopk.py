@@ -8,6 +8,28 @@ vs the current production path (ops/topk.py chunked) and lax.top_k.
 
 Scratch harness — findings land in ops/topk.py + docs; file kept as the
 measurement record for the accept/reject decision.
+
+r5 addendum (envelope widening, measured via bench._timed_chain on v5e at
+4096x32768 — the decisions shipped in ops/pallas/topk.py):
+  - depth-4 chain + 16-wide bitonic fold for 8 < k <= 16: ACCEPTED —
+    values-only 1.25-1.5 ms (vs lax f32 top_k 6.3 ms), full tuple 5.1 ms;
+    suspect rate C(16,5)/128^4 keeps the rescue bounded.
+  - bfloat16 input: ACCEPTED via in-register f32 upcast (Mosaic v5e
+    rejects bf16 vector compares: "Target does not support this
+    comparison" on vector<...xbf16> cmpf) — values-only ~1.1 ms vs
+    lax-bf16 9.0 ms (XLA's bf16 TopK is SLOWER than its f32 TopK),
+    tuple 3.8 ms; compute-bound, so halved HBM traffic does not speed
+    the chain.
+  - index-carrying chain (value+slab register pairs): REJECTED — 5 VPU
+    ops per insert vs 2 (cmp + 4 selects), ~2.4 ms projected at depth 3;
+    the streaming post-hoc recovery (ops/topk.py:_block_topk_indices)
+    costs ~3 ms total-tuple instead and is DCE-free for values-only
+    callers. The r4 target "tuple <= 1.5 ms" was set against XLA TopK's
+    2.4 ms VALUES-only figure; with indices actually consumed every XLA
+    variant lowers to a ~135-142 ms variadic sort, so 3.7-4.5 ms is
+    ~31-37x the only real alternative (recorded negative on the 1.5 ms
+    number itself: the kernel + one unavoidable second read of x already
+    costs ~1.7 ms).
 """
 
 import functools
