@@ -108,12 +108,11 @@ def test_block_topk_bfloat16(rng):
     import jax
 
     xb = rng.standard_normal((B, D)).astype(jnp.bfloat16)
-    for k in (8, 16):
-        got = np.asarray(pallas_batched_topk_values(jnp.asarray(xb), k))
-        want = np.asarray(jax.lax.top_k(jnp.asarray(xb), k)[0])
-        np.testing.assert_array_equal(
-            got.view(np.uint16), want.view(np.uint16), err_msg=str(k)
-        )
+    # k=8 only: the bf16 k=16 (depth-4) combination costs another ~15 s of
+    # interpret trace and runs compiled in tpu_smoke.py every round
+    got = np.asarray(pallas_batched_topk_values(jnp.asarray(xb), 8))
+    want = np.asarray(jax.lax.top_k(jnp.asarray(xb), 8)[0])
+    np.testing.assert_array_equal(got.view(np.uint16), want.view(np.uint16))
     vals, idx = topk(jnp.asarray(xb), 8, method="block")
     rv, ri = jax.lax.top_k(jnp.asarray(xb), 8)
     np.testing.assert_array_equal(

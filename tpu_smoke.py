@@ -193,11 +193,12 @@ def main() -> int:
         check(f"block k={kk} indices", i, ri)
     # bf16 (in-kernel f32 upcast; values bitwise-exact bf16)
     xb16 = jnp.asarray(xb).astype(jnp.bfloat16)
-    v, i = topk(xb16, 8, method="block")
-    rv, ri = jax.lax.top_k(xb16, 8)
-    check("block bf16 k=8 values", np.asarray(v).view(np.uint16),
-          np.asarray(rv).view(np.uint16))
-    check("block bf16 k=8 indices", i, ri)
+    for kk in (8, 16):  # both depth bands in bf16
+        v, i = topk(xb16, kk, method="block")
+        rv, ri = jax.lax.top_k(xb16, kk)
+        check(f"block bf16 k={kk} values", np.asarray(v).view(np.uint16),
+              np.asarray(rv).view(np.uint16))
+        check(f"block bf16 k={kk} indices", i, ri)
 
     if failures:
         print(f"tpu_smoke: {len(failures)} FAILURES")
