@@ -85,7 +85,11 @@ def build_parser() -> argparse.ArgumentParser:
         "nearest-rank order statistics, amortized over one prepared pass",
     )
     p.add_argument("--smallest", action="store_true", help="top-k smallest instead of largest")
-    p.add_argument("--batch", type=int, default=None, help="batch dimension for top-k")
+    p.add_argument(
+        "--batch", type=int, default=None,
+        help="batch rows for top-k: the input becomes shape (batch, n), "
+        "i.e. batch INDEPENDENT rows of n elements each (total batch*n)",
+    )
     p.add_argument(
         "--topk-method",
         choices=("auto", "flat", "chunked", "threshold", "tournament", "block"),
