@@ -25,7 +25,15 @@ def _block(x):
 
 
 def time_fn(fn: Callable[[], Any], *, repeats: int = 1, warmup: int = 0):
-    """Time `fn` with device-sync semantics. Returns (best_seconds, last_result)."""
+    """Time `fn` with device-sync semantics. Returns (best_seconds, last_result).
+
+    Caveat (remote/tunneled accelerators): best-of-repeats on IDENTICAL
+    inputs can read far below the true device time when the transport
+    caches results (observed through the axon tunnel: a 64M top-k
+    "measured" 0.15 ms vs ~4 ms real). On directly-attached hardware the
+    numbers are sound; for tunnel-proof measurement use bench.py's
+    differential perturb-chain methodology, which defeats caching by
+    making every iteration's input depend on the previous output."""
     result = None
     for _ in range(warmup):
         result = _block(fn())
