@@ -139,8 +139,13 @@ def bench_kselect_1b(on_tpu: bool):
         )
     )()
     xd.block_until_ready()
+    sort_index = jax.jit(lambda v: jnp.sort(v)[k - 1])
+    want = int(sort_index(xd))  # on-device sort-then-index oracle (+ compile)
+    # steady-state baseline (ADVICE r5 #3): time a SECOND invocation, compile
+    # excluded — jit caches compilations, not results, so the same buffer
+    # re-runs the full sort (no extra 4 GB copy resident during it)
     t0 = time.perf_counter()
-    want = int(jnp.sort(xd)[k - 1])  # on-device sort-then-index oracle
+    _ = int(sort_index(xd))
     baseline_s = time.perf_counter() - t0
 
     kd = jnp.asarray(k, jnp.int32)
@@ -175,7 +180,8 @@ def bench_kselect_1b(on_tpu: bool):
             "k": k,
             "seconds": round(per, 6),
             "baseline_seconds": round(baseline_s, 6),
-            "baseline": "on-chip jnp.sort-then-index (single shot)",
+            "baseline": "on-chip jnp.sort-then-index (steady-state, 2nd call)",
+            "baseline_includes_compile": False,
             "exact_match": exact,
         }
     )
@@ -427,6 +433,80 @@ def bench_multirank(
     return exact
 
 
+def bench_streaming_oc(on_tpu: bool):
+    """Out-of-core exact k-select (the streaming subsystem): N=2^33 int32
+    median on TPU — the 32 GB input is ~2x a 16 GB HBM, so the on-device
+    baseline (resident sort OR resident radix select) cannot exist at this
+    n; `vs_baseline` is therefore reported as 0.0 with the reason in the
+    record. Chunks are generated ON DEVICE per index (jax PRNG keyed by
+    chunk number — replay-stable across passes, nothing crosses the
+    tunnel), streamed through the histogram kernels, and only the
+    (2^radix_bits,) counts and the <= collect_budget survivors ever leave.
+    Exactness is proven by a streamed O(n) rank certificate (less < k <=
+    leq) — the same guarantee --check gives, no oracle sort needed. CPU CI
+    runs a small config with a real host oracle instead."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mpi_k_selection_tpu.streaming.chunked import (
+        streaming_kselect,
+        streaming_rank_certificate,
+    )
+
+    n, chunk = (1 << 33, 1 << 27) if on_tpu else (1 << 22, 1 << 19)
+    nchunks = n // chunk
+    k = n // 2
+
+    gen = jax.jit(
+        lambda i: jax.random.randint(
+            jax.random.fold_in(jax.random.PRNGKey(9), i),
+            (chunk,),
+            -(2**31),
+            2**31 - 1,
+            jnp.int32,
+        )
+    )
+    source = lambda: (gen(i) for i in range(nchunks))
+
+    t0 = time.perf_counter()
+    ans = streaming_kselect(source, k)
+    dt = time.perf_counter() - t0
+
+    less, leq = streaming_rank_certificate(source, ans)
+    exact = less < k <= leq
+    rec = {
+        "metric": "kselect_streaming_oc_8b_int32" if on_tpu else "kselect_streaming_oc",
+        "value": round(n / dt, 1) if exact else 0.0,
+        "unit": "elems/sec/chip",
+        "n": n,
+        "k": k,
+        "chunks": nchunks,
+        "chunk_elems": chunk,
+        "seconds": round(dt, 6),
+        "rank_certificate": [less, leq],
+        "exact_match": bool(exact),
+    }
+    if on_tpu:
+        rec["vs_baseline"] = 0.0
+        rec["baseline"] = (
+            "infeasible on-device: 2^33 int32 (32 GB) exceeds HBM; "
+            "certificate-verified instead"
+        )
+    else:
+        x = np.concatenate([np.asarray(gen(i)) for i in range(nchunks)])
+        t0 = time.perf_counter()
+        want = int(np.sort(x, kind="stable")[k - 1])
+        baseline_s = time.perf_counter() - t0
+        exact = exact and int(ans) == want
+        rec["exact_match"] = bool(exact)
+        rec["value"] = round(n / dt, 1) if exact else 0.0
+        rec["vs_baseline"] = round(baseline_s / dt, 3) if exact else 0.0
+        rec["baseline_seconds"] = round(baseline_s, 6)
+    _emit(rec)
+    return bool(exact)
+
+
 def bench_cgm_native():
     """BASELINE config: CGM/MPI parity backend, 4 ranks, N=16M, k=N/2.
 
@@ -513,6 +593,7 @@ def main() -> int:
         metric="multirank_deciles_k9",
         reps=(2, 8) if on_tpu else (1, 3),
     )
+    ok &= bench_streaming_oc(on_tpu)
     ok &= bench_cgm_native()
     ok &= bench_seq_oracle()
     return 0 if ok else 1

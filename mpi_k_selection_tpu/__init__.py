@@ -22,6 +22,8 @@ Public API::
     ks.quantiles(x, [.5, .9, .99])# exact nearest-rank order statistics
     ks.topk(x, k)                 # top-k values (and indices)
     ks.distributed_kselect(x, k)  # sharded over a jax.sharding.Mesh
+    ks.kselect_streaming(src, k)  # out-of-core exact selection over chunks
+    ks.StreamingQuantiles(dtype)  # mergeable online-quantile sketch + refine
 
 Full reference: docs/API.md.
 """
@@ -32,10 +34,12 @@ from mpi_k_selection_tpu.ops.sort import sort_select
 from mpi_k_selection_tpu.ops.radix import radix_select
 from mpi_k_selection_tpu.ops.topk import topk, batched_topk
 from mpi_k_selection_tpu.api import (
+    StreamingQuantiles,
     batched_kselect,
     batched_median,
     kselect,
     kselect_many,
+    kselect_streaming,
     median,
     quantiles,
 )
@@ -43,14 +47,19 @@ from mpi_k_selection_tpu.parallel import (
     distributed_kselect,
     distributed_radix_select,
     distributed_cgm_select,
+    distributed_sketch,
     distributed_topk,
 )
+from mpi_k_selection_tpu.streaming import RadixSketch
 
 __all__ = [
     "__version__",
     "DeviceVector",
     "kselect",
     "kselect_many",
+    "kselect_streaming",
+    "StreamingQuantiles",
+    "RadixSketch",
     "quantiles",
     "median",
     "batched_kselect",
@@ -62,5 +71,6 @@ __all__ = [
     "distributed_kselect",
     "distributed_radix_select",
     "distributed_cgm_select",
+    "distributed_sketch",
     "distributed_topk",
 ]

@@ -225,6 +225,75 @@ def median(x, **kwargs):
     return kselect(x, max(1, x.size // 2), **kwargs)
 
 
+def kselect_streaming(source, k, **kwargs):
+    """Exact k-th smallest over data that is only ever materialized in
+    chunks — never as one device (or host) array. ``source`` is a
+    list/tuple of chunks or a zero-arg callable returning a fresh chunk
+    iterator (replayed once per radix pass); chunks may be numpy or device
+    arrays. Serves ``n`` far beyond HBM, and is bit-exact for float64 on
+    TPU with host chunks (keys never touch the device's ~49-bit f64
+    storage). See streaming/chunked.py:streaming_kselect for options
+    (``radix_bits``, ``hist_method``, ``collect_budget``, ``sketch``)."""
+    from mpi_k_selection_tpu.streaming.chunked import streaming_kselect
+
+    return streaming_kselect(source, k, **kwargs)
+
+
+class StreamingQuantiles:
+    """Online quantile tracker over a chunked stream: a mergeable
+    :class:`~mpi_k_selection_tpu.streaming.sketch.RadixSketch` plus the
+    exact-refinement hook. The telemetry shape: feed chunks as they arrive
+    (``update``), combine trackers from different shards/processes in any
+    order (``merge`` — bitwise order-invariant), read approximate quantiles
+    any time (``quantiles`` — rank error per the sketch's documented
+    bound), and spend extra passes over a replayable source only when an
+    exact answer is worth it (``refine_quantiles``)."""
+
+    def __init__(self, dtype, *, radix_bits: int = 4, levels: int = 4):
+        from mpi_k_selection_tpu.streaming.sketch import RadixSketch
+
+        self.sketch = RadixSketch(dtype, radix_bits=radix_bits, levels=levels)
+
+    @property
+    def n(self) -> int:
+        return self.sketch.n
+
+    def update(self, chunk) -> "StreamingQuantiles":
+        self.sketch.update(chunk)
+        return self
+
+    def merge(self, other: "StreamingQuantiles") -> "StreamingQuantiles":
+        out = StreamingQuantiles(
+            self.sketch.dtype,
+            radix_bits=self.sketch.radix_bits,
+            levels=self.sketch.levels,
+        )
+        out.sketch = self.sketch.merge(
+            other.sketch if isinstance(other, StreamingQuantiles) else other
+        )
+        return out
+
+    def quantiles(self, qs):
+        """Approximate nearest-rank quantile values (see RadixSketch.query
+        for the error contract; exact rank/value brackets via the sketch)."""
+        return self.sketch.quantiles(qs)
+
+    def refine_quantiles(self, qs, source):
+        """EXACT nearest-rank quantiles over the replayable ``source``
+        (which must replay the very stream this tracker accumulated): ONE
+        sketch-seeded multi-rank descent shares every streamed pass across
+        all requested ranks, so m quantiles cost roughly the stream replays
+        of one (streaming/chunked.py:streaming_kselect_many)."""
+        from mpi_k_selection_tpu.streaming.chunked import streaming_kselect_many
+
+        return streaming_kselect_many(
+            source,
+            quantile_ranks(qs, self.sketch.n),
+            radix_bits=self.sketch.radix_bits,
+            sketch=self.sketch,
+        )
+
+
 def batched_kselect(x, k):
     """Per-row exact k-th smallest along the last axis (1-indexed k).
 

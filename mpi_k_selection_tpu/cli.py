@@ -97,6 +97,21 @@ def build_parser() -> argparse.ArgumentParser:
         help="top-k algorithm (see ops/topk.py; block = the Pallas batched "
         "values kernel, 2-D float32 largest k<=8)",
     )
+    p.add_argument(
+        "--streaming",
+        action="store_true",
+        help="out-of-core mode: the input is generated and consumed in "
+        "chunks of --chunk-elems and never materialized whole; exact k-th "
+        "selection via the streaming subsystem (k-th mode only). Each chunk "
+        "i is generated independently with seed+i, so structured --gen "
+        "patterns (sequential/descending/seqlike) become per-chunk ramps "
+        "and answers are NOT comparable to non-streaming runs at the same "
+        "seed; --verify/--check stay self-consistent",
+    )
+    p.add_argument(
+        "--chunk-elems", type=int, default=1 << 22,
+        help="chunk size (elements) for --streaming",
+    )
     p.add_argument("--repeats", type=int, default=1)
     p.add_argument("--verify", action="store_true", help="check against the seq oracle")
     p.add_argument(
@@ -239,6 +254,76 @@ def _run_quantiles(args, x):
     return record, ok
 
 
+def _chunk_source(args):
+    """Replayable chunk generator for --streaming: chunk i is
+    ``datagen.generate(..., seed=seed+i)``, so the stream is deterministic
+    and identical on every pass (the replay-stability contract of
+    streaming/chunked.py) while no more than --chunk-elems elements ever
+    exist at once."""
+    n, chunk = args.n, args.chunk_elems
+
+    def source():
+        off = i = 0
+        while off < n:
+            m = min(chunk, n - off)
+            yield datagen.generate(
+                m, pattern=args.gen, seed=args.seed + i, dtype=args.dtype
+            )
+            off += m
+            i += 1
+
+    return source
+
+
+def _run_streaming(args):
+    from mpi_k_selection_tpu.api import kselect_streaming
+    from mpi_k_selection_tpu.streaming.chunked import streaming_rank_certificate
+
+    n = args.n
+    if args.chunk_elems < 1:
+        raise SystemExit("error: --chunk-elems must be >= 1")
+    k = args.k if args.k is not None else max(1, n // 2)
+    if not 1 <= k <= n:
+        raise SystemExit(f"error: k={k} out of range [1, {n}]")
+    source = _chunk_source(args)
+    # the seq backend answers from host histograms; tpu streams chunks
+    # through the device kernels (ops/histogram.py resolves the method)
+    hist_method = "numpy" if args.backend == "seq" else "auto"
+    fn = lambda: kselect_streaming(source, k, hist_method=hist_method)
+    seconds, answer = time_fn(fn, repeats=args.repeats, warmup=0)
+    record = ResultRecord(
+        answer=np.asarray(answer).item(),
+        n=n,
+        k=k,
+        backend=args.backend,
+        algorithm="streaming-chunked",
+        dtype=args.dtype,
+        seconds=seconds,
+        n_devices=_device_count(args),
+    )
+    nchunks = -(-n // args.chunk_elems)
+    record.extra["chunks"] = nchunks
+    record.extra["chunk_elems"] = args.chunk_elems
+    ok = True
+    if args.verify:
+        # the oracle NEEDS the whole array resident — only meaningful at
+        # sizes where that is still possible; --check stays streaming
+        from mpi_k_selection_tpu.backends import seq
+
+        x = np.concatenate([np.ravel(c) for c in source()])
+        want = np.asarray(seq.kselect(x, k)).item()
+        ok = record.answer == want
+        record.extra["oracle"] = want
+        record.extra["exact_match"] = ok
+    if args.check:
+        less, leq = streaming_rank_certificate(source, answer)
+        cert_ok = less < k <= leq
+        record.extra["rank_certificate"] = [less, leq]
+        record.extra["certificate_ok"] = cert_ok
+        ok = ok and cert_ok
+    return record, ok
+
+
 def _run_topk(args, x):
     k = args.topk
     if args.backend == "seq":
@@ -322,26 +407,41 @@ def main(argv=None) -> int:
         raise SystemExit(
             "error: --quantiles is exclusive with --topk/--check; use --verify"
         )
+    if args.streaming and (
+        args.topk is not None or args.quantiles is not None or args.batch
+    ):
+        raise SystemExit(
+            "error: --streaming supports k-th selection only "
+            "(no --topk/--quantiles/--batch)"
+        )
+    if args.streaming and args.backend == "mpi":
+        raise SystemExit("error: --streaming runs on the seq or tpu backend")
     x64_needed = args.dtype in ("int64", "float64")
     from mpi_k_selection_tpu.utils import profiling
 
+    import contextlib
+
     timer = profiling.PhaseTimer()
+    tracer = lambda: (
+        profiling.trace(args.trace_dir)
+        if args.trace_dir
+        else contextlib.nullcontext()
+    )
     try:
         with maybe_x64(x64_needed):
+            if args.streaming:
+                # chunks are generated INSIDE the solve (that is the point:
+                # the whole array never exists); --check streams too
+                with tracer(), timer.phase("solve"):
+                    record, ok = _run_streaming(args)
+                return _finish(args, record, ok, timer)
             with timer.phase("generate"):
                 batch = (args.batch,) if args.batch else ()
                 x = datagen.generate(
                     args.n, pattern=args.gen, seed=args.seed, dtype=args.dtype,
                     batch=batch,
                 )
-            import contextlib
-
-            tracer = (
-                profiling.trace(args.trace_dir)
-                if args.trace_dir
-                else contextlib.nullcontext()
-            )
-            with tracer, timer.phase("solve"):
+            with tracer(), timer.phase("solve"):
                 if args.quantiles is not None:
                     record, ok = _run_quantiles(args, x)
                 elif args.topk is not None:
@@ -359,6 +459,11 @@ def main(argv=None) -> int:
                     ok = ok and cert_ok
     except (ValueError, RuntimeError) as e:
         raise SystemExit(f"error: {e}") from e
+    return _finish(args, record, ok, timer)
+
+
+def _finish(args, record, ok, timer) -> int:
+    """Shared result reporting (JSON or reference-style) + exit code."""
     if args.profile:
         record.extra["phases"] = timer.as_dict()
     if args.json:

@@ -512,25 +512,29 @@ def _trace_state_clean() -> bool:
         return True
 
 
-_f64_tpu_approx_warned = False
+# advice strings already emitted (None = the default kselect advice):
+# one-time PER ADVICE, not per process — the kselect and threshold-top-k
+# paths carry contradictory guidance (an eager-exact escape exists for one
+# and not the other), so whichever fires first must not suppress the other
+_f64_tpu_approx_warned: set = set()
 
 
-def _warn_f64_tpu_approx(x):
-    """One-time warning when an f64-on-TPU selection takes the traced
-    ~49-bit key approximation (utils/dtypes.py:f64_raw_bits) instead of the
-    exact host-key route — the one dtype/backend pair where a jit silently
-    changes the answer's guarantee. Fires for traced f64 inputs and for
-    concrete f64 closed over inside a user jit; never on the exact host
-    route (``_f64_tpu_host_keys`` succeeded) and never off-TPU."""
-    global _f64_tpu_approx_warned
-    if _f64_tpu_approx_warned:
+def _warn_f64_tpu_approx(x, advice=None):
+    """One-time (per distinct ``advice``) warning when an f64-on-TPU
+    selection takes the traced ~49-bit key approximation
+    (utils/dtypes.py:f64_raw_bits) instead of the exact host-key route —
+    the one dtype/backend pair where a jit silently changes the answer's
+    guarantee. Fires for traced f64 inputs and for concrete f64 closed
+    over inside a user jit; never on the exact host route
+    (``_f64_tpu_host_keys`` succeeded) and never off-TPU."""
+    if advice in _f64_tpu_approx_warned:
         return
     try:
         is_f64 = np.dtype(x.dtype) == np.float64
     except Exception:
         return
     if is_f64 and jax.default_backend() == "tpu":
-        _f64_tpu_approx_warned = True
+        _f64_tpu_approx_warned.add(advice)
         import inspect
         import warnings
 
@@ -542,12 +546,16 @@ def _warn_f64_tpu_approx(x):
         for level, frame in enumerate(inspect.stack()[1:], start=2):
             if pkg not in frame.frame.f_globals.get("__name__", ""):
                 break
+        if advice is None:
+            advice = (
+                "For bit-exact f64 results call the selection "
+                "eagerly with a host (numpy) array — see docs/API.md. "
+            )
         warnings.warn(
-            "float64 selection inside jit on TPU uses an approximate ~49-bit "
+            "float64 selection on TPU here uses an approximate ~49-bit "
             "key (TPU f64 is double-double; exact f64 bitcasts crash its "
-            "compiler). For bit-exact f64 results call the selection "
-            "eagerly with a host (numpy) array — see docs/API.md. "
-            "This warning is emitted once per process.",
+            "compiler). " + advice +
+            "This warning is emitted once per process per selection path.",
             stacklevel=level,
         )
 

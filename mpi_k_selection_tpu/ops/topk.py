@@ -328,7 +328,26 @@ def _threshold_topk_indices(x: jax.Array, k: int, largest: bool) -> jax.Array:
     and swept it with jnp block counts — ~5.9 ms at the 64M f32 k=128
     BASELINE config vs ≤3.5 ms targeted here.
     """
-    from mpi_k_selection_tpu.ops.radix import _Descent, _select_key_on_prep
+    from mpi_k_selection_tpu.ops.radix import (
+        _Descent,
+        _select_key_on_prep,
+        _warn_f64_tpu_approx,
+    )
+
+    # this path builds its own _Descent, bypassing the radix shells' exact
+    # f64-on-TPU host-key route — threshold top-k over float64 on TPU always
+    # runs the documented ~49-bit key approximation, so emit the same
+    # one-time warning the kselect paths do (ADVICE r5 #1; the helper
+    # no-ops for every other dtype/backend pair), with advice specific to
+    # this path: unlike k-th selection, there is no eager-exact escape
+    _warn_f64_tpu_approx(
+        x,
+        advice=(
+            "The threshold top-k index pass always runs in device key "
+            "space — the exact eager host-key route applies to k-th "
+            "selection, not top-k (see docs/API.md). "
+        ),
+    )
 
     n = x.shape[0]
     xr = x.ravel()

@@ -1,0 +1,128 @@
+"""Sharded RadixSketch construction — per-shard histograms merged by psum.
+
+The sketch's merge is an elementwise sum (streaming/sketch.py), so building
+one over a device-sharded array is a single shard_map: every shard counts
+its local DEEPEST-level histogram with the same ops/histogram.py primitive
+the selects use, one ``lax.psum`` merges the counts (the shallower pyramid
+is derived host-side by reshape-sum) — the exact analogue
+of the reference CGM's ``MPI_Allreduce`` of per-rank counts
+(``TODO-kth-problem-cgm.c:190``), except the reduced object here IS the
+final queryable summary. The replicated result is lifted into a host
+:class:`RadixSketch`, interchangeable (bitwise) with one accumulated by
+sequential ``update`` calls over the same data.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from mpi_k_selection_tpu.ops.histogram import masked_radix_histogram
+from mpi_k_selection_tpu.parallel import mesh as mesh_lib
+from mpi_k_selection_tpu.streaming.sketch import RadixSketch
+from mpi_k_selection_tpu.utils import dtypes as _dt
+
+
+def _shard_map(fn, mesh, in_specs, out_specs):
+    """shard_map across jax versions (jax.shard_map landed after 0.4.x;
+    the experimental module is the fallback — same calling convention)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    from jax.experimental.shard_map import shard_map as _legacy
+
+    return _legacy(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+
+
+def distributed_sketch(
+    x,
+    *,
+    mesh=None,
+    radix_bits: int = 4,
+    levels: int = 4,
+    hist_method: str = "scatter",
+) -> RadixSketch:
+    """Build a :class:`RadixSketch` of device-resident ``x`` over ``mesh``
+    (all devices by default): one psum-merged deepest-level histogram pass,
+    shallower levels derived host-side.
+
+    ``hist_method`` defaults to ``"scatter"``: the deepest level needs
+    ``2**resolution_bits`` buckets, beyond the Pallas kernels' digit-width
+    sweet spot — scatter handles any bucket count. A non-multiple-of-mesh
+    tail is folded in host-side (sentinel padding would corrupt the top
+    bucket's count, unlike selection where sentinels are rank-safe).
+    """
+    if mesh is None:
+        mesh = mesh_lib.make_mesh()
+    mesh_lib.require_distributed(mesh)
+    xh = x if hasattr(x, "dtype") else np.asarray(x)
+    dtype = np.dtype(xh.dtype)  # BEFORE any device cast can narrow it
+    sk = RadixSketch(dtype, radix_bits=radix_bits, levels=levels)
+    if dtype.itemsize == 8 and not jax.config.jax_enable_x64:
+        # jnp.asarray would silently truncate 64-bit host input to 32 bits
+        # (wrong counts, wrong sketch dtype) — the same hole
+        # streaming/chunked.py:resolve_stream_hist guards; accumulate
+        # host-side instead: exact, and no x64 mode flip required
+        return sk.update(np.ravel(np.asarray(xh)))
+    x = jnp.ravel(jnp.asarray(x))
+    if dtype == np.float64 and jax.default_backend() == "tpu":
+        # TPU f64 device keys are the ~49-bit approximation
+        # (utils/dtypes.py:f64_raw_bits), which would break the bitwise
+        # host-parity contract — accumulate host-side instead, exact
+        # w.r.t. the (already storage-truncated) device contents
+        return sk.update(np.asarray(x))
+    n = x.shape[0]
+    nmain = n - n % mesh.size
+    axis = mesh.axis_names[0]
+    total_bits = sk.total_bits
+
+    if nmain:
+
+        def shard_fn(xs):
+            u = _dt.to_sortable_bits(xs.ravel())
+            # ONE kernel + one psum: only the deepest level is counted on
+            # device; the shallower pyramid is derived host-side from the
+            # merged int64 counts (RadixSketch._fold_deep_histogram), which
+            # is bitwise identical and cuts device reads and collective
+            # traffic by ~levels x
+            local = masked_radix_histogram(
+                u,
+                shift=total_bits - levels * radix_bits,
+                radix_bits=levels * radix_bits,
+                prefix=None,
+                method=hist_method,
+                count_dtype=jnp.int32,  # exact: segment < 2^31 elements
+            )
+            # extremes in KEY space (not value space): bitwise identical to
+            # the host sketch's update() extremes for every stream, NaN and
+            # -0.0/+0.0 included, where value-space min/max diverge from the
+            # keys' total order
+            return (
+                jax.lax.psum(local, axis),
+                jax.lax.pmin(jnp.min(u), axis),
+                jax.lax.pmax(jnp.max(u), axis),
+            )
+
+        fn = jax.jit(
+            _shard_map(shard_fn, mesh, in_specs=(P(axis),), out_specs=P())
+        )
+        # the psum reduces int32 counts across shards: cap each call's total
+        # population below 2^31 so the merged counts cannot wrap, and
+        # accumulate segments host-side in int64 (the same discipline as
+        # streaming/chunked.py's per-chunk histograms)
+        seg = ((1 << 31) - 1) // mesh.size * mesh.size
+        kmin = kmax = None
+        for off in range(0, nmain, seg):
+            xs = mesh_lib.shard_1d(x[off : off + min(seg, nmain - off)], mesh)
+            deep, dmin, dmax = fn(xs)
+            sk._fold_deep_histogram(np.asarray(deep).astype(np.int64))
+            smin = sk.kdt.type(np.asarray(dmin))
+            smax = sk.kdt.type(np.asarray(dmax))
+            kmin = smin if kmin is None else min(kmin, smin)
+            kmax = smax if kmax is None else max(kmax, smax)
+        sk.n = nmain
+        sk._min_key, sk._max_key = kmin, kmax
+    if nmain != n:
+        sk.update(np.asarray(x[nmain:]))
+    return sk

@@ -1,0 +1,32 @@
+"""Streaming selection — exact k-select and quantile sketches over data the
+device never holds all at once.
+
+Two cooperating pieces (see docs/API.md "Streaming / out-of-core"):
+
+- :mod:`chunked` — out-of-core exact k-selection: stream host- (or
+  generator-) resident chunks through the device one radix pass at a time,
+  merge the per-chunk digit histograms host-side, narrow the candidate
+  prefix, re-stream only for the passes that still need the data. Exact at
+  ``n`` far beyond HBM.
+- :mod:`sketch` — :class:`RadixSketch`, a fixed-size mergeable multi-level
+  digit-histogram accumulator for online quantiles: ``update``/``merge``
+  (associative AND commutative — bitwise merge-order invariant), exact
+  ``rank_bounds``/``value_bounds``, approximate ``quantile``, and a
+  ``refine`` hook that reuses the chunked path for exact answers.
+"""
+
+from mpi_k_selection_tpu.streaming.chunked import (
+    as_chunk_source,
+    streaming_kselect,
+    streaming_kselect_many,
+    streaming_rank_certificate,
+)
+from mpi_k_selection_tpu.streaming.sketch import RadixSketch
+
+__all__ = [
+    "RadixSketch",
+    "as_chunk_source",
+    "streaming_kselect",
+    "streaming_kselect_many",
+    "streaming_rank_certificate",
+]

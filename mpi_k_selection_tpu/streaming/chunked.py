@@ -1,0 +1,432 @@
+"""Out-of-core exact k-selection over chunked streams.
+
+Every resident selection path (ops/radix.py, parallel/radix.py) requires the
+whole array on device, bounding serviceable ``n`` by HBM. This module removes
+that bound: the input is a *chunk source* — host arrays, device arrays, or a
+replayable generator — and each radix pass streams the chunks through the
+device one at a time, accumulating ONE digit histogram for the whole stream.
+The cross-pass state is the same two scalars as the resident descent
+(prefix, k), so chunks are free to be discarded (and regenerated, or re-read
+from disk) between passes. This is the reference CGM's own discipline — scan
+local data, exchange a small summary, discard, repeat
+(``TODO-kth-problem-cgm.c:103-293``) — applied across *time* instead of
+across ranks.
+
+Exactness: histogram counts are integers accumulated host-side in int64, so
+the walk is exact for ``n`` up to 2^63 regardless of jax's x64 mode (the
+per-chunk device counts stay int32 — a chunk never exceeds 2^31 elements).
+Keys are produced by the host transform (utils/dtypes.py:np_to_sortable_bits)
+for host chunks — which makes streaming float64 selection bit-exact even on
+TPU, where resident f64 device storage truncates to ~49 bits — and by the
+device transform for device chunks.
+
+Termination mirrors ops/radix.py's cutover: as soon as the surviving
+population fits ``collect_budget``, one extra streaming pass collects the
+survivors host-side and a tiny partition finishes — so uniform-ish data pays
+~2 passes + collect instead of the full ``key_bits / radix_bits`` schedule.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from mpi_k_selection_tpu.utils import dtypes as _dt
+
+DEFAULT_COLLECT_BUDGET = 1 << 20
+
+
+def _is_device_array(chunk) -> bool:
+    import jax
+
+    return isinstance(chunk, jax.Array)
+
+
+def _tpu_backend() -> bool:
+    import jax
+
+    return jax.default_backend() == "tpu"
+
+
+def as_chunk_source(source):
+    """Normalize ``source`` to a zero-arg callable returning a fresh chunk
+    iterator — the replayable form every streaming pass needs.
+
+    Accepted: a list/tuple of arrays, a single array (one chunk), or a
+    zero-arg callable returning an iterable of arrays. A bare one-shot
+    iterator/generator is rejected with instructions: exact selection
+    re-reads the stream once per radix pass, which a consumed generator
+    cannot serve (use :class:`~mpi_k_selection_tpu.streaming.sketch.
+    RadixSketch` for single-pass approximate answers).
+    """
+    if callable(source):
+        return source
+    if isinstance(source, (list, tuple)):
+        return lambda: iter(source)
+    if isinstance(source, np.ndarray) or _is_device_array(source):
+        return lambda: iter((source,))
+    if hasattr(source, "__iter__") or hasattr(source, "__next__"):
+        raise TypeError(
+            "streaming selection re-reads the data once per radix pass; a "
+            "one-shot iterator/generator cannot be replayed. Pass a "
+            "list/tuple of chunks or a zero-arg callable returning a fresh "
+            "iterator (e.g. lambda: (load(i) for i in range(nchunks))). "
+            "For single-pass streams, use RadixSketch (approximate) instead."
+        )
+    raise TypeError(f"unsupported chunk source type {type(source).__name__!r}")
+
+
+def _iter_key_chunks(src, dtype=None):
+    """Yield ``(keys, chunk)`` pairs for every non-empty chunk: ``keys`` is
+    the order-preserving unsigned view (host numpy for host chunks, device
+    array for device chunks — each stays where it lives), ``chunk`` the
+    raveled original. Validates dtype consistency across the stream."""
+    for chunk in src():
+        if _is_device_array(chunk):
+            c = chunk.ravel()
+        else:
+            c = np.ravel(np.asarray(chunk))
+        if c.size == 0:
+            continue
+        if c.size >= 1 << 31:
+            raise ValueError(
+                f"chunk of {c.size} elements: per-chunk device histogram "
+                "counts are int32-exact only below 2^31 elements — split "
+                "the stream into smaller chunks (n is unbounded, chunks "
+                "are not)"
+            )
+        if dtype is None:
+            dtype = np.dtype(c.dtype)
+        elif np.dtype(c.dtype) != dtype:
+            raise TypeError(
+                f"chunk dtype {np.dtype(c.dtype)} != stream dtype {dtype}; "
+                "streaming selection requires one dtype per stream"
+            )
+        if not _is_device_array(c):
+            yield _dt.np_to_sortable_bits(c), c
+        elif dtype == np.float64 and _tpu_backend():
+            # device f64 keys on TPU are the ~49-bit approximation
+            # (utils/dtypes.py:f64_raw_bits) — decode the chunk's (already
+            # storage-truncated) values to host and key them EXACTLY, so
+            # every chunk of a stream lives in ONE key space regardless of
+            # residency and the answer is exact w.r.t. the chunk contents
+            hc = np.asarray(c)
+            yield _dt.np_to_sortable_bits(hc), hc
+        else:
+            yield _dt.to_sortable_bits(c), c
+
+
+def resolve_stream_hist(hist_method: str, dtype) -> str:
+    """``"numpy"`` (host bincount) or an ops/histogram.py method name.
+
+    ``"auto"`` keeps the device path (ops/histogram.py resolves it to the
+    Pallas kernels on TPU, scatter elsewhere) EXCEPT where the device would
+    not be exact: 64-bit keys without x64 (jnp would silently truncate
+    them) and float64 on TPU (device keys are the ~49-bit ``f64_raw_bits``
+    approximation; the host path keys the exact bits) — host counting
+    needs no mode flip and stays exact for both.
+    """
+    if hist_method == "numpy":
+        return "numpy"
+    dtype = np.dtype(dtype)
+    if dtype.itemsize == 8:
+        import jax
+
+        if not jax.config.jax_enable_x64:
+            return "numpy"
+        if dtype.kind == "f" and jax.default_backend() == "tpu":
+            return "numpy"
+    return hist_method
+
+
+def _chunk_histograms(keys, shift, radix_bits, prefixes, method, kdt):
+    """``{prefix: int64 histogram}`` of one chunk's digit at ``shift``, for
+    every prefix in ``prefixes`` (``None`` = no filter) — the chunk-side
+    work is paid ONCE and shared across prefixes: host chunks compute the
+    digit/prefix arrays once, device chunks cross the tunnel once and stay
+    on device for the counts (the whole point on TPU); only the
+    (2**radix_bits,) counts per prefix come back."""
+    if method == "numpy":
+        k = keys if isinstance(keys, np.ndarray) else np.asarray(keys)
+        dig = ((k >> kdt.type(shift)) & kdt.type((1 << radix_bits) - 1)).astype(
+            np.int64
+        )
+        nb = 1 << radix_bits
+        if len(prefixes) == 1 and prefixes[0] is None:
+            return {None: np.bincount(dig, minlength=nb).astype(np.int64)}
+        up = k >> kdt.type(shift + radix_bits)
+        return {
+            p: np.bincount(dig[up == kdt.type(p)], minlength=nb).astype(np.int64)
+            for p in prefixes
+        }
+    import jax.numpy as jnp
+
+    from mpi_k_selection_tpu.ops.histogram import (
+        masked_radix_histogram,
+        multi_masked_radix_histogram,
+    )
+
+    dk = jnp.asarray(keys)  # no-op for device chunks: ONE transfer, all prefixes
+    if len(prefixes) == 1 and prefixes[0] is None:
+        h = masked_radix_histogram(
+            dk,
+            shift=shift,
+            radix_bits=radix_bits,
+            prefix=None,
+            method=method,
+            count_dtype=jnp.int32,  # exact per chunk (chunk size < 2^31)
+        )
+        return {None: np.asarray(h).astype(np.int64)}
+    # the shared-sweep primitive of the resident multi-rank descent: on the
+    # pallas methods all K prefix queries ride ONE read of the chunk (other
+    # methods fall back to K single-prefix sweeps — correct, just K reads)
+    hk = np.asarray(
+        multi_masked_radix_histogram(
+            dk,
+            shift=shift,
+            radix_bits=radix_bits,
+            prefixes=np.asarray(prefixes, kdt),
+            method=method,
+            count_dtype=jnp.int32,
+        )
+    ).astype(np.int64)
+    return {p: hk[i] for i, p in enumerate(prefixes)}
+
+
+def _np_walk(hist, kk, prefix, radix_bits):
+    """Host bucket-walk step (the numpy twin of ops/radix.py:
+    bucket_walk_step): pick the bucket containing the kk-th survivor,
+    rebase kk, extend the prefix. Returns (prefix, kk, bucket_count)."""
+    cum = np.cumsum(hist)
+    b = int(np.searchsorted(cum, kk, side="left"))
+    kk = int(kk - (cum[b - 1] if b else 0))
+    prefix = ((int(prefix) << radix_bits) | b) if prefix is not None else b
+    return prefix, kk, int(hist[b])
+
+
+def _collect_survivors(src, dtype, specs):
+    """One streamed pass collecting survivors for EVERY ``(resolved_bits,
+    prefix) -> expected population`` spec at once — the shared finish of
+    the multi-rank descent (a single-rank descent passes one spec). Keys
+    whose top ``resolved_bits`` equal ``prefix`` survive; device chunks are
+    filtered ON device (eager boolean indexing) so only survivors cross
+    back to the host. Returns ``{spec: host uint key array}``."""
+    kdt = np.dtype(_dt.key_dtype(dtype))
+    total_bits = _dt.key_bits(dtype)
+    out = {s: [] for s in specs}
+    for keys, _ in _iter_key_chunks(src, dtype):
+        host = isinstance(keys, np.ndarray)
+        for resolved, prefix in out:
+            shift = total_bits - resolved
+            if host:
+                surv = keys[(keys >> kdt.type(shift)) == kdt.type(prefix)]
+            else:
+                import jax
+
+                m = jax.lax.shift_right_logical(
+                    keys, keys.dtype.type(shift)
+                ) == keys.dtype.type(prefix)
+                surv = np.asarray(keys[m])  # eager boolean gather, device-side
+            if surv.size:
+                out[(resolved, prefix)].append(np.asarray(surv, kdt))
+    collected = {}
+    for spec, parts in out.items():
+        c = np.concatenate(parts) if parts else np.empty((0,), kdt)
+        if c.size != specs[spec]:  # pragma: no cover - source changed between passes
+            raise RuntimeError(
+                f"chunk source is not replay-stable: collected {c.size} "
+                f"survivors, histogram pass counted {specs[spec]}. The source "
+                "callable must yield identical data on every invocation."
+            )
+        collected[spec] = c
+    return collected
+
+
+def _validate_ks(ks, n):
+    for k in ks:
+        if not 1 <= k <= n:
+            raise ValueError(f"k={k} out of range [1, {n}]")
+
+
+def streaming_kselect(
+    source,
+    k,
+    *,
+    radix_bits: int = 8,
+    hist_method: str = "auto",
+    collect_budget: int = DEFAULT_COLLECT_BUDGET,
+    sketch=None,
+):
+    """Exact k-th smallest (1-indexed) over a chunked stream.
+
+    ``source`` per :func:`as_chunk_source`. ``k`` must be concrete (the
+    loop is host-driven — there is nothing to trace). ``sketch`` is an
+    optional :class:`~mpi_k_selection_tpu.streaming.sketch.RadixSketch`
+    built over the SAME stream: its deepest exact level seeds the descent,
+    skipping the first ``sketch.resolution_bits`` worth of passes (the
+    ``refine`` fast path). Returns a host scalar of the stream's dtype —
+    bit-exact, including float64 on TPU for host chunks (host key space
+    end-to-end; see module docstring).
+
+    ``collect_budget`` bounds host memory for the survivor collect (keys of
+    at most that many elements are materialized at once); the streamed
+    chunks themselves are never concatenated.
+    """
+    return streaming_kselect_many(
+        source,
+        [k],
+        radix_bits=radix_bits,
+        hist_method=hist_method,
+        collect_budget=collect_budget,
+        sketch=sketch,
+    )[0]
+
+
+def streaming_kselect_many(
+    source,
+    ks,
+    *,
+    radix_bits: int = 8,
+    hist_method: str = "auto",
+    collect_budget: int = DEFAULT_COLLECT_BUDGET,
+    sketch=None,
+):
+    """Exact k-th smallest for EVERY (1-indexed) rank in ``ks``, sharing
+    each streamed pass across ranks: the stream is replayed once per radix
+    level plus one collect — NOT once per rank — with one histogram per
+    DISTINCT surviving prefix at each level (ranks whose descents land in
+    the same bucket share it). For out-of-core sources the replay is the
+    dominant cost, so m quantiles over one stream cost roughly the passes
+    of one. Per-rank semantics are exactly :func:`streaming_kselect`'s;
+    returns a list in input order.
+    """
+    src = as_chunk_source(source)
+    ks = [int(k) for k in ks]
+    if not ks:
+        return []
+
+    # per-rank descent state: [prefix, rebased_k, resolved_bits, population]
+    if sketch is not None:
+        # the sketch names the stream dtype (later passes validate every
+        # chunk against it); check_stream validates divisibility of the
+        # bits BELOW its resolved prefix — what the remaining passes walk
+        dtype = sketch.dtype
+        kdt = np.dtype(_dt.key_dtype(dtype))
+        total_bits = _dt.key_bits(dtype)
+        method = resolve_stream_hist(hist_method, dtype)
+        sketch.check_stream(dtype, radix_bits)
+        _validate_ks(ks, sketch.n)
+        states = [list(sketch.walk(k)) for k in ks]
+    else:
+        # pass 0 triples as the length scan and the dtype probe: ONE
+        # streamed histogram of the top digit (rank-independent — no prefix
+        # filter yet), with dtype (hence key geometry and method) captured
+        # from the first chunk — nothing is produced just to be discarded
+        dtype = None
+        n = 0
+        for keys, chunk in _iter_key_chunks(src):
+            if dtype is None:
+                dtype = np.dtype(chunk.dtype)
+                kdt = np.dtype(_dt.key_dtype(dtype))
+                total_bits = _dt.key_bits(dtype)
+                if total_bits % radix_bits:
+                    raise ValueError(
+                        f"radix_bits={radix_bits} must divide key bits "
+                        f"{total_bits}"
+                    )
+                method = resolve_stream_hist(hist_method, dtype)
+                shift0 = total_bits - radix_bits
+                hist = np.zeros((1 << radix_bits,), np.int64)
+            hist += _chunk_histograms(keys, shift0, radix_bits, [None], method, kdt)[None]
+            n += int(keys.size)
+        if n == 0:
+            raise ValueError("streaming selection requires a non-empty stream")
+        _validate_ks(ks, n)
+        states = []
+        for k in ks:
+            prefix, kk, pop = _np_walk(hist, k, None, radix_bits)
+            states.append([prefix, kk, radix_bits, pop])
+
+    def _active(st):
+        return st[2] < total_bits and st[3] > collect_budget
+
+    while any(_active(st) for st in states):
+        # active ranks advance in lockstep (a rank only ever EXITS the
+        # active set), so they all sit at one resolved depth: one streamed
+        # pass serves every distinct surviving prefix
+        resolved = next(st[2] for st in states if _active(st))
+        shift = total_bits - resolved - radix_bits
+        prefixes = sorted({st[0] for st in states if _active(st)})
+        expected = {st[0]: st[3] for st in states if _active(st)}
+        hists = {p: np.zeros((1 << radix_bits,), np.int64) for p in prefixes}
+        for keys, _ in _iter_key_chunks(src, dtype):
+            for p, h in _chunk_histograms(
+                keys, shift, radix_bits, prefixes, method, kdt
+            ).items():
+                hists[p] += h
+        for p in prefixes:
+            # replay-stability check, mirroring _collect_survivors': this
+            # pass's population under each surviving prefix must equal the
+            # bucket count the PREVIOUS pass (or the seeding sketch)
+            # established — a drifting source fails loudly here instead of
+            # walking a corrupt histogram to a wrong answer
+            if int(hists[p].sum()) != expected[p]:
+                raise RuntimeError(
+                    f"chunk source is not replay-stable: prefix {p:#x} holds "
+                    f"{int(hists[p].sum())} elements this pass, previous "
+                    f"pass counted {expected[p]}. The source callable must "
+                    "yield identical data on every invocation."
+                )
+        for st in states:
+            if _active(st):
+                st[0], st[1], st[3] = _np_walk(hists[st[0]], st[1], st[0], radix_bits)
+                st[2] = resolved + radix_bits
+
+    specs = {}
+    for prefix, _kk, resolved, pop in states:
+        if resolved < total_bits:
+            specs[(resolved, int(prefix))] = pop
+    collected = _collect_survivors(src, dtype, specs) if specs else {}
+
+    answers = []
+    for prefix, kk, resolved, _pop in states:
+        if resolved == total_bits:
+            # every key bit determined (either the schedule ran out or the
+            # survivors are duplicates of one key): the prefix IS the answer
+            ans_key = kdt.type(prefix)
+        else:
+            surv = collected[(resolved, int(prefix))]
+            ans_key = np.partition(surv, kk - 1)[kk - 1]
+        answers.append(
+            _dt.np_from_sortable_bits(np.asarray([ans_key], kdt), dtype)[0]
+        )
+    return answers
+
+
+def streaming_rank_certificate(source, value):
+    """``(#elements < value, #elements <= value)`` streamed — the O(n)
+    exactness proof of utils/debug.py:rank_certificate without residency:
+    an answer for rank k is exact iff ``less < k <= leq``. Comparisons run
+    in key space (total order: ties, -0.0/+0.0 and NaN behave exactly like
+    the selection itself)."""
+    src = as_chunk_source(source)
+    less = leq = 0
+    vkey = None
+    for keys, chunk in _iter_key_chunks(src):
+        if vkey is None:
+            # key the probe value from the first chunk's dtype — no chunk
+            # is produced just to learn it
+            vkey = _dt.np_to_sortable_bits(
+                np.asarray([value], np.dtype(chunk.dtype))
+            )[0]
+        if isinstance(keys, np.ndarray):
+            less += int(np.count_nonzero(keys < vkey))
+            leq += int(np.count_nonzero(keys <= vkey))
+        else:
+            import jax.numpy as jnp
+
+            v = keys.dtype.type(vkey)
+            less += int(jnp.sum(keys < v))
+            leq += int(jnp.sum(keys <= v))
+    if vkey is None:
+        raise ValueError("streaming_rank_certificate requires a non-empty stream")
+    return less, leq

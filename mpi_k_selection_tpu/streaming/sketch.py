@@ -1,0 +1,282 @@
+"""RadixSketch — a fixed-size, exactly-mergeable digit-histogram sketch for
+online quantiles.
+
+The radix histogram that drives selection (ops/histogram.py) is an *exact,
+mergeable, constant-size summary*: counts add elementwise, so per-chunk (or
+per-shard) histograms combine associatively AND commutatively with plain
+``+`` — merge order cannot change a single bit of the accumulator, unlike
+compressed quantile sketches (t-digest, q-digest) whose merges are only
+approximately order-invariant. That property is what lets per-shard sketches
+ride one ``psum`` in parallel/sketch.py and telemetry pipelines merge
+partial sketches in any tree shape.
+
+Structure: level ``l`` (1-indexed) is the exact histogram of the top
+``l * radix_bits`` key bits, for ``l = 1..levels``. The deepest level
+answers queries; the shallower pyramid gives coarse prefixes for seeding
+exact refinement at any resolution multiple of ``radix_bits``. Size is fixed
+at ``sum(2**(l*rb))`` int64 counters (~70K counters / 0.5 MB at the default
+4 bits x 4 levels) — independent of ``n``.
+
+Guarantees (let ``b = resolution_bits = levels * radix_bits``):
+
+- ``rank_bounds(k) -> (lo, hi)`` with ``lo < k <= hi`` is EXACT for any
+  stream, adversarial included: lo/hi are true ranks of the resolved
+  key-interval boundaries.
+- ``value_bounds(k)`` brackets the true k-th smallest value by the interval
+  of width ``2**(key_bits - b)`` in key space (clamped to the observed
+  min/max) — again exact for any stream.
+- ``query(k)`` / ``quantile(q)`` point estimates carry rank error at most
+  ``hi - lo`` (the answering bucket's population — query it via
+  ``rank_error_bound(k)``). For streams that do not concentrate more than
+  ``c * n / 2**b`` elements into any resolved interval (uniform-ish keys;
+  c covers sampling fluctuation), that is the advertised ``c * n / 2**b``
+  bound. Heavy duplicates keep the bounds above exact but widen the point
+  estimate's rank error — that is inherent to ANY fixed-resolution value
+  histogram, and exactly what :meth:`refine` exists for.
+- ``refine(source, k)`` is bit-exact: it seeds the out-of-core descent
+  (streaming/chunked.py) with the sketch's resolved prefix, skipping
+  ``levels`` histogram passes over the stream.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from mpi_k_selection_tpu.utils import dtypes as _dt
+
+# fixed-size cap: 2^20 int64 counters = 8 MB for the deepest level
+_MAX_RESOLUTION_BITS = 20
+
+
+class RadixSketch:
+    """Mergeable multi-level radix-digit histogram over one dtype's streams."""
+
+    def __init__(self, dtype, *, radix_bits: int = 4, levels: int = 4):
+        self.dtype = np.dtype(dtype)
+        self.kdt = np.dtype(_dt.key_dtype(self.dtype))  # validates dtype
+        self.total_bits = _dt.key_bits(self.dtype)
+        if radix_bits < 1 or levels < 1:
+            raise ValueError("radix_bits and levels must be >= 1")
+        if levels * radix_bits > min(self.total_bits, _MAX_RESOLUTION_BITS):
+            raise ValueError(
+                f"levels*radix_bits={levels * radix_bits} exceeds "
+                f"{min(self.total_bits, _MAX_RESOLUTION_BITS)} "
+                f"(key bits capped at {_MAX_RESOLUTION_BITS} to keep the "
+                "sketch fixed-size; refine() provides exactness beyond it)"
+            )
+        self.radix_bits = radix_bits
+        self.levels = levels
+        self.n = 0
+        self.hists = [
+            np.zeros((1 << (l * radix_bits),), np.int64)
+            for l in range(1, levels + 1)
+        ]
+        # exact observed extremes, in key space (None until first update)
+        self._min_key = None
+        self._max_key = None
+
+    # -- accumulation ------------------------------------------------------
+
+    @property
+    def resolution_bits(self) -> int:
+        """Key bits the deepest level resolves (= levels * radix_bits)."""
+        return self.levels * self.radix_bits
+
+    def update(self, chunk) -> "RadixSketch":
+        """Fold one chunk in (host-side — a sketch is a host accumulator;
+        for device-sharded arrays use parallel/sketch.py, which computes the
+        same histograms on device and merges them with one psum). Returns
+        ``self``. Empty chunks are no-ops."""
+        c = np.ravel(np.asarray(chunk))
+        if c.size == 0:
+            return self
+        if np.dtype(c.dtype) != self.dtype:
+            raise TypeError(
+                f"chunk dtype {np.dtype(c.dtype)} != sketch dtype {self.dtype}"
+            )
+        keys = _dt.np_to_sortable_bits(c)
+        # one full-chunk pass builds the DEEPEST level; each shallower level
+        # is that histogram with its lower digits summed out (a reshape-sum
+        # over <= 2^resolution_bits counters, bitwise identical to counting
+        # the chunk again at the coarser width and ~levels x cheaper)
+        shift = self.kdt.type(self.total_bits - self.resolution_bits)
+        deep = np.bincount(
+            (keys >> shift).astype(np.int64),
+            minlength=1 << self.resolution_bits,
+        ).astype(np.int64)
+        self._fold_deep_histogram(deep)
+        kmin, kmax = keys.min(), keys.max()
+        if self._min_key is None or kmin < self._min_key:
+            self._min_key = self.kdt.type(kmin)
+        if self._max_key is None or kmax > self._max_key:
+            self._max_key = self.kdt.type(kmax)
+        self.n += int(c.size)
+        return self
+
+    def _fold_deep_histogram(self, deep: np.ndarray) -> None:
+        """Accumulate one deepest-level int64 histogram into every level
+        (shallow levels by reshape-sum — see :meth:`update`). Shared with
+        parallel/sketch.py, whose device pass also produces only the
+        deepest level."""
+        self.hists[-1] += deep
+        for l in range(1, self.levels):
+            self.hists[l - 1] += deep.reshape(1 << (l * self.radix_bits), -1).sum(
+                axis=1
+            )
+
+    def _check_compatible(self, other: "RadixSketch") -> None:
+        if not isinstance(other, RadixSketch):
+            raise TypeError(f"cannot merge RadixSketch with {type(other).__name__}")
+        if (
+            self.dtype != other.dtype
+            or self.radix_bits != other.radix_bits
+            or self.levels != other.levels
+        ):
+            raise ValueError(
+                f"incompatible sketches: ({self.dtype}, rb={self.radix_bits}, "
+                f"L={self.levels}) vs ({other.dtype}, rb={other.radix_bits}, "
+                f"L={other.levels})"
+            )
+
+    def merge(self, other: "RadixSketch") -> "RadixSketch":
+        """Pure elementwise-sum merge — associative and commutative, so any
+        merge tree over the same update set yields a bitwise-identical
+        sketch. Neither operand is mutated."""
+        self._check_compatible(other)
+        out = RadixSketch(self.dtype, radix_bits=self.radix_bits, levels=self.levels)
+        out.n = self.n + other.n
+        out.hists = [a + b for a, b in zip(self.hists, other.hists)]
+        mins = [s._min_key for s in (self, other) if s._min_key is not None]
+        maxs = [s._max_key for s in (self, other) if s._max_key is not None]
+        out._min_key = self.kdt.type(min(mins)) if mins else None
+        out._max_key = self.kdt.type(max(maxs)) if maxs else None
+        return out
+
+    __add__ = merge
+
+    def __eq__(self, other) -> bool:
+        if not isinstance(other, RadixSketch):
+            return NotImplemented
+        return (
+            self.dtype == other.dtype
+            and self.radix_bits == other.radix_bits
+            and self.levels == other.levels
+            and self.n == other.n
+            and self._min_key == other._min_key
+            and self._max_key == other._max_key
+            and all(np.array_equal(a, b) for a, b in zip(self.hists, other.hists))
+        )
+
+    __hash__ = None  # mutable accumulator
+
+    # -- queries -----------------------------------------------------------
+
+    def _bucket(self, k: int, level: int | None = None):
+        """(bucket, rank_lo, rank_hi) at ``level`` (deepest by default):
+        the resolved-prefix bucket whose exact rank interval contains k."""
+        if self.n == 0:
+            raise ValueError("empty sketch")
+        k = int(k)
+        if not 1 <= k <= self.n:
+            raise ValueError(f"k={k} out of range [1, {self.n}]")
+        hist = self.hists[(self.levels if level is None else level) - 1]
+        cum = np.cumsum(hist)
+        b = int(np.searchsorted(cum, k, side="left"))
+        lo = int(cum[b - 1]) if b else 0
+        return b, lo, int(cum[b])
+
+    def rank_bounds(self, k: int) -> tuple[int, int]:
+        """Exact ``(lo, hi)`` with ``lo < k <= hi``: the true ranks
+        bracketing the resolved key interval that contains the k-th
+        smallest element. Holds for ANY stream (adversarial included)."""
+        _, lo, hi = self._bucket(k)
+        return lo, hi
+
+    def rank_error_bound(self, k: int) -> int:
+        """Worst-case rank error of :meth:`query`'s point estimate for this
+        k: the answering bucket's population (``hi - lo``). For streams with
+        no resolved interval heavier than ``c * n / 2**resolution_bits``
+        this is the advertised ``c * n / 2**bits`` bound."""
+        lo, hi = self.rank_bounds(k)
+        return hi - lo
+
+    def max_bucket_population(self) -> int:
+        """Heaviest deepest-level bucket — the sketch-wide rank-error bound
+        (``max_k rank_error_bound(k)``)."""
+        return int(self.hists[-1].max()) if self.n else 0
+
+    def _interval_keys(self, bucket: int):
+        shift = self.total_bits - self.resolution_bits
+        lo_key = self.kdt.type(np.uint64(bucket) << np.uint64(shift))
+        span = (np.uint64(1) << np.uint64(shift)) - np.uint64(1)
+        hi_key = self.kdt.type((np.uint64(bucket) << np.uint64(shift)) | span)
+        lo_key = max(lo_key, self._min_key)
+        hi_key = min(hi_key, self._max_key)
+        return lo_key, hi_key
+
+    def value_bounds(self, k: int):
+        """``(v_lo, v_hi)`` values of the stream's dtype with the true k-th
+        smallest guaranteed inside ``[v_lo, v_hi]`` — the resolved key
+        interval clamped to the observed extremes. Exact for any stream."""
+        b, _, _ = self._bucket(k)
+        lo_key, hi_key = self._interval_keys(b)
+        pair = _dt.np_from_sortable_bits(np.asarray([lo_key, hi_key], self.kdt), self.dtype)
+        return pair[0], pair[1]
+
+    def query(self, k: int):
+        """Point estimate for the k-th smallest: the answering interval's
+        lower boundary (clamped to the observed extremes). Rank error
+        bounded by :meth:`rank_error_bound`; use :meth:`refine` for exact."""
+        return self.value_bounds(k)[0]
+
+    def quantile(self, q: float):
+        """Approximate quantile (nearest-rank convention, matching
+        api.quantile_ranks)."""
+        return self.quantiles([q])[0]
+
+    def quantiles(self, qs):
+        from mpi_k_selection_tpu.api import quantile_ranks
+
+        return [self.query(k) for k in quantile_ranks(qs, self.n)]
+
+    # -- exact refinement --------------------------------------------------
+
+    def walk(self, k: int):
+        """``(prefix, rebased_k, resolved_bits, population)`` of the deepest
+        exact level — the seed for a chunked descent, identical in meaning
+        to ``resolution_bits / radix_bits`` streamed histogram passes."""
+        b, lo, hi = self._bucket(k)
+        return b, int(k) - lo, self.resolution_bits, hi - lo
+
+    def check_stream(self, dtype, radix_bits: int) -> None:
+        """Validate that a chunked descent with ``radix_bits`` can continue
+        from this sketch's resolved prefix (streaming/chunked.py calls this
+        before seeding)."""
+        if np.dtype(dtype) != self.dtype:
+            raise TypeError(
+                f"stream dtype {np.dtype(dtype)} != sketch dtype {self.dtype}"
+            )
+        remaining = self.total_bits - self.resolution_bits
+        if remaining % radix_bits:
+            raise ValueError(
+                f"radix_bits={radix_bits} must divide the {remaining} key "
+                f"bits left below the sketch's {self.resolution_bits} "
+                "resolved bits"
+            )
+
+    def refine(self, source, k: int, **kwargs):
+        """Exact k-th smallest over ``source`` (which must replay the very
+        stream this sketch accumulated), reusing the sketch's resolved
+        prefix to skip its ``levels`` passes. Keyword options are those of
+        streaming/chunked.py:streaming_kselect."""
+        from mpi_k_selection_tpu.streaming.chunked import streaming_kselect
+
+        kwargs.setdefault("radix_bits", self.radix_bits)
+        return streaming_kselect(source, k, sketch=self, **kwargs)
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (
+            f"RadixSketch(dtype={self.dtype}, radix_bits={self.radix_bits}, "
+            f"levels={self.levels}, n={self.n}, "
+            f"resolution_bits={self.resolution_bits})"
+        )

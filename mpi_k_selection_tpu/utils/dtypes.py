@@ -207,6 +207,51 @@ def sortable_from_raw_bits(raw: jax.Array, dtype) -> jax.Array:
     return jnp.where(neg, raw ^ all_ones, raw | msb)
 
 
+def np_to_sortable_bits(x: np.ndarray) -> np.ndarray:
+    """Host (NumPy) twin of :func:`to_sortable_bits` — pure view-casts and
+    integer ops, no device round trip. The streaming subsystem
+    (streaming/chunked.py, streaming/sketch.py) converts host chunks through
+    here, which makes out-of-core float64 selection bit-exact even on TPU:
+    the f64 bits never touch the device's ~49-bit f64 storage (the same
+    trick as ops/radix.py:_f64_tpu_host_keys, generalized to every dtype).
+    No x64 requirement — NumPy's uint64 is always real."""
+    x = np.ascontiguousarray(x)
+    dtype = np.dtype(x.dtype)
+    kdt, bits = _KEY_INFO.get(dtype, (None, None))
+    if kdt is None:
+        raise TypeError(f"unsupported dtype for k-selection: {dtype}")
+    kdt = np.dtype(kdt)
+    if jnp.issubdtype(dtype, jnp.unsignedinteger):
+        return x.view(kdt)
+    u = x.view(kdt)
+    msb = kdt.type(np.uint64(1) << np.uint64(bits - 1))
+    if jnp.issubdtype(dtype, jnp.signedinteger):
+        return u ^ msb
+    all_ones = kdt.type(~np.uint64(0) >> np.uint64(64 - bits))
+    neg = (u >> kdt.type(bits - 1)) != kdt.type(0)
+    return np.where(neg, u ^ all_ones, u | msb)
+
+
+def np_from_sortable_bits(u: np.ndarray, dtype) -> np.ndarray:
+    """Inverse of :func:`np_to_sortable_bits` (host twin of
+    :func:`from_sortable_bits`)."""
+    dtype = np.dtype(dtype)
+    kdt, bits = _KEY_INFO.get(dtype, (None, None))
+    if kdt is None:
+        raise TypeError(f"unsupported dtype for k-selection: {dtype}")
+    kdt = np.dtype(kdt)
+    u = np.ascontiguousarray(np.asarray(u, kdt))
+    if jnp.issubdtype(dtype, jnp.unsignedinteger):
+        return u.astype(dtype)
+    msb = kdt.type(np.uint64(1) << np.uint64(bits - 1))
+    if jnp.issubdtype(dtype, jnp.signedinteger):
+        return (u ^ msb).view(dtype)
+    all_ones = kdt.type(~np.uint64(0) >> np.uint64(64 - bits))
+    neg = (u & msb) == kdt.type(0)  # keys below MSB came from negative floats
+    raw = np.where(neg, u ^ all_ones, u & ~msb)
+    return np.ascontiguousarray(raw).view(dtype)
+
+
 def from_sortable_bits(u: jax.Array, dtype) -> jax.Array:
     """Inverse of :func:`to_sortable_bits`."""
     dtype = np.dtype(dtype)

@@ -146,7 +146,7 @@ def test_kselect_many_traced_scalar_ks_host_f64(monkeypatch, rng):
     monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
     # the traced calls below trip the one-time f64-approx warning; keep the
     # process-global flag's state out of other tests
-    monkeypatch.setattr(radix_mod, "_f64_tpu_approx_warned", False)
+    monkeypatch.setattr(radix_mod, "_f64_tpu_approx_warned", set())
     with jax.enable_x64(True):
         x = rng.standard_normal(1_000)  # size <= 2^14 -> the sort path
         with warnings.catch_warnings(record=True) as caught:

@@ -189,7 +189,7 @@ def test_f64_tpu_host_route_declines_under_trace_and_warns(monkeypatch):
     from mpi_k_selection_tpu.ops import radix as radix_mod
 
     monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
-    monkeypatch.setattr(radix_mod, "_f64_tpu_approx_warned", False)
+    monkeypatch.setattr(radix_mod, "_f64_tpu_approx_warned", set())
     rng = np.random.default_rng(5)
     x = rng.standard_normal(4096)
     want = float(np.sort(x, kind="stable")[499])
@@ -222,7 +222,7 @@ def test_f64_tpu_host_route_declines_under_trace_and_warns(monkeypatch):
                 )
             )()
         # the eager exact host route never warns
-        monkeypatch.setattr(radix_mod, "_f64_tpu_approx_warned", False)
+        monkeypatch.setattr(radix_mod, "_f64_tpu_approx_warned", set())
         with warnings.catch_warnings():
             warnings.simplefilter("error")
             got = radix_mod.radix_select(x, 500, hist_method="scatter")

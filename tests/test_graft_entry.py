@@ -11,6 +11,7 @@ import sys
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 import jax
+import pytest
 
 
 def test_entry_compiles_and_runs():
@@ -31,4 +32,17 @@ def test_dryrun_gauntlet_inprocess(monkeypatch):
     # tests/test_distributed*.py; in-process they cost ~40 s of suite
     # time for no added path.
     monkeypatch.setenv("_MPIKSEL_GAUNTLET_FAST", "1")
+    g.dryrun_multichip(8)  # asserts internally
+
+
+@pytest.mark.slow
+def test_dryrun_gauntlet_full(monkeypatch):
+    """The FULL 12-case matrix as an in-repo opt-in (ADVICE r5 #2): tier-1
+    runs only the FAST subset above; this slow-marked twin keeps the whole
+    gauntlet (cases 3-8, config-5 scale included) runnable without the
+    out-of-repo driver: ``pytest -m slow tests/test_graft_entry.py``."""
+    import __graft_entry__ as g
+
+    monkeypatch.setenv("_MPIKSEL_GAUNTLET_FAST", "0")
+    monkeypatch.delenv("_MPIKSEL_GAUNTLET_SKIP_SLOW", raising=False)
     g.dryrun_multichip(8)  # asserts internally

@@ -1,0 +1,53 @@
+"""Tier-1 membership audit.
+
+The tier-1 gate runs ``pytest -m 'not slow'``. A test file whose tests all
+carry an implicit skip (bad collection, module-level gating, a forgotten
+``pytestmark``) silently falls out of that gate without anyone noticing.
+This audit closes the hole: every ``tests/test_*.py`` file must either
+contribute at least one collected test to the ``-m 'not slow'`` selection
+or contain an explicit ``pytest.mark.slow`` opt-out.
+"""
+
+import pathlib
+import re
+import subprocess
+import sys
+
+TESTS_DIR = pathlib.Path(__file__).resolve().parent
+
+
+def test_every_test_file_is_tier1_or_explicitly_slow():
+    out = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "pytest",
+            "--collect-only",
+            "-q",
+            "-m",
+            "not slow",
+            "--continue-on-collection-errors",
+            "-p",
+            "no:cacheprovider",
+            str(TESTS_DIR),
+        ],
+        capture_output=True,
+        text=True,
+        cwd=TESTS_DIR.parent,
+    )
+    collected = {
+        pathlib.Path(line.split("::")[0]).name
+        for line in out.stdout.splitlines()
+        if "::" in line
+    }
+    assert collected, f"tier-1 collection produced nothing:\n{out.stdout}\n{out.stderr}"
+    offenders = [
+        f.name
+        for f in sorted(TESTS_DIR.glob("test_*.py"))
+        if f.name not in collected
+        and not re.search(r"pytest\.mark\.slow\b", f.read_text())
+    ]
+    assert not offenders, (
+        "test files neither collected under tier-1 (-m 'not slow') nor "
+        f"explicitly slow-marked: {offenders}"
+    )

@@ -1,0 +1,472 @@
+"""Streaming subsystem: out-of-core chunked selection + RadixSketch.
+
+Everything here runs on the 8-device virtual CPU mesh from conftest. The
+acceptance contract under test: chunked selection is BIT-exact against the
+seq oracle for inputs only ever materialized in chunks, at n >= 8x the
+largest chunk; sketch merge is bitwise order-invariant; rank/value bounds
+are exact on random AND adversarial streams; the n/2^bits rank-error form
+holds for streams without heavy resolved intervals (full-range uniform).
+"""
+
+import numpy as np
+import pytest
+
+from mpi_k_selection_tpu.backends import seq
+from mpi_k_selection_tpu.streaming import (
+    RadixSketch,
+    as_chunk_source,
+    streaming_kselect,
+    streaming_kselect_many,
+    streaming_rank_certificate,
+)
+from mpi_k_selection_tpu.utils import datagen
+
+
+def _chunks(x, nchunks):
+    return [np.ascontiguousarray(c) for c in np.array_split(x, nchunks)]
+
+
+STREAM_DTYPES = [
+    np.int32,
+    np.uint32,
+    np.int16,
+    np.float32,
+    np.float16,
+    # 64-bit dtypes stream WITHOUT x64: counts accumulate host-side in
+    # numpy int64 and the auto method falls back to host histograms (the
+    # device path is exercised under x64 below)
+    np.int64,
+    np.float64,
+]
+
+
+@pytest.mark.parametrize("dtype", STREAM_DTYPES, ids=lambda d: np.dtype(d).name)
+def test_chunked_matches_oracle_across_dtypes(dtype, rng):
+    n = 1 << 14
+    if np.dtype(dtype).kind == "f":
+        x = (rng.standard_normal(n) * 100).astype(dtype)
+    else:
+        info = np.iinfo(dtype)
+        x = rng.integers(info.min, info.max, size=n, dtype=np.int64).astype(dtype)
+    chunks = _chunks(x, 8)
+    for k in (1, 137, n // 2, n):
+        got = streaming_kselect(chunks, k)
+        want = seq.kselect_sort(x, k)
+        assert got == want
+        assert got.dtype == x.dtype
+
+
+@pytest.mark.parametrize("pattern", datagen.PATTERNS)
+def test_chunked_adversarial_patterns(pattern):
+    dtype = np.float32 if pattern in ("normal", "funiform") else np.int32
+    n = 1 << 14
+    x = datagen.generate(n, pattern=pattern, seed=3, dtype=dtype)
+    chunks = _chunks(x, 8)
+    for k in (1, n // 3, n):
+        assert streaming_kselect(chunks, k) == seq.kselect_sort(x, k)
+
+
+def test_chunked_extremes_fixture():
+    for name, x in datagen.adversarial_fixtures(1 << 13, dtype=np.int32, seed=5):
+        k = x.size // 2
+        assert streaming_kselect(_chunks(x, 8), k) == seq.kselect_sort(x, k), name
+
+
+def test_chunked_input_never_materialized(rng):
+    """Acceptance criterion: exact at n >= 8x the largest single chunk, with
+    the data only ever produced chunk-by-chunk from a replayable callable
+    (chunk i regenerated from its own seed on every pass)."""
+    chunk, nchunks = 1 << 13, 16
+    n = chunk * nchunks
+
+    def make(i):
+        r = np.random.default_rng(1000 + i)
+        return r.integers(-(2**31), 2**31, size=chunk, dtype=np.int64).astype(
+            np.int32
+        )
+
+    source = lambda: (make(i) for i in range(nchunks))
+    k = n // 2
+    got = streaming_kselect(source, k)
+    x = np.concatenate([make(i) for i in range(nchunks)])  # oracle only
+    assert n >= 8 * chunk
+    assert got == seq.kselect_sort(x, k)
+    less, leq = streaming_rank_certificate(source, got)
+    assert less < k <= leq
+
+
+def test_chunked_device_chunks(rng):
+    import jax.numpy as jnp
+
+    x = rng.integers(-(2**31), 2**31, size=1 << 14, dtype=np.int64).astype(np.int32)
+    dchunks = [jnp.asarray(c) for c in _chunks(x, 8)]
+    k = 4321
+    assert streaming_kselect(dchunks, k) == seq.kselect_sort(x, k)
+
+
+def test_chunked_64bit_device_path_under_x64(rng):
+    from mpi_k_selection_tpu.utils import x64
+
+    x = rng.integers(-(2**62), 2**62, size=1 << 13, dtype=np.int64)
+    k = x.size // 2
+    with x64.enable_x64():
+        got = streaming_kselect(_chunks(x, 8), k, hist_method="auto")
+    assert got == seq.kselect_sort(x, k)
+
+
+def test_chunked_full_pass_schedule_on_duplicates():
+    # all-equal stream: the population never fits any budget, so every
+    # radix pass runs and the fully-resolved prefix IS the answer
+    x = np.full(1 << 13, 42, dtype=np.int32)
+    assert streaming_kselect(_chunks(x, 8), 17, collect_budget=4) == 42
+
+
+def test_chunked_tiny_budget_multi_pass(rng):
+    x = rng.integers(-(2**31), 2**31, size=1 << 14, dtype=np.int64).astype(np.int32)
+    k = x.size // 3
+    got = streaming_kselect(_chunks(x, 8), k, collect_budget=64)
+    assert got == seq.kselect_sort(x, k)
+
+
+def test_chunked_empty_and_single_chunk_edges(rng):
+    x = rng.integers(0, 1000, size=257, dtype=np.int64).astype(np.int32)
+    # empty chunks interspersed are no-ops
+    chunks = [x[:100], np.empty(0, np.int32), x[100:], np.empty(0, np.int32)]
+    assert streaming_kselect(chunks, 19) == seq.kselect_sort(x, 19)
+    # a single chunk degenerates to resident selection
+    assert streaming_kselect([x], 19) == seq.kselect_sort(x, 19)
+    # all-empty / empty-list streams are errors
+    with pytest.raises(ValueError, match="non-empty"):
+        streaming_kselect([np.empty(0, np.int32)], 1)
+    with pytest.raises(ValueError, match="non-empty"):
+        streaming_kselect([], 1)
+
+
+def test_chunked_input_validation(rng):
+    x = rng.integers(0, 1000, size=64, dtype=np.int64).astype(np.int32)
+    with pytest.raises(ValueError, match="out of range"):
+        streaming_kselect([x], 0)
+    with pytest.raises(ValueError, match="out of range"):
+        streaming_kselect([x], 65)
+    with pytest.raises(TypeError, match="one-shot iterator"):
+        streaming_kselect(iter([x]), 1)
+    with pytest.raises(TypeError, match="one dtype"):
+        streaming_kselect([x, x.astype(np.float32)], 1)
+    with pytest.raises(ValueError, match="must divide"):
+        streaming_kselect([x], 1, radix_bits=7)
+
+
+def test_many_matches_single_and_oracle(rng):
+    """The shared-pass multi-rank descent: every rank's answer equals both
+    the single-rank streaming path and the seq oracle, including ranks that
+    share a first-level bucket, duplicated ranks, and the extremes."""
+    n = 1 << 14
+    x = rng.integers(-(2**31), 2**31, size=n, dtype=np.int64).astype(np.int32)
+    chunks = _chunks(x, 8)
+    ks = [1, 2, 137, n // 2, n // 2 + 1, n // 2, n]
+    got = streaming_kselect_many(chunks, ks)
+    assert got == [seq.kselect_sort(x, k) for k in ks]
+    assert got == [streaming_kselect(chunks, k) for k in ks]
+    assert streaming_kselect_many(chunks, []) == []
+
+
+def test_many_tiny_budget_divergent_prefixes(rng):
+    # a tiny budget forces deep descents whose prefixes diverge, exercising
+    # the per-prefix histogram groups and the multi-spec shared collect
+    x = rng.integers(-(2**31), 2**31, size=1 << 14, dtype=np.int64).astype(np.int32)
+    chunks = _chunks(x, 8)
+    ks = [7, x.size // 4, x.size // 2, x.size - 3]
+    got = streaming_kselect_many(chunks, ks, collect_budget=64)
+    assert got == [seq.kselect_sort(x, k) for k in ks]
+    # all-duplicate stream: every rank runs the full schedule, no collect
+    y = np.full(1 << 13, -9, dtype=np.int32)
+    assert streaming_kselect_many(_chunks(y, 4), [1, 100], collect_budget=4) == [-9, -9]
+
+
+def test_many_device_chunks_divergent_prefixes(rng):
+    # device chunks + tiny budget: deep multi-prefix passes run through the
+    # shared-sweep device histogram (multi_masked_radix_histogram), one
+    # chunk read serving every surviving prefix
+    import jax.numpy as jnp
+
+    x = rng.integers(-(2**31), 2**31, size=1 << 14, dtype=np.int64).astype(np.int32)
+    dchunks = [jnp.asarray(c) for c in _chunks(x, 8)]
+    ks = [7, x.size // 4, x.size // 2, x.size - 3]
+    got = streaming_kselect_many(dchunks, ks, collect_budget=64)
+    assert got == [seq.kselect_sort(x, k) for k in ks]
+
+
+def test_unstable_source_raises_mid_descent(rng):
+    """A source that yields different data on each replay must fail loudly
+    on the FIRST re-streamed histogram pass (population under the surviving
+    prefix no longer matches), not walk a corrupt histogram to a silently
+    wrong answer or a collect-time surprise."""
+    calls = [0]
+
+    def source():
+        calls[0] += 1
+        r = np.random.default_rng(calls[0])  # different stream every replay
+        yield r.integers(-(2**31), 2**31, size=1 << 13, dtype=np.int64).astype(
+            np.int32
+        )
+
+    with pytest.raises(RuntimeError, match="not replay-stable"):
+        streaming_kselect(source, 1 << 12, collect_budget=4)
+
+
+def test_many_validates_every_rank(rng):
+    x = rng.integers(0, 1000, size=64, dtype=np.int64).astype(np.int32)
+    with pytest.raises(ValueError, match="out of range"):
+        streaming_kselect_many([x], [1, 65])
+
+
+# -- RadixSketch -----------------------------------------------------------
+
+
+def _sketches_over(x, parts, **kw):
+    out = []
+    for c in np.array_split(x, parts):
+        out.append(RadixSketch(x.dtype, **kw).update(c))
+    return out
+
+
+def test_sketch_merge_is_order_invariant(rng):
+    x = rng.integers(-(2**31), 2**31, size=1 << 14, dtype=np.int64).astype(np.int32)
+    s1, s2, s3 = _sketches_over(x, 3)
+    a = s1.merge(s2).merge(s3)  # ((1+2)+3)
+    b = s1.merge(s2.merge(s3))  # (1+(2+3))  -- associativity
+    c = s3.merge(s1).merge(s2)  # permuted    -- commutativity
+    d = s1 + s2 + s3
+    assert a == b == c == d  # bitwise: counts, n, extremes
+    # merge is pure: operands unchanged, and the merged sketch equals one
+    # accumulated sequentially over the whole stream
+    whole = RadixSketch(np.int32).update(x)
+    assert a == whole and s1 != whole
+
+
+def test_sketch_merge_empty_identity(rng):
+    x = rng.integers(0, 10**6, size=1000, dtype=np.int64).astype(np.int32)
+    s = RadixSketch(np.int32).update(x)
+    empty = RadixSketch(np.int32)
+    assert s.merge(empty) == s == empty.merge(s)
+    assert empty.n == 0
+    with pytest.raises(ValueError, match="empty sketch"):
+        empty.rank_bounds(1)
+
+
+def test_sketch_incompatible_merge_raises():
+    with pytest.raises(ValueError, match="incompatible"):
+        RadixSketch(np.int32).merge(RadixSketch(np.float32))
+    with pytest.raises(ValueError, match="incompatible"):
+        RadixSketch(np.int32, radix_bits=4).merge(RadixSketch(np.int32, radix_bits=2))
+    with pytest.raises(TypeError):
+        RadixSketch(np.int32).merge(object())
+
+
+def test_sketch_fixed_size_cap():
+    with pytest.raises(ValueError, match="fixed-size"):
+        RadixSketch(np.int32, radix_bits=8, levels=4)  # 32 bits > cap
+    with pytest.raises(ValueError, match="exceeds"):
+        RadixSketch(np.int16, radix_bits=8, levels=3)  # 24 > key bits
+
+
+def _true_rank_lt(x, v):
+    """#elements < v in key order (ties with v excluded), matching the
+    sketch's key-space comparisons."""
+    from mpi_k_selection_tpu.utils import dtypes as _dt
+
+    keys = _dt.np_to_sortable_bits(x)
+    vkey = _dt.np_to_sortable_bits(np.asarray([v], x.dtype))[0]
+    return int(np.count_nonzero(keys < vkey))
+
+
+@pytest.mark.parametrize(
+    "pattern", ["uniform", "sequential", "equal", "descending", "normal"]
+)
+def test_sketch_bounds_exact_on_any_stream(pattern):
+    """The distribution-free guarantees: rank_bounds brackets k exactly and
+    value_bounds brackets the true k-th value — including adversarial
+    duplicate-heavy streams — and the point estimate's rank error never
+    exceeds rank_error_bound (the answering bucket's population)."""
+    dtype = np.float32 if pattern == "normal" else np.int32
+    n = 1 << 14
+    x = datagen.generate(n, pattern=pattern, seed=11, dtype=dtype)
+    sk = RadixSketch(dtype)
+    for c in np.array_split(x, 7):
+        sk.update(c)
+    s = np.sort(x, kind="stable")
+    for k in (1, n // 100, n // 2, n - 1, n):
+        lo, hi = sk.rank_bounds(k)
+        assert lo < k <= hi
+        vlo, vhi = sk.value_bounds(k)
+        want = s[k - 1]
+        assert vlo <= want <= vhi
+        est = sk.query(k)
+        err = abs(_true_rank_lt(x, est) - (k - 1))
+        assert err <= sk.rank_error_bound(k)
+
+
+def test_sketch_rank_error_bound_random_stream(rng):
+    """The advertised n / 2^bits form on a stream with no heavy resolved
+    interval: full-range uniform int32 keys spread ~evenly over the
+    deepest level, so the max bucket population (== the sketch-wide rank
+    error bound) sits within a small constant of n / 2^resolution_bits."""
+    n = 1 << 16
+    x = rng.integers(-(2**31), 2**31, size=n, dtype=np.int64).astype(np.int32)
+    sk = RadixSketch(np.int32, radix_bits=4, levels=3)  # 12 bits resolved
+    sk.update(x)
+    per_bucket = n / (1 << sk.resolution_bits)  # = 16
+    assert sk.max_bucket_population() <= 8 * per_bucket
+    s = np.sort(x)
+    for q in (0.01, 0.25, 0.5, 0.9, 0.99):
+        k = max(1, int(np.ceil(q * n)))
+        est = sk.query(k)
+        err = abs(_true_rank_lt(x, est) - (k - 1))
+        assert err <= 8 * per_bucket
+
+
+def test_sketch_quantiles_and_refine(rng):
+    n = 1 << 14
+    x = rng.integers(-(2**31), 2**31, size=n, dtype=np.int64).astype(np.int32)
+    chunks = _chunks(x, 8)
+    sk = RadixSketch(np.int32)
+    for c in chunks:
+        sk.update(c)
+    qs = [0.5, 0.9, 0.99]
+    approx = sk.quantiles(qs)
+    assert len(approx) == 3
+    # refine reuses the chunked path seeded by the sketch: bit-exact
+    from mpi_k_selection_tpu.api import quantile_ranks
+
+    for q, k in zip(qs, quantile_ranks(qs, n)):
+        assert sk.refine(chunks, k) == seq.kselect_sort(x, k)
+
+
+def test_streaming_quantiles_api(rng):
+    from mpi_k_selection_tpu import StreamingQuantiles, kselect_streaming
+
+    n = 1 << 14
+    x = rng.integers(0, 10**8, size=n, dtype=np.int64).astype(np.int32)
+    chunks = _chunks(x, 8)
+    t1 = StreamingQuantiles(np.int32).update(chunks[0]).update(chunks[1])
+    t2 = StreamingQuantiles(np.int32)
+    for c in chunks[2:]:
+        t2.update(c)
+    t = t1.merge(t2)
+    assert t.n == n
+    qs = [0.5, 0.99]
+    exact = t.refine_quantiles(qs, chunks)
+    s = np.sort(x, kind="stable")
+    from mpi_k_selection_tpu.api import quantile_ranks
+
+    assert exact == [s[k - 1] for k in quantile_ranks(qs, n)]
+    # api-level chunked entry
+    assert kselect_streaming(chunks, n // 2) == s[n // 2 - 1]
+
+
+def test_sketch_refine_radix_bits_divides_remaining_not_total(rng):
+    """Seeded descents only need radix_bits to divide the bits BELOW the
+    sketch's resolved prefix: rb=5 doesn't divide 32 key bits but does
+    divide the 20 left under a 12-bit sketch — valid and exact."""
+    x = rng.integers(-(2**31), 2**31, size=1 << 13, dtype=np.int64).astype(np.int32)
+    chunks = _chunks(x, 8)
+    sk = RadixSketch(np.int32, radix_bits=4, levels=3)  # 12 resolved bits
+    for c in chunks:
+        sk.update(c)
+    k = x.size // 2
+    assert sk.refine(chunks, k, radix_bits=5, collect_budget=64) == seq.kselect_sort(x, k)
+    with pytest.raises(ValueError, match="must divide"):
+        sk.refine(chunks, k, radix_bits=7)  # 20 % 7 != 0
+
+
+def test_sketch_float64_stream_no_x64(rng):
+    """Host-side sketch + refine over float64 chunks works (and stays
+    bit-exact) without ever enabling x64 — keys never touch the device."""
+    x = rng.standard_normal(1 << 13)
+    chunks = _chunks(x, 8)
+    sk = RadixSketch(np.float64)
+    for c in chunks:
+        sk.update(c)
+    k = x.size // 2
+    lo, hi = sk.rank_bounds(k)
+    assert lo < k <= hi
+    assert sk.refine(chunks, k) == seq.kselect_sort(x, k)
+
+
+def test_distributed_sketch_matches_host_sketch(rng):
+    """Sharded merge on the virtual mesh: per-shard device histograms
+    psum-merged into a sketch bitwise-equal to sequential host updates over
+    the same (ragged — exercises the tail fold) array."""
+    import jax.numpy as jnp
+
+    from mpi_k_selection_tpu.parallel import distributed_sketch, make_mesh
+
+    n = (1 << 13) - 5
+    x = rng.integers(-(2**31), 2**31, size=n, dtype=np.int64).astype(np.int32)
+    mesh = make_mesh()
+    assert mesh.size == 8
+    dsk = distributed_sketch(jnp.asarray(x), mesh=mesh)
+    assert dsk == RadixSketch(np.int32).update(x)
+    xf = rng.standard_normal(1 << 12).astype(np.float32)
+    dskf = distributed_sketch(jnp.asarray(xf), mesh=mesh, radix_bits=4, levels=3)
+    assert dskf == RadixSketch(np.float32, radix_bits=4, levels=3).update(xf)
+
+
+def test_distributed_sketch_nan_and_signed_zero_extremes(rng):
+    """Extremes are taken in KEY space on device, so streams containing NaN
+    and -0.0/+0.0 (where value-space min/max diverge from the keys' total
+    order) still produce a sketch bitwise-equal to host accumulation."""
+    import jax.numpy as jnp
+
+    from mpi_k_selection_tpu.parallel import distributed_sketch, make_mesh
+
+    x = rng.standard_normal(1 << 12).astype(np.float32)
+    x[17] = np.nan
+    x[100] = -0.0
+    x[200] = +0.0
+    dsk = distributed_sketch(jnp.asarray(x), mesh=make_mesh())
+    assert dsk == RadixSketch(np.float32).update(x)
+
+
+def test_distributed_sketch_64bit_no_x64(rng):
+    """64-bit host input with x64 OFF must not be silently narrowed by the
+    device cast (jnp.asarray would truncate int64->int32): the sketch falls
+    back to exact host accumulation, keeping dtype and counts bitwise equal
+    to the host sketch."""
+    from mpi_k_selection_tpu.parallel import distributed_sketch
+
+    x = rng.integers(-(2**62), 2**62, size=(1 << 12) + 3, dtype=np.int64)
+    dsk = distributed_sketch(x)
+    assert dsk.dtype == np.int64
+    assert dsk == RadixSketch(np.int64).update(x)
+
+
+def test_distributed_sketch_64bit_device_path_under_x64(rng):
+    from mpi_k_selection_tpu.parallel import distributed_sketch, make_mesh
+    from mpi_k_selection_tpu.utils import x64
+
+    x = rng.integers(-(2**62), 2**62, size=(1 << 12) - 1, dtype=np.int64)
+    with x64.enable_x64():
+        import jax.numpy as jnp
+
+        dsk = distributed_sketch(jnp.asarray(x), mesh=make_mesh())
+    assert dsk == RadixSketch(np.int64).update(x)
+
+
+def test_cli_streaming_mode(capsys):
+    from mpi_k_selection_tpu import cli
+
+    rc = cli.main(
+        [
+            "--backend", "tpu", "--streaming", "--n", "100000",
+            "--chunk-elems", "9973", "--verify", "--check", "--json",
+        ]
+    )
+    assert rc == 0
+    import json
+
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["algorithm"] == "streaming-chunked"
+    assert rec["extra"]["exact_match"] is True
+    assert rec["extra"]["certificate_ok"] is True
+    assert rec["extra"]["chunks"] == 11
