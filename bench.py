@@ -19,6 +19,7 @@ jitted chains of R1 and R2 *data-dependent* iterations (iteration i depends
 on iteration i-1, so no iteration can be elided) and report the differential
 (t2 - t1) / (R2 - R1): pure device-side solve time.
 """
+# ksel: noqa-file[KSL004] -- the differential perturb-chain methodology reads clocks inline around chained device calls; utils/timing.time_fn's block-per-call semantics would break the chain (its own docstring points here)
 
 from __future__ import annotations
 

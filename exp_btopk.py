@@ -31,6 +31,7 @@ r5 addendum (envelope widening, measured via bench._timed_chain on v5e at
     number itself: the kernel + one unavoidable second read of x already
     costs ~1.7 ms).
 """
+# ksel: noqa-file[KSL004] -- research script using the same inline perturb-chain clock discipline as bench.py
 
 import functools
 import time

@@ -1,0 +1,53 @@
+"""kselect-lint — static analysis gating this codebase's recurring bug classes.
+
+Every review round of this repository has caught the same families of
+latent bugs by hand: silent int64->int32 truncation when x64 is off,
+f64-on-TPU paths that bypass the ~49-bit key-space warning, host syncs
+hiding inside jitted hot paths, and test files silently falling out of the
+tier-1 gate. This package encodes those reviewers' checklists as
+machine-enforced rules, in two engines:
+
+1. **AST lint rules** (analysis/ast_rules.py, ids ``KSLxxx``) over the
+   package source — pure syntax-tree pattern rules with per-line
+   ``# ksel: noqa[KSLxxx]`` suppressions.
+2. **jaxpr contract checks** (analysis/jaxpr_checks.py, ids ``KSCxxx``)
+   that abstractly trace the public kernels over a shape/dtype grid and
+   assert dtype preservation, counter-width discipline, and jaxpr
+   stability across batch sizes (the recompile-hazard detector).
+
+Run it::
+
+    kselect-lint mpi_k_selection_tpu/            # console script
+    python -m mpi_k_selection_tpu.analysis .     # same thing
+
+The tier-1 test suite runs the analyzer over the whole repository and
+fails on any unsuppressed finding (tests/test_analysis.py), so a PR cannot
+reintroduce a gated bug class without carrying a written justification.
+Rule catalog: docs/ANALYSIS.md.
+"""
+
+from mpi_k_selection_tpu.analysis.core import (
+    Finding,
+    Report,
+    Rule,
+    iter_python_files,
+    load_module,
+    run_analysis,
+)
+from mpi_k_selection_tpu.analysis import ast_rules as _ast_rules  # registers KSL rules
+from mpi_k_selection_tpu.analysis.core import all_rules
+from mpi_k_selection_tpu.analysis.jaxpr_checks import CONTRACT_CHECKS
+from mpi_k_selection_tpu.analysis.reporters import render_json, render_text
+
+__all__ = [
+    "Finding",
+    "Report",
+    "Rule",
+    "run_analysis",
+    "all_rules",
+    "iter_python_files",
+    "load_module",
+    "CONTRACT_CHECKS",
+    "render_json",
+    "render_text",
+]

@@ -1,0 +1,83 @@
+"""``kselect-lint`` / ``python -m mpi_k_selection_tpu.analysis`` driver.
+
+Exit codes: 0 clean (or everything suppressed), 1 unsuppressed findings,
+2 usage error. The tier-1 gate (tests/test_analysis.py) runs the same
+engine in-process and asserts exit code 0 over the whole repository.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="kselect-lint",
+        description=(
+            "JAX-aware static analysis for the k-selection codebase: AST "
+            "rules (KSLxxx) + jaxpr contract checks (KSCxxx). Rule catalog: "
+            "docs/ANALYSIS.md."
+        ),
+    )
+    p.add_argument("paths", nargs="*", default=["."], help="files/directories to scan")
+    p.add_argument("--json", action="store_true", help="emit the JSON report")
+    p.add_argument("--output", default=None, help="also write the JSON report here")
+    p.add_argument(
+        "--select", default=None,
+        help="comma-separated rule-id prefixes to run (e.g. KSL001,KSC)",
+    )
+    p.add_argument(
+        "--ignore", default=None,
+        help="comma-separated rule-id prefixes to skip",
+    )
+    p.add_argument(
+        "--no-contracts", action="store_true",
+        help="skip the jaxpr contract checks (no jax import; pure AST lint)",
+    )
+    p.add_argument(
+        "--verbose", action="store_true",
+        help="show suppressed findings in text output too",
+    )
+    p.add_argument("--list-rules", action="store_true", help="print the rule catalog")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    from mpi_k_selection_tpu.analysis import (
+        CONTRACT_CHECKS,
+        all_rules,
+        render_json,
+        render_text,
+        run_analysis,
+    )
+
+    if args.list_rules:
+        for rid, rule in sorted(all_rules().items()):
+            print(f"{rid}  {rule.title}")
+        for check in CONTRACT_CHECKS:
+            print(f"{check.id}  {check.title}")
+        return 0
+
+    select = args.select.split(",") if args.select else None
+    ignore = args.ignore.split(",") if args.ignore else None
+    try:
+        report = run_analysis(
+            args.paths,
+            select=select,
+            ignore=ignore,
+            contracts=not args.no_contracts,
+        )
+    except (OSError, RuntimeError) as e:
+        print(f"kselect-lint: error: {e}", file=sys.stderr)
+        return 2
+    if args.output:
+        with open(args.output, "w") as fh:
+            fh.write(render_json(report))
+    print(render_json(report) if args.json else render_text(report, verbose=args.verbose))
+    return report.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,474 @@
+"""AST lint rules (KSL001-KSL006) — each encodes a bug class a human
+reviewer caught in this repository at least once. docs/ANALYSIS.md holds
+the catalog with the historical incident behind every rule.
+
+The rules are module-local by design: reachability is computed from one
+file's call graph (a function is "jit-reachable" when it, or a function
+that references it by name in the same module, is jit/shard_map-wrapped).
+Cross-module reachability would need whole-program import resolution for
+marginal extra recall — the bug classes these rules gate have all been
+single-module patterns.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+import subprocess
+import sys
+
+from mpi_k_selection_tpu.analysis.core import Rule, SourceModule, register
+
+# ---------------------------------------------------------------------------
+# shared AST helpers
+
+
+def dotted_name(node: ast.AST) -> str:
+    """``a.b.c`` for Name/Attribute chains, '' for anything else."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _function_defs(tree: ast.AST) -> dict[str, list[ast.AST]]:
+    """Every (possibly nested) function def in the module, by bare name."""
+    defs: dict[str, list[ast.AST]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, []).append(node)
+    return defs
+
+
+_JIT_WRAPPERS = {"jax.jit", "jit", "pjit", "jax.pjit"}
+_SHARD_WRAPPERS = {
+    "jax.shard_map",
+    "shard_map",
+    "_shard_map",
+    "compat.shard_map",
+    "jax.experimental.shard_map.shard_map",
+}
+_TRACE_WRAPPERS = _JIT_WRAPPERS | _SHARD_WRAPPERS
+
+
+def _is_trace_wrapper_call(call: ast.Call) -> bool:
+    name = dotted_name(call.func)
+    if name in _TRACE_WRAPPERS:
+        return True
+    # functools.partial(jax.jit, ...) — a jit decorator factory
+    if name in ("functools.partial", "partial") and call.args:
+        return dotted_name(call.args[0]) in _TRACE_WRAPPERS
+    return False
+
+
+def _jit_roots(tree: ast.AST, defs: dict[str, list[ast.AST]]) -> set[ast.AST]:
+    """Function defs that are jit/shard_map-wrapped: decorated with a
+    wrapper, or passed by name into a wrapper call anywhere in the
+    module."""
+    roots: set[ast.AST] = set()
+    for nodes in defs.values():
+        for node in nodes:
+            for dec in node.decorator_list:
+                if dotted_name(dec) in _TRACE_WRAPPERS:
+                    roots.add(node)
+                elif isinstance(dec, ast.Call) and _is_trace_wrapper_call(dec):
+                    roots.add(node)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_trace_wrapper_call(node):
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Name) and arg.id in defs:
+                    roots.update(defs[arg.id])
+    return roots
+
+
+def _reachable_from(roots: set[ast.AST], defs: dict[str, list[ast.AST]]) -> set[ast.AST]:
+    """Transitive closure over module-local name references (a reference is
+    an edge — jitted code routinely passes local functions as closures)."""
+    reached: set[ast.AST] = set()
+    frontier = list(roots)
+    while frontier:
+        fn = frontier.pop()
+        if fn in reached:
+            continue
+        reached.add(fn)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) and node.id in defs:
+                for target in defs[node.id]:
+                    if target not in reached:
+                        frontier.append(target)
+    return reached
+
+
+_MODULE_ALIASES = {"np", "numpy", "jnp", "jax", "lax", "math", "functools", "pl", "pltpu"}
+
+
+def _is_static_expr(node: ast.AST) -> bool:
+    """True when the expression references no local/parameter names —
+    constants like ``np.array(~np.uint64(0))`` trace fine inside jit; only
+    expressions over runtime values force a host sync."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id not in _MODULE_ALIASES:
+            return False
+    return True
+
+
+_SHAPE_TOKENS = re.compile(r"\.shape\b|\.ndim\b|\blen\(|\.itemsize\b|\.size\b")
+
+
+def _path_endswith(mod: SourceModule, *suffixes: str) -> bool:
+    """Exemption matching on the RESOLVED absolute path, so a scan
+    invoked from inside the package (cwd-relative 'timing.py') still
+    recognizes utils/timing.py — relpath depends on the caller's cwd."""
+    p = pathlib.Path(mod.path).resolve().as_posix()
+    return p.endswith(suffixes)
+
+
+def _is_test_file(mod: SourceModule) -> bool:
+    """Library-path rules (KSL001-KSL003) skip test files: tests assert
+    exact values and fail loudly where the library would silently
+    truncate/sync, and they legitimately poke internals (building a
+    `_Descent` directly, converting freshly-narrowed arrays). Tests stay
+    in scope for KSL004 (no raw clocks), KSL005 (tier-1 membership — a
+    tests-only rule) and KSL006 (version-sensitive jax attrs)."""
+    p = pathlib.Path(mod.path).resolve()
+    return p.name.startswith("test_") or "tests" in p.parts or p.name == "conftest.py"
+
+
+# ---------------------------------------------------------------------------
+# KSL001 — host syncs reachable from jit/shard_map
+
+
+@register
+class HostSyncInJit(Rule):
+    id = "KSL001"
+    title = "host sync reachable from jit/shard_map-wrapped code"
+    rationale = (
+        "`.item()`/`int()`/`np.asarray`/`jax.device_get` on a traced value "
+        "either crashes (TracerArrayConversionError) or, on a concrete "
+        "closure value, silently forces a device->host transfer inside the "
+        "hot path. Every selection hot loop is jitted; host decode belongs "
+        "in the eager shells (ops/radix.py:_f64_exact_shell is the "
+        "pattern)."
+    )
+
+    _CAST_NAMES = {"int", "float", "bool"}
+    _SYNC_ATTRS = {"item", "tolist"}
+    _SYNC_CALLS = {"jax.device_get", "device_get", "np.asarray", "numpy.asarray"}
+
+    def check_module(self, mod: SourceModule):
+        if _is_test_file(mod):
+            return
+        defs = _function_defs(mod.tree)
+        if not defs:
+            return
+        roots = _jit_roots(mod.tree, defs)
+        if not roots:
+            return
+        seen: set[tuple[int, str]] = set()
+        for fn in _reachable_from(roots, defs):
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                msg = None
+                name = dotted_name(node.func)
+                if isinstance(node.func, ast.Attribute) and node.func.attr in self._SYNC_ATTRS:
+                    msg = f".{node.func.attr}() forces a host sync under jit"
+                elif name in self._SYNC_CALLS:
+                    if not (node.args and _is_static_expr(node.args[0])):
+                        msg = f"{name}() forces a host sync under jit"
+                elif name in self._CAST_NAMES and node.args:
+                    arg = node.args[0]
+                    if not _is_static_expr(arg) and not _SHAPE_TOKENS.search(
+                        mod.segment(arg)
+                    ):
+                        msg = (
+                            f"{name}() on a runtime value forces a host sync "
+                            "under jit (shape/ndim-derived values are exempt)"
+                        )
+                if msg is not None:
+                    key = (node.lineno, msg)
+                    if key not in seen:
+                        seen.add(key)
+                        yield node.lineno, (
+                            f"{msg}; reachable from jit/shard_map via "
+                            f"`{getattr(fn, 'name', '<fn>')}`"
+                        )
+
+
+# ---------------------------------------------------------------------------
+# KSL002 — 64-bit host data entering jnp.asarray without an x64 guard
+
+
+_X64_GUARDS = re.compile(
+    r"_require_x64|require_x64|jax_enable_x64|maybe_x64|enable_x64"
+)
+_WIDE_TOKENS = re.compile(r"\bu?int64\b|\bfloat64\b|itemsize")
+
+
+@register
+class Unguarded64BitAsarray(Rule):
+    id = "KSL002"
+    title = "64-bit host data entering jnp.asarray/jnp.array without an x64 guard"
+    rationale = (
+        "With x64 off, `jnp.asarray` silently narrows int64/uint64/float64 "
+        "host data to 32 bits — wrong answers, no error (the truncation "
+        "class reviews r1-r5 kept catching). Any function that handles "
+        "64-bit data and converts it to a device array must first consult "
+        "an x64 guard (`utils.dtypes._require_x64`, a `jax_enable_x64` "
+        "check, `utils.x64.maybe_x64`) or take a host fallback."
+    )
+
+    @staticmethod
+    def _has_explicit_dtype(call: ast.Call) -> bool:
+        """An explicit dtype (2nd positional or ``dtype=``) declares the
+        width — the gated bug class is the *implicit* narrowing."""
+        return len(call.args) >= 2 or any(
+            kw.arg == "dtype" for kw in call.keywords
+        )
+
+    def check_module(self, mod: SourceModule):
+        if _is_test_file(mod):
+            return
+        seen: set[tuple[int, int]] = set()  # a call in a nested def is
+        # visited once per enclosing function — report it once
+        for fn in (n for n in ast.walk(mod.tree)
+                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))):
+            src = mod.segment(fn)
+            if not _WIDE_TOKENS.search(src) or _X64_GUARDS.search(src):
+                continue
+            for node in ast.walk(fn):
+                if (
+                    isinstance(node, ast.Call)
+                    and dotted_name(node.func) in ("jnp.asarray", "jnp.array")
+                    and node.args
+                    and not self._has_explicit_dtype(node)
+                    and not _is_static_expr(node.args[0])
+                    and (node.lineno, node.col_offset) not in seen
+                ):
+                    seen.add((node.lineno, node.col_offset))
+                    yield node.lineno, (
+                        f"`{dotted_name(node.func)}` in `{fn.name}`, which "
+                        "handles 64-bit data but has no x64 guard or host "
+                        "fallback — with x64 off this silently truncates to "
+                        "32 bits"
+                    )
+
+
+# ---------------------------------------------------------------------------
+# KSL003 — _Descent construction bypassing the f64-on-TPU warning
+
+
+@register
+class DescentWithoutF64Warning(Rule):
+    id = "KSL003"
+    title = "_Descent built outside the f64-on-TPU warning/exact-route shells"
+    rationale = (
+        "float64 selection on TPU through device keys is the documented "
+        "~49-bit approximation (utils/dtypes.py:f64_raw_bits). Every path "
+        "that builds a `_Descent` must either run under `_f64_exact_shell` "
+        "(exact host keys when possible) or call `_warn_f64_tpu_approx` "
+        "itself — ADVICE r5 #1: a silent approximation is the one thing a "
+        "selection library must never do."
+    )
+
+    _SHELLS = ("_warn_f64_tpu_approx", "_f64_exact_shell")
+
+    def check_module(self, mod: SourceModule):
+        if _is_test_file(mod):
+            return
+        defs = _function_defs(mod.tree)
+        # functions that call a shell themselves
+        shelled: set[str] = set()
+        for name, nodes in defs.items():
+            for fn in nodes:
+                if any(
+                    isinstance(n, ast.Name) and n.id in self._SHELLS
+                    for n in ast.walk(fn)
+                ):
+                    shelled.add(name)
+        # functions referenced by name inside a shelled function are covered
+        covered: set[str] = set(shelled)
+        for name in shelled:
+            for fn in defs[name]:
+                for n in ast.walk(fn):
+                    if isinstance(n, ast.Name) and n.id in defs:
+                        covered.add(n.id)
+        for name, nodes in defs.items():
+            for fn in nodes:
+                if name in covered:
+                    continue
+                for node in ast.walk(fn):
+                    if (
+                        isinstance(node, ast.Call)
+                        and dotted_name(node.func).split(".")[-1] == "_Descent"
+                    ):
+                        yield node.lineno, (
+                            f"`_Descent` built in `{name}`, which neither "
+                            "calls `_warn_f64_tpu_approx` nor runs under "
+                            "`_f64_exact_shell` — f64-on-TPU would approximate "
+                            "silently"
+                        )
+
+
+# ---------------------------------------------------------------------------
+# KSL004 — raw clocks outside the timing helpers
+
+
+@register
+class RawClockOutsideTiming(Rule):
+    id = "KSL004"
+    title = "raw time.time/perf_counter outside utils/timing + utils/profiling"
+    rationale = (
+        "Raw clock pairs around jax calls measure dispatch, not compute "
+        "(async dispatch returns before the device finishes). "
+        "utils/timing.time_fn blocks on the result tree; "
+        "utils/profiling.PhaseTimer owns phase wall-clock. Bench code with "
+        "a methodological reason to read clocks inline (the differential "
+        "perturb-chain) carries a written noqa."
+    )
+
+    _CLOCKS = {
+        "time.time",
+        "time.perf_counter",
+        "time.monotonic",
+        "time.process_time",
+        "timeit.default_timer",
+    }
+    _ALLOWED = ("utils/timing.py", "utils/profiling.py")
+
+    def check_module(self, mod: SourceModule):
+        if _path_endswith(mod, *self._ALLOWED):
+            return
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) and dotted_name(node.func) in self._CLOCKS:
+                yield node.lineno, (
+                    f"`{dotted_name(node.func)}()` — use utils/timing.time_fn "
+                    "(device-sync semantics) or utils/profiling.PhaseTimer"
+                )
+
+
+# ---------------------------------------------------------------------------
+# KSL005 — tier-1 membership audit (generalized tests/test_marker_audit.py)
+
+
+@register
+class Tier1Membership(Rule):
+    id = "KSL005"
+    title = "test file neither tier-1-collected nor explicitly slow-marked"
+    rationale = (
+        "The tier-1 gate runs `pytest -m 'not slow'`. A test file whose "
+        "tests all carry an implicit skip (bad collection, module-level "
+        "gating, a forgotten pytestmark) silently falls out of that gate. "
+        "Every tests/test_*.py must contribute at least one collected test "
+        "or contain an explicit pytest.mark.slow opt-out."
+    )
+
+    def collect_offenders(self, tests_dir: pathlib.Path) -> list[pathlib.Path]:
+        """Offending test files under ``tests_dir`` — the single
+        implementation behind both this rule and the historical
+        tests/test_marker_audit.py (now a thin wrapper)."""
+        out = subprocess.run(
+            [
+                sys.executable, "-m", "pytest", "--collect-only", "-q",
+                "-m", "not slow", "--continue-on-collection-errors",
+                "-p", "no:cacheprovider", str(tests_dir),
+            ],
+            capture_output=True,
+            text=True,
+            cwd=tests_dir.parent,
+        )
+        collected = {
+            pathlib.Path(line.split("::")[0]).name
+            for line in out.stdout.splitlines()
+            if "::" in line
+        }
+        if not collected:
+            raise RuntimeError(
+                f"tier-1 collection produced nothing:\n{out.stdout}\n{out.stderr}"
+            )
+        return [
+            f
+            for f in sorted(tests_dir.glob("test_*.py"))
+            if f.name not in collected
+            and not re.search(r"pytest\.mark\.slow\b", f.read_text())
+        ]
+
+    def check_tree(self, mods):
+        by_dir: dict[pathlib.Path, list[SourceModule]] = {}
+        for mod in mods:
+            p = pathlib.Path(mod.path)
+            if p.name.startswith("test_") and p.parent.name == "tests":
+                by_dir.setdefault(p.parent.resolve(), []).append(mod)
+        for tests_dir, dir_mods in sorted(by_dir.items()):
+            mod_by_name = {pathlib.Path(m.path).name: m for m in dir_mods}
+            for offender in self.collect_offenders(tests_dir):
+                mod = mod_by_name.get(offender.name)
+                if mod is None:
+                    continue  # offender outside the scanned set
+                yield mod, 1, (
+                    f"{offender.name} contributes no test to the tier-1 "
+                    "selection (-m 'not slow') and has no pytest.mark.slow "
+                    "opt-out — it silently fell out of the gate"
+                )
+
+
+# ---------------------------------------------------------------------------
+# KSL006 — version-sensitive jax attributes outside utils/compat.py
+
+
+@register
+class DirectVersionSensitiveJaxAttr(Rule):
+    id = "KSL006"
+    title = "version-sensitive jax attribute accessed outside utils/compat.py"
+    rationale = (
+        "`jax.shard_map`, `jax.typeof`, `jax.enable_x64` and "
+        "`jax.lax.pcast`/`pvary` moved (or did not exist) across the jax "
+        "releases this package supports; direct access is an "
+        "AttributeError on the 0.4.x line — the seed's entire 137-test "
+        "failure set. utils/compat.py resolves every one of them exactly "
+        "once; route through it."
+    )
+
+    _FORBIDDEN_ATTRS = {
+        "jax.shard_map",
+        "jax.experimental.shard_map",
+        "jax.typeof",
+        "jax.enable_x64",
+        "jax.disable_x64",
+        "jax.lax.pcast",
+        "jax.lax.pvary",
+    }
+    _FORBIDDEN_IMPORTS = {
+        ("jax.experimental.shard_map", None),  # any name from that module
+        ("jax.experimental", "shard_map"),
+        ("jax.experimental", "enable_x64"),
+        ("jax.experimental", "disable_x64"),
+    }
+
+    def check_module(self, mod: SourceModule):
+        if _path_endswith(mod, "utils/compat.py"):
+            return
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Attribute):
+                name = dotted_name(node)
+                if name in self._FORBIDDEN_ATTRS:
+                    yield node.lineno, (
+                        f"direct `{name}` — moved across jax versions; use "
+                        "the utils/compat.py shim"
+                    )
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for alias in node.names:
+                    if (node.module, alias.name) in self._FORBIDDEN_IMPORTS or (
+                        node.module,
+                        None,
+                    ) in self._FORBIDDEN_IMPORTS:
+                        yield node.lineno, (
+                            f"direct `from {node.module} import {alias.name}` "
+                            "— moved across jax versions; use the "
+                            "utils/compat.py shim"
+                        )

@@ -1,0 +1,266 @@
+"""Rule framework: findings, suppressions, the registry, and the driver.
+
+The engine is deliberately dependency-free (stdlib ``ast`` only) so the
+lint gate can run in any environment that can import the package — the
+jaxpr contract engine (jaxpr_checks.py) is the only part that needs jax,
+and it is skipped with ``contracts=False``.
+
+Suppression syntax (mirrors flake8's ``# noqa`` but namespaced so the two
+tools never fight over a comment):
+
+- ``# ksel: noqa[KSL001]`` — suppress that rule on this line; everything
+  after ``--`` is the recorded justification::
+
+      t0 = time.perf_counter()  # ksel: noqa[KSL004] -- differential chain
+
+- ``# ksel: noqa[KSL001,KSL004] -- reason`` — several rules, one line.
+- ``# ksel: noqa-file[KSL005] -- reason`` — suppress for the whole file
+  (for rules whose findings do not attach to a meaningful line).
+
+A suppressed finding still appears in the JSON report (``suppressed:
+true`` with its justification) so the gate's artifact doubles as the
+ledger of accepted exceptions.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import pathlib
+import re
+
+_NOQA_RE = re.compile(
+    r"#\s*ksel:\s*noqa(?P<scope>-file)?\[(?P<rules>[A-Z0-9_,\s]+)\]"
+    r"(?:\s*--\s*(?P<why>.*?))?\s*$"
+)
+
+
+@dataclasses.dataclass
+class Finding:
+    """One rule violation at one source location."""
+
+    rule: str
+    path: str
+    line: int
+    message: str
+    suppressed: bool = False
+    justification: str = ""
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        tag = " (suppressed)" if self.suppressed else ""
+        return f"{self.path}:{self.line}: {self.rule}{tag} {self.message}"
+
+
+class SourceModule:
+    """A parsed source file plus its suppression table."""
+
+    def __init__(self, path: str, relpath: str, text: str):
+        self.path = path
+        self.relpath = relpath
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=path)
+        # line -> {rule -> justification}; rule "" means all rules
+        self.line_noqa: dict[int, dict[str, str]] = {}
+        self.file_noqa: dict[str, str] = {}
+        for lineno, line in enumerate(self.lines, start=1):
+            m = _NOQA_RE.search(line)
+            if not m:
+                continue
+            rules = [r.strip() for r in m.group("rules").split(",") if r.strip()]
+            why = (m.group("why") or "").strip()
+            if m.group("scope"):
+                for r in rules:
+                    self.file_noqa[r] = why
+            else:
+                table = self.line_noqa.setdefault(lineno, {})
+                for r in rules:
+                    table[r] = why
+
+    def suppression(self, rule: str, line: int) -> str | None:
+        """Justification string when ``rule`` is suppressed at ``line``
+        (empty string = suppressed without justification), else None."""
+        table = self.line_noqa.get(line)
+        if table is not None and rule in table:
+            return table[rule]
+        if rule in self.file_noqa:
+            return self.file_noqa[rule]
+        return None
+
+    def segment(self, node: ast.AST) -> str:
+        """Source text of ``node`` (used for token-level heuristics)."""
+        return ast.get_source_segment(self.text, node) or ""
+
+
+class Rule:
+    """Base AST rule. Subclasses set the class attributes and implement
+    either :meth:`check_module` (per-file) or :meth:`check_tree`
+    (whole-scan rules like the tier-1 membership audit)."""
+
+    id: str = ""
+    title: str = ""
+    rationale: str = ""
+
+    def check_module(self, mod: SourceModule):
+        """Yield ``(line, message)`` violations for one file."""
+        return ()
+
+    def check_tree(self, mods: list[SourceModule]):
+        """Yield ``(mod, line, message)`` violations for the whole scan."""
+        return ()
+
+
+_REGISTRY: dict[str, Rule] = {}
+
+
+def register(cls):
+    """Class decorator adding a rule to the global registry."""
+    inst = cls()
+    if not inst.id:
+        raise ValueError(f"rule {cls.__name__} has no id")
+    if inst.id in _REGISTRY:
+        raise ValueError(f"duplicate rule id {inst.id}")
+    _REGISTRY[inst.id] = inst
+    return cls
+
+
+def all_rules() -> dict[str, Rule]:
+    return dict(_REGISTRY)
+
+
+@dataclasses.dataclass
+class Report:
+    findings: list[Finding]
+    files: list[str]
+    checks_run: list[str]
+
+    @property
+    def unsuppressed(self) -> list[Finding]:
+        return [f for f in self.findings if not f.suppressed]
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if self.unsuppressed else 0
+
+
+_SKIP_DIRS = {
+    "__pycache__", "build", "dist", "node_modules", "venv",
+    "site-packages",
+}
+
+
+def _skip_part(part: str) -> bool:
+    """Directory components the scan never descends into: caches, build
+    output, virtualenvs (``kselect-lint .`` must not lint site-packages),
+    and every dot-directory (.git, .venv, .tox, .claude, ...)."""
+    return (
+        part in _SKIP_DIRS
+        or part.endswith(".egg-info")
+        or (part.startswith(".") and part not in (".", ".."))
+    )
+
+
+def iter_python_files(paths) -> list[pathlib.Path]:
+    out: list[pathlib.Path] = []
+    for p in paths:
+        p = pathlib.Path(p)
+        if p.is_file() and p.suffix == ".py":
+            out.append(p)
+        elif p.is_dir():
+            for f in sorted(p.rglob("*.py")):
+                # judge only the components BELOW the given root, so a
+                # scan rooted inside a dot-directory still works
+                if not any(_skip_part(part) for part in f.relative_to(p).parts):
+                    out.append(f)
+    # dedupe, stable order
+    seen, uniq = set(), []
+    for f in out:
+        r = f.resolve()
+        if r not in seen:
+            seen.add(r)
+            uniq.append(f)
+    return uniq
+
+
+def load_module(path, root=None) -> SourceModule:
+    path = pathlib.Path(path)
+    try:
+        rel = str(path.resolve().relative_to(pathlib.Path(root or ".").resolve()))
+    except ValueError:
+        rel = str(path)
+    return SourceModule(str(path), rel, path.read_text())
+
+
+def _selected(rule_id: str, select, ignore) -> bool:
+    if select is not None and not any(rule_id.startswith(s) for s in select):
+        return False
+    if ignore is not None and any(rule_id.startswith(s) for s in ignore):
+        return False
+    return True
+
+
+def run_analysis(
+    paths,
+    *,
+    select=None,
+    ignore=None,
+    contracts: bool = True,
+    root=None,
+) -> Report:
+    """Run every selected rule (and, with ``contracts=True``, every jaxpr
+    contract check) over ``paths``. Returns a :class:`Report`; the gate
+    semantics are ``report.exit_code`` (1 iff any unsuppressed finding)."""
+    files = iter_python_files(paths)
+    findings: list[Finding] = []
+    mods: list[SourceModule] = []
+    checks_run: list[str] = []
+    for f in files:
+        try:
+            mods.append(load_module(f, root=root))
+        except SyntaxError as e:
+            # KSL000 honors --select/--ignore like any rule: an unparseable
+            # vendored file is excludable (`--ignore KSL000`) without
+            # dropping it from the scan paths — a noqa cannot apply since
+            # the suppression table needs a parse
+            if _selected("KSL000", select, ignore):
+                findings.append(
+                    Finding("KSL000", str(f), e.lineno or 1, f"syntax error: {e.msg}")
+                )
+
+    def emit(rule_id: str, mod: SourceModule, line: int, message: str):
+        why = mod.suppression(rule_id, line)
+        findings.append(
+            Finding(
+                rule_id,
+                mod.relpath,
+                line,
+                message,
+                suppressed=why is not None,
+                justification=why or "",
+            )
+        )
+
+    for rule_id, rule in sorted(_REGISTRY.items()):
+        if not _selected(rule_id, select, ignore):
+            continue
+        checks_run.append(rule_id)
+        for mod in mods:
+            for line, message in rule.check_module(mod):
+                emit(rule_id, mod, line, message)
+        for mod, line, message in rule.check_tree(mods):
+            emit(rule_id, mod, line, message)
+
+    if contracts:
+        from mpi_k_selection_tpu.analysis.jaxpr_checks import CONTRACT_CHECKS
+
+        for check in CONTRACT_CHECKS:
+            if not _selected(check.id, select, ignore):
+                continue
+            checks_run.append(check.id)
+            findings.extend(check.run())
+
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return Report(findings, [str(f) for f in files], checks_run)

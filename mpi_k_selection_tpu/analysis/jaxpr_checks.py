@@ -1,0 +1,303 @@
+"""jaxpr-level contract checks (KSC101-KSC103).
+
+The AST rules see syntax; these see the traced program. Each check
+abstractly traces public kernels from ``ops/`` and ``parallel/`` over a
+shape/dtype grid — ``jax.eval_shape``/``jax.make_jaxpr`` only, so nothing
+runs on a device and a 2^31-element contract costs no memory — and
+asserts a property every review round has had to re-derive by hand:
+
+- **KSC101 dtype preservation**: a selection returns its input dtype.
+  The silent-demotion twin of the KSL002 truncation class, caught at the
+  traced boundary instead of the host boundary.
+- **KSC102 counter-width discipline**: histogram accumulators are int32
+  only below the documented 2^31-population bound, int64 (x64) beyond,
+  and `select_count_dtype` refuses the un-representable case loudly.
+- **KSC103 jaxpr stability across batch sizes**: the same kernel traced
+  at nearby n produces the identical primitive sequence — a divergence
+  means some Python-level branch depends on n in a way that recompiles
+  per shape (the recompile-hazard class: jit caches are per-jaxpr).
+
+Checks report :class:`~mpi_k_selection_tpu.analysis.core.Finding`s
+against the module that owns the kernel; they have no line-level noqa
+(deselect with ``--ignore KSC103`` and a written justification in the
+caller instead).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+from mpi_k_selection_tpu.analysis.core import Finding
+
+CONTRACT_CHECKS: list["ContractCheck"] = []
+
+
+@dataclasses.dataclass
+class ContractCheck:
+    id: str
+    title: str
+    rationale: str
+    fn: Callable[[], list[Finding]]
+
+    def run(self) -> list[Finding]:
+        try:
+            return self.fn()
+        except Exception as e:  # a crash is a finding, not a pass
+            return [
+                Finding(
+                    self.id,
+                    "<contract-engine>",
+                    0,
+                    f"contract check crashed: {type(e).__name__}: {e}",
+                )
+            ]
+
+
+def contract(id: str, title: str, rationale: str):
+    def deco(fn):
+        CONTRACT_CHECKS.append(ContractCheck(id, title, rationale, fn))
+        return fn
+
+    return deco
+
+
+def _spec(n, dtype):
+    import jax
+
+    return jax.ShapeDtypeStruct((n,), dtype)
+
+
+def _primitive_trail(jaxpr) -> list[str]:
+    """Flattened primitive-name sequence of a (closed) jaxpr, recursing
+    into call/pjit/cond/scan sub-jaxprs — the shape-free program
+    fingerprint KSC103 compares across batch sizes."""
+    trail: list[str] = []
+
+    def walk(jx):
+        for eqn in jx.eqns:
+            trail.append(eqn.primitive.name)
+            for v in eqn.params.values():
+                vals = v if isinstance(v, (list, tuple)) else [v]
+                for item in vals:
+                    inner = getattr(item, "jaxpr", None)
+                    if inner is not None:
+                        walk(inner)
+                    elif hasattr(item, "eqns"):
+                        walk(item)
+
+    walk(jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr)
+    return trail
+
+
+# the dtype grid: every key width class the transform table supports
+# without an x64 mode flip, plus the 64-bit pair under compat.enable_x64
+_GRID_32 = ("int32", "uint32", "float32", "int16", "bfloat16")
+_GRID_64 = ("int64", "float64")
+
+
+@contract(
+    "KSC101",
+    "public selections preserve their input dtype",
+    "a demoted output dtype means some intermediate silently narrowed the "
+    "values — the traced twin of the KSL002 truncation class",
+)
+def check_dtype_preservation() -> list[Finding]:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mpi_k_selection_tpu.ops.radix import radix_select, radix_select_many
+    from mpi_k_selection_tpu.ops.sort import sort_select
+    from mpi_k_selection_tpu.ops.topk import topk
+    from mpi_k_selection_tpu.utils import compat
+
+    findings: list[Finding] = []
+
+    def expect(path, fn, x, want, label):
+        out = jax.eval_shape(fn, x)
+        got = np.dtype(jnp.result_type(out)) if not hasattr(out, "dtype") else np.dtype(out.dtype)
+        if got != np.dtype(want):
+            findings.append(
+                Finding(
+                    "KSC101",
+                    path,
+                    0,
+                    f"{label}: input {np.dtype(want)} -> output {got} "
+                    "(silent dtype demotion)",
+                )
+            )
+
+    def sweep(dtypes):
+        for dt in dtypes:
+            expect(
+                "mpi_k_selection_tpu/ops/radix.py",
+                lambda x: radix_select(x, 37), _spec(1 << 16, dt), dt,
+                f"radix_select[{dt}, n=2^16]",
+            )
+            expect(
+                "mpi_k_selection_tpu/ops/radix.py",
+                lambda x: radix_select_many(x, jnp.asarray([1, 5, 9])),
+                _spec(1 << 16, dt), dt,
+                f"radix_select_many[{dt}, n=2^16]",
+            )
+            expect(
+                "mpi_k_selection_tpu/ops/sort.py",
+                lambda x: sort_select(x, 5), _spec(1 << 10, dt), dt,
+                f"sort_select[{dt}, n=2^10]",
+            )
+            expect(
+                "mpi_k_selection_tpu/ops/topk.py",
+                lambda x: topk(x, 8)[0], _spec(1 << 14, dt), dt,
+                f"topk[{dt}, n=2^14] values",
+            )
+
+    sweep(_GRID_32)
+    with compat.enable_x64(True):
+        sweep(_GRID_64)
+    return findings
+
+
+@contract(
+    "KSC102",
+    "histogram counter width matches the documented population bound",
+    "int32 counts are exact only below 2^31 elements; beyond that the "
+    "accumulator must be int64 and the un-representable case must raise "
+    "instead of wrapping (SURVEY.md §7 int-overflow hygiene)",
+)
+def check_counter_width() -> list[Finding]:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mpi_k_selection_tpu.ops.histogram import masked_radix_histogram
+    from mpi_k_selection_tpu.ops.radix import select_count_dtype
+    from mpi_k_selection_tpu.utils import compat
+
+    path = "mpi_k_selection_tpu/ops/radix.py"
+    findings: list[Finding] = []
+
+    # documented-bound int32: the dtype must actually cover the population
+    for n in (1 << 10, 1 << 20, (1 << 31) - 1):
+        cdt = select_count_dtype(n)
+        if np.iinfo(np.dtype(cdt)).max < n:
+            findings.append(
+                Finding("KSC102", path, 0,
+                        f"select_count_dtype({n}) = {np.dtype(cdt)} cannot "
+                        f"represent the population")
+            )
+
+    # the un-representable case must raise, not wrap
+    if not jax.config.jax_enable_x64:
+        try:
+            select_count_dtype(1 << 31)
+            findings.append(
+                Finding("KSC102", path, 0,
+                        "select_count_dtype(2^31) without x64 must raise "
+                        "(int32 would wrap; int64 would silently truncate)")
+            )
+        except ValueError:
+            pass
+
+    # the traced accumulator honors the requested width (no demotion)
+    hpath = "mpi_k_selection_tpu/ops/histogram.py"
+    out = jax.eval_shape(
+        lambda u: masked_radix_histogram(
+            u, shift=24, radix_bits=8, method="scatter", count_dtype=jnp.int32
+        ),
+        _spec(1 << 16, "uint32"),
+    )
+    if np.dtype(out.dtype) != np.dtype(np.int32):
+        findings.append(
+            Finding("KSC102", hpath, 0,
+                    f"int32 histogram accumulator traced as {out.dtype}")
+        )
+    with compat.enable_x64(True):
+        cdt = select_count_dtype(1 << 31)
+        if np.dtype(cdt) != np.dtype(np.int64):
+            findings.append(
+                Finding("KSC102", path, 0,
+                        f"select_count_dtype(2^31) under x64 = {np.dtype(cdt)}, "
+                        "want int64")
+            )
+        out = jax.eval_shape(
+            lambda u: masked_radix_histogram(
+                u, shift=24, radix_bits=8, method="scatter", count_dtype=jnp.int64
+            ),
+            _spec(1 << 16, "uint32"),
+        )
+        if np.dtype(out.dtype) != np.dtype(np.int64):
+            findings.append(
+                Finding("KSC102", hpath, 0,
+                        f"int64 histogram accumulator traced as {out.dtype} "
+                        "under x64 (silent counter demotion)")
+            )
+    return findings
+
+
+@contract(
+    "KSC103",
+    "selection jaxpr is stable across nearby batch sizes",
+    "two nearby n tracing to different primitive sequences means a "
+    "Python-level branch keys on n — every distinct jaxpr is a fresh XLA "
+    "compile, and a size-dependent program is a latent recompile storm in "
+    "serving loops that see ragged batches",
+)
+def check_jaxpr_stability() -> list[Finding]:
+    import jax
+    import jax.numpy as jnp
+
+    from mpi_k_selection_tpu.ops.histogram import masked_radix_histogram
+    from mpi_k_selection_tpu.ops.radix import radix_select
+    from mpi_k_selection_tpu.ops.topk import topk
+
+    findings: list[Finding] = []
+    cases = [
+        (
+            "mpi_k_selection_tpu/ops/radix.py",
+            "radix_select[int32]",
+            lambda x: radix_select(x, 1234),
+            "int32",
+            (1 << 20, (1 << 20) + (1 << 13)),
+        ),
+        (
+            "mpi_k_selection_tpu/ops/topk.py",
+            "topk[float32, k=8]",
+            lambda x: topk(x, 8)[0],
+            "float32",
+            (1 << 16, (1 << 16) + (1 << 10)),
+        ),
+        (
+            "mpi_k_selection_tpu/ops/histogram.py",
+            "masked_radix_histogram[uint32]",
+            lambda u: masked_radix_histogram(
+                u, shift=24, radix_bits=8, method="scatter",
+                count_dtype=jnp.int32,
+            ),
+            "uint32",
+            (1 << 16, (1 << 16) + (1 << 10)),
+        ),
+    ]
+    for path, label, fn, dt, (n1, n2) in cases:
+        t1 = _primitive_trail(jax.make_jaxpr(fn)(_spec(n1, dt)))
+        t2 = _primitive_trail(jax.make_jaxpr(fn)(_spec(n2, dt)))
+        if t1 != t2:
+            # locate the first divergence for the report
+            i = next(
+                (j for j, (a, b) in enumerate(zip(t1, t2)) if a != b),
+                min(len(t1), len(t2)),
+            )
+            a = t1[i] if i < len(t1) else "<end>"
+            b = t2[i] if i < len(t2) else "<end>"
+            findings.append(
+                Finding(
+                    "KSC103",
+                    path,
+                    0,
+                    f"{label}: primitive trail diverges between n={n1} "
+                    f"({len(t1)} eqns) and n={n2} ({len(t2)} eqns) at "
+                    f"position {i} ({a} vs {b}) — n-dependent program "
+                    "structure recompiles per batch size",
+                )
+            )
+    return findings

@@ -1,0 +1,48 @@
+"""Report rendering: human text and machine JSON, sharing one Report.
+
+The JSON form is the gate's debugging artifact (tests/test_analysis.py
+writes it to /tmp/kselect_lint.json on every tier-1 run) and doubles as
+the suppression ledger: suppressed findings stay in the report with their
+written justification.
+"""
+
+from __future__ import annotations
+
+import json
+
+from mpi_k_selection_tpu.analysis.core import Report
+
+
+def render_text(report: Report, *, verbose: bool = False) -> str:
+    lines: list[str] = []
+    shown = report.findings if verbose else report.unsuppressed
+    for f in shown:
+        lines.append(f.render())
+    nsup = len(report.findings) - len(report.unsuppressed)
+    summary = (
+        f"{len(report.unsuppressed)} finding(s) "
+        f"({nsup} suppressed) in {len(report.files)} file(s); "
+        f"checks: {', '.join(report.checks_run)}"
+    )
+    lines.append(summary)
+    return "\n".join(lines)
+
+
+def render_json(report: Report) -> str:
+    from mpi_k_selection_tpu.analysis.core import all_rules
+
+    return json.dumps(
+        {
+            "findings": [f.to_dict() for f in report.findings],
+            "unsuppressed": len(report.unsuppressed),
+            "suppressed": len(report.findings) - len(report.unsuppressed),
+            "files_scanned": report.files,
+            "checks_run": report.checks_run,
+            "rules": {
+                rid: {"title": r.title, "rationale": r.rationale}
+                for rid, r in sorted(all_rules().items())
+            },
+            "exit_code": report.exit_code,
+        },
+        indent=2,
+    )

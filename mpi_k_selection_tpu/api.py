@@ -47,11 +47,27 @@ def as_selection_array(x):
     truncated; selection is then exact w.r.t. its actual contents)."""
     import jax
 
+    from mpi_k_selection_tpu.utils.dtypes import _require_x64
+
     if isinstance(x, (jax.Array, jax.core.Tracer)):
         return x
+    # plain Python lists/scalars widen to int64/float64 under np.asarray;
+    # that widening is NumPy's default, not a caller-declared width, so it
+    # keeps the historical weak-typed conversion below
+    was_typed = hasattr(x, "dtype")
     x = np.asarray(x)
     if x.dtype == np.float64 and jax.default_backend() == "tpu":
         return x
+    # CALLER-TYPED 64-bit INTEGER host data must not cross jnp.asarray
+    # with x64 off: the conversion silently truncates the bit patterns and
+    # the selection answers wrong with no error (kselect over host int64
+    # returned the truncated array's k-th element — the KSL002 truncation
+    # class, caught by the analyzer's first run). float64 keeps the
+    # documented downcast (value ROUNDING, not bit corruption — the
+    # docstring's "exact w.r.t. its actual contents" contract), so the
+    # default NumPy float dtype keeps working with default jax config.
+    if was_typed and x.dtype.kind in "iu":
+        _require_x64(x.dtype)
     return jnp.asarray(x)
 
 
@@ -162,12 +178,14 @@ def kselect_many(x, ks, **kwargs):
                 out = s_np[np.clip(ks_np - 1, 0, x.size - 1)].reshape(ks_np.shape)
             return restore_k_shape(out, ks)
         warn_kwargs_ignored()
-        ks_arr = jnp.atleast_1d(jnp.asarray(ks))
+        # rank dtype sized to n IN the conversion: an implicit int32
+        # asarray would silently wrap int64 ranks for n >= 2^31 (this path
+        # is reachable at any n via K >= 192, the dispatch clamp's
+        # ceiling), and select_count_dtype raises loudly when that width
+        # needs x64
+        ks_arr = jnp.atleast_1d(jnp.asarray(ks, select_count_dtype(x.size)))
         s = jnp.sort(x.ravel())
-        # rank dtype sized to n: an int32 cast would silently wrap int64
-        # ranks for n >= 2^31 (this path is reachable at any n via K >= 192,
-        # the dispatch clamp's ceiling)
-        idx = jnp.clip(ks_arr.astype(select_count_dtype(x.size)) - 1, 0, x.size - 1)
+        idx = jnp.clip(ks_arr - 1, 0, x.size - 1)
         out = s[idx.ravel()].reshape(ks_arr.shape)
     else:
         out = radix_select_many(x, ks, **kwargs)
@@ -211,7 +229,11 @@ def quantiles(x, qs, **kwargs):
     """Exact order statistics at quantiles ``qs`` (nearest-rank — every
     returned value is an actual array element, the same guarantee the
     reference's selection gives)."""
-    x = jnp.asarray(x)
+    # as_selection_array, not jnp.asarray: a bare conversion would both
+    # truncate 64-bit host data with x64 off AND commit host float64 to
+    # the TPU (losing the exact host-key route) before kselect_many could
+    # route around it
+    x = as_selection_array(x)
     if x.size == 0:
         raise ValueError("quantiles requires a non-empty input")
     return kselect_many(x, quantile_ks(qs, x.size), **kwargs)
@@ -221,7 +243,7 @@ def median(x, **kwargs):
     """Lower median: k = max(1, n//2), matching the reference's median
     operating point ``k = N/2`` (``kth-problem-seq.c~:24``,
     ``TODO-kth-problem-cgm.c~:48``)."""
-    x = jnp.asarray(x)
+    x = as_selection_array(x)  # see quantiles: truncation + f64 routing
     return kselect(x, max(1, x.size // 2), **kwargs)
 
 
@@ -302,6 +324,13 @@ def batched_kselect(x, k):
     efficient TPU shape (batch parallelism), and unlike the 1-D case the
     per-row histogram trick has no batch advantage to exploit.
     """
+    from mpi_k_selection_tpu.utils.dtypes import _require_x64
+
+    if hasattr(x, "dtype") and np.dtype(x.dtype).kind in "iu":
+        # caller-typed host int64 would silently bit-truncate below;
+        # weak-typed Python lists and float64 (value rounding, see
+        # as_selection_array) keep the historical conversion
+        _require_x64(x.dtype)
     x = jnp.asarray(x)
     if x.ndim < 2:
         raise ValueError("batched_kselect wants a (..., d) batch; use kselect for 1-D")
@@ -316,5 +345,5 @@ def batched_kselect(x, k):
 
 def batched_median(x):
     """Per-row lower median along the last axis."""
-    x = jnp.asarray(x)
-    return batched_kselect(x, max(1, x.shape[-1] // 2))
+    d = np.shape(x)[-1] if np.shape(x) else 0  # no dtype-changing conversion
+    return batched_kselect(x, max(1, d // 2))

@@ -41,6 +41,8 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from mpi_k_selection_tpu.utils import compat
 from jax.experimental import pallas as pl
 
 try:  # pltpu is importable on CPU builds too; guard for safety
@@ -154,8 +156,8 @@ def _match_vma(x, vma):
     refs are derived from psummed (invariant) walk state, while the tiles
     are device-varying under shard_map; pallas_call wants them to agree.
     No-op outside shard_map (both sides empty)."""
-    missing = tuple(sorted(vma - jax.typeof(x).vma))
-    return jax.lax.pcast(x, missing, to="varying") if missing else x
+    missing = tuple(sorted(vma - compat.vma_of(x)))
+    return compat.pvary(x, missing) if missing else x
 
 
 from mpi_k_selection_tpu.ops.histogram import check_block_rows as _check_block_rows  # noqa: E402  (shared geometry contract; no cycle — ops.histogram imports pallas lazily)
@@ -457,11 +459,11 @@ def pallas_radix_histogram(
     )
     # under shard_map the tiles are device-varying; the out_shape must carry
     # the same varying-manual-axes type for check_vma (empty set otherwise)
-    vma = jax.typeof(k2d).vma
+    vma = compat.vma_of(k2d)
     zref = _match_vma(zref, vma)
     # trace the kernel with x64 off: the kernel is int32-only, and Mosaic
     # fails to legalize programs traced in x64 mode (int64 grid indices)
-    with jax.enable_x64(False):
+    with compat.enable_x64(False):
         lane_hist = pl.pallas_call(
             kernel,
             grid=(grid,),
@@ -472,7 +474,7 @@ def pallas_radix_histogram(
                 ),
             ],
             out_specs=pl.BlockSpec((nb, LANES), lambda i: (0, 0), memory_space=pltpu.VMEM),
-            out_shape=jax.ShapeDtypeStruct((nb, LANES), jnp.int32, vma=vma),
+            out_shape=compat.shape_dtype_struct((nb, LANES), jnp.int32, vma=vma),
             interpret=interpret,
         )(zref, k2d)
     hist = jnp.sum(lane_hist, axis=1, dtype=count_dtype)
@@ -645,11 +647,11 @@ def pallas_radix_histogram64(
     kernel = functools.partial(
         kern64, shift=shift, radix_bits=radix_bits, key_op=key_op
     )
-    vma = jax.typeof(hi2).vma  # see 32-bit variant
+    vma = compat.vma_of(hi2)  # see 32-bit variant
     phi = _match_vma(phi, vma)
     zlo = _match_vma(zlo, vma)
     # x64 off while tracing: the kernel is int32-only (see 32-bit variant)
-    with jax.enable_x64(False):
+    with compat.enable_x64(False):
         lane_hist = pl.pallas_call(
             kernel,
             grid=(grid,),
@@ -664,7 +666,7 @@ def pallas_radix_histogram64(
                 ),
             ],
             out_specs=pl.BlockSpec((nb, LANES), lambda i: (0, 0), memory_space=pltpu.VMEM),
-            out_shape=jax.ShapeDtypeStruct((nb, LANES), jnp.int32, vma=vma),
+            out_shape=compat.shape_dtype_struct((nb, LANES), jnp.int32, vma=vma),
             interpret=interpret,
         )(phi, zlo, hi2, lo2)
     hist = jnp.sum(lane_hist, axis=1, dtype=count_dtype)
@@ -817,9 +819,9 @@ def pallas_radix_histogram_multi(
         _hist_kernel_multi_packed,
         shift=shift, radix_bits=radix_bits, key_op=key_op, nq=nq,
     )
-    vma = jax.typeof(k2d).vma  # see pallas_radix_histogram
+    vma = compat.vma_of(k2d)  # see pallas_radix_histogram
     zrefs = _match_vma(zrefs, vma)
-    with jax.enable_x64(False):
+    with compat.enable_x64(False):
         lane_hist = pl.pallas_call(
             kernel,
             grid=(grid,),
@@ -832,7 +834,7 @@ def pallas_radix_histogram_multi(
             out_specs=pl.BlockSpec(
                 (nq * nb, LANES), lambda i: (0, 0), memory_space=pltpu.VMEM
             ),
-            out_shape=jax.ShapeDtypeStruct((nq * nb, LANES), jnp.int32, vma=vma),
+            out_shape=compat.shape_dtype_struct((nq * nb, LANES), jnp.int32, vma=vma),
             interpret=interpret,
         )(zrefs, k2d)
     hist = jnp.sum(
@@ -929,10 +931,10 @@ def pallas_radix_histogram64_multi(
         _hist_kernel64_multi_packed,
         shift=shift, radix_bits=radix_bits, key_op=key_op, nq=nq,
     )
-    vma = jax.typeof(hi2).vma  # see pallas_radix_histogram
+    vma = compat.vma_of(hi2)  # see pallas_radix_histogram
     phis = _match_vma(phis, vma)
     zlos = _match_vma(zlos, vma)
-    with jax.enable_x64(False):
+    with compat.enable_x64(False):
         lane_hist = pl.pallas_call(
             kernel,
             grid=(grid,),
@@ -949,7 +951,7 @@ def pallas_radix_histogram64_multi(
             out_specs=pl.BlockSpec(
                 (nq * nb, LANES), lambda i: (0, 0), memory_space=pltpu.VMEM
             ),
-            out_shape=jax.ShapeDtypeStruct((nq * nb, LANES), jnp.int32, vma=vma),
+            out_shape=compat.shape_dtype_struct((nq * nb, LANES), jnp.int32, vma=vma),
             interpret=interpret,
         )(phis, zlos, hi2, lo2)
     hist = jnp.sum(
@@ -1070,9 +1072,9 @@ def pallas_match_counts(
     kernel = functools.partial(
         _match_count_kernel, mshift=mshift, key_op=key_op, nq=nq, n=orig_n
     )
-    vma = jax.typeof(tiles).vma  # see pallas_radix_histogram
+    vma = compat.vma_of(tiles)  # see pallas_radix_histogram
     crefs = _match_vma(crefs, vma)
-    with jax.enable_x64(False):
+    with compat.enable_x64(False):
         out = pl.pallas_call(
             kernel,
             grid=(grid,),
@@ -1085,9 +1087,7 @@ def pallas_match_counts(
             out_specs=pl.BlockSpec(
                 (nq * groups, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM
             ),
-            out_shape=jax.ShapeDtypeStruct(
-                (grid * nq * groups, LANES), jnp.int32, vma=vma
-            ),
+            out_shape=compat.shape_dtype_struct((grid * nq * groups, LANES), jnp.int32, vma=vma),
             interpret=interpret,
         )(crefs, tiles)
     # (grid, nq, groups, 128) -> (nq, grid*groups*128) == (nq, R)
@@ -1183,9 +1183,9 @@ def pallas_tau_counts(
         _tau_count_kernel, key_op=key_op, key_xor=key_xor, largest=largest,
         n=orig_n,
     )
-    vma = jax.typeof(tiles).vma  # see pallas_radix_histogram
+    vma = compat.vma_of(tiles)  # see pallas_radix_histogram
     tau = _match_vma(tau, vma)
-    with jax.enable_x64(False):
+    with compat.enable_x64(False):
         out = pl.pallas_call(
             kernel,
             grid=(grid,),
@@ -1198,9 +1198,7 @@ def pallas_tau_counts(
             out_specs=pl.BlockSpec(
                 (2 * groups, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM
             ),
-            out_shape=jax.ShapeDtypeStruct(
-                (grid * 2 * groups, LANES), jnp.int32, vma=vma
-            ),
+            out_shape=compat.shape_dtype_struct((grid * 2 * groups, LANES), jnp.int32, vma=vma),
             interpret=interpret,
         )(tau, tiles)
     # (grid, 2, groups, 128) -> (2, grid*groups*128) == (2, R)

@@ -64,6 +64,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from mpi_k_selection_tpu.utils import compat
+
 try:  # pragma: no cover
     from jax.experimental.pallas import tpu as pltpu
 except ImportError:  # pragma: no cover
@@ -210,7 +212,7 @@ def pallas_batched_topk_values(
     rescue_rows = min(rescue_rows, B)
     dt = x.dtype
 
-    with jax.enable_x64(False):
+    with compat.enable_x64(False):
         cand = pl.pallas_call(
             functools.partial(_chain_kernel, bd=bd, depth=depth),
             grid=(nb, nd),
@@ -220,9 +222,7 @@ def pallas_batched_topk_values(
             out_specs=pl.BlockSpec(
                 (depth * bb, LANES), lambda i, j: (i, 0), memory_space=pltpu.VMEM
             ),
-            out_shape=jax.ShapeDtypeStruct(
-                (depth * B, LANES), jnp.float32, vma=jax.typeof(x).vma
-            ),
+            out_shape=compat.shape_dtype_struct((depth * B, LANES), jnp.float32, vma=compat.vma_of(x)),
             interpret=interpret,
         )(x)
         top, susp = pl.pallas_call(
@@ -238,8 +238,8 @@ def pallas_batched_topk_values(
                 pl.BlockSpec((bb, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
             ],
             out_shape=[
-                jax.ShapeDtypeStruct((B, m_out), jnp.float32, vma=jax.typeof(x).vma),
-                jax.ShapeDtypeStruct((B, 1), jnp.float32, vma=jax.typeof(x).vma),
+                compat.shape_dtype_struct((B, m_out), jnp.float32, vma=compat.vma_of(x)),
+                compat.shape_dtype_struct((B, 1), jnp.float32, vma=compat.vma_of(x)),
             ],
             interpret=interpret,
         )(cand)

@@ -107,7 +107,7 @@ def resolve_cutover(cutover, n, total_bits, radix_bits, budget):
         return cutover_passes(n, total_bits, radix_bits, budget)
     if cutover is None:
         return None
-    ncut = int(cutover)
+    ncut = int(cutover)  # ksel: noqa[KSL001] -- cutover is a static jit arg (static_argnames); int() runs at trace time, never on a tracer
     if not 1 <= ncut < npasses:
         raise ValueError(f"cutover={ncut} out of range [1, {npasses - 1}]")
     return ncut
@@ -240,7 +240,7 @@ def _collect_prefix_matches(
         up = jnp.pad(u, (0, nb_ * block - n)) if nb_ * block != n else u
         u = up.reshape(nb_, block)
         ku2 = u
-    mshift = jnp.asarray(total_bits - resolved_bits).astype(kdt)  # >= 1 pass ran
+    mshift = jnp.asarray(total_bits - resolved_bits, jnp.int32).astype(kdt)  # >= 1 pass ran; values <= 64, int32 never narrows
     match2 = jax.lax.shift_right_logical(ku2, mshift) == prefix
     if padded:
         valid = (
@@ -847,7 +847,7 @@ def _collect_prefix_matches_multi(
     cdt = jnp.int32 if n < 2**31 else jnp.int64
     padded = nv != n
     nq = prefixes.shape[0]
-    mshift = jnp.asarray(total_bits - resolved_bits).astype(kdt)
+    mshift = jnp.asarray(total_bits - resolved_bits, jnp.int32).astype(kdt)  # values <= 64
     shifted = jax.lax.shift_right_logical(ku2, mshift)  # (nb_, block)
     match3 = shifted[None] == prefixes.astype(kdt)[:, None, None]
     if padded:

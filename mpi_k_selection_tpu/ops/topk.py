@@ -354,7 +354,9 @@ def _threshold_topk_indices(x: jax.Array, k: int, largest: bool) -> jax.Array:
     prep = _Descent(xr, None, "auto", 32768)
     # threshold rank in TRUE key space: k-th largest == (n-k+1)-th smallest
     tau_rank = (n - k + 1) if largest else k
-    tauk = _select_key_on_prep(prep, n, jnp.asarray(tau_rank))
+    # rank in the descent's count dtype (select_count_dtype(n), sized at
+    # _Descent build): an implicit int32 asarray would wrap for n >= 2^31
+    tauk = _select_key_on_prep(prep, n, jnp.asarray(tau_rank, prep.cdt))
     if (
         prep.count_tiles is not None
         and prep.tiles is not None

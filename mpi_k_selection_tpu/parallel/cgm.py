@@ -54,14 +54,9 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from mpi_k_selection_tpu.ops.radix import select_count_dtype
 from mpi_k_selection_tpu.parallel import mesh as mesh_lib
-from mpi_k_selection_tpu.utils import debug as _debug, dtypes as _dt
+from mpi_k_selection_tpu.utils import compat, debug as _debug, dtypes as _dt
 
-
-def _pvary(value, axis):
-    """Mark a value varying over `axis` (pcast on new jax, pvary on older)."""
-    if hasattr(jax.lax, "pcast"):
-        return jax.lax.pcast(value, (axis,), to="varying")
-    return jax.lax.pvary(value, (axis,))
+_pvary = compat.pvary  # varying-manual-axes marking across jax versions
 
 
 @functools.lru_cache(maxsize=64)
@@ -123,7 +118,7 @@ def _jitted_cgm(mesh, n, cdt, max_rounds):
     # check_vma=False: the answer/rounds are replicated by construction (they
     # derive only from psum/all_gather results), but the while_loop's mixed
     # varying/invariant carry defeats static replication inference.
-    fn = jax.shard_map(
+    fn = compat.shard_map(
         shard_fn,
         mesh=mesh,
         in_specs=(P(axis), P()),

@@ -42,7 +42,7 @@ from mpi_k_selection_tpu.ops.radix import (
     select_count_dtype,
 )
 from mpi_k_selection_tpu.parallel import mesh as mesh_lib
-from mpi_k_selection_tpu.utils import debug as _debug, dtypes as _dt
+from mpi_k_selection_tpu.utils import compat, debug as _debug, dtypes as _dt
 
 
 def _prep_shard(hist_method, xs, block_rows=4096):
@@ -190,7 +190,7 @@ def _jitted_select(
                 # match the collect branch's varying-manual-axes type (the
                 # all_gather output is device-varying to the type system
                 # even though its value is replicated)
-                return jax.lax.pcast(prefix, axis, to="varying") if check_vma else prefix
+                return compat.pvary(prefix, axis) if check_vma else prefix
 
             return fn
 
@@ -212,7 +212,7 @@ def _jitted_select(
             ans = jax.lax.pmax(ans, axis)
         return _dt.from_sortable_bits(ans, xs.dtype)
 
-    fn = jax.shard_map(
+    fn = compat.shard_map(
         shard_fn, mesh=mesh, in_specs=(P(axis), P()), out_specs=P(),
         check_vma=check_vma,
     )
@@ -376,7 +376,7 @@ def _jitted_select_many(
                 for p in range(p0, npasses):
                     prefixes, kk, _ = multi_pass(p, prefixes, kk)
                 # type-match the collect branch (see _jitted_select)
-                return jax.lax.pcast(prefixes, axis, to="varying") if check_vma else prefixes
+                return compat.pvary(prefixes, axis) if check_vma else prefixes
 
             return fn
 
@@ -393,7 +393,7 @@ def _jitted_select_many(
             ans = jax.lax.pmax(ans, axis)  # replicated value -> invariant type
         return _dt.from_sortable_bits(ans, xs.dtype)
 
-    fn = jax.shard_map(
+    fn = compat.shard_map(
         shard_fn, mesh=mesh, in_specs=(P(axis), P()), out_specs=P(),
         check_vma=check_vma,
     )

@@ -22,17 +22,8 @@ from jax.sharding import PartitionSpec as P
 from mpi_k_selection_tpu.ops.histogram import masked_radix_histogram
 from mpi_k_selection_tpu.parallel import mesh as mesh_lib
 from mpi_k_selection_tpu.streaming.sketch import RadixSketch
+from mpi_k_selection_tpu.utils import compat
 from mpi_k_selection_tpu.utils import dtypes as _dt
-
-
-def _shard_map(fn, mesh, in_specs, out_specs):
-    """shard_map across jax versions (jax.shard_map landed after 0.4.x;
-    the experimental module is the fallback — same calling convention)."""
-    if hasattr(jax, "shard_map"):
-        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
-    from jax.experimental.shard_map import shard_map as _legacy
-
-    return _legacy(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
 
 
 def distributed_sketch(
@@ -105,7 +96,7 @@ def distributed_sketch(
             )
 
         fn = jax.jit(
-            _shard_map(shard_fn, mesh, in_specs=(P(axis),), out_specs=P())
+            compat.shard_map(shard_fn, mesh=mesh, in_specs=(P(axis),), out_specs=P())
         )
         # the psum reduces int32 counts across shards: cap each call's total
         # population below 2^31 so the merged counts cannot wrap, and

@@ -26,7 +26,7 @@ import numpy as np
 
 from mpi_k_selection_tpu.ops.topk import topk as local_topk
 from mpi_k_selection_tpu.parallel import mesh as mesh_lib
-from mpi_k_selection_tpu.utils import debug as _debug, dtypes as _dt
+from mpi_k_selection_tpu.utils import compat, debug as _debug, dtypes as _dt
 
 
 def _pad_with_losers(x, multiple: int, largest: bool):
@@ -62,7 +62,7 @@ def _jitted_topk(mesh, k, largest, method):
     # check_vma=False: outputs derive only from all_gather results so they
     # are replicated by construction, but the jitted local_topk inside the
     # body defeats static replication inference (same situation as cgm.py)
-    fn = jax.shard_map(
+    fn = compat.shard_map(
         shard_fn, mesh=mesh, in_specs=(P(axis),), out_specs=(P(), P()), check_vma=False
     )
     return jax.jit(fn)

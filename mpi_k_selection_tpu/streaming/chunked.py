@@ -165,7 +165,7 @@ def _chunk_histograms(keys, shift, radix_bits, prefixes, method, kdt):
         multi_masked_radix_histogram,
     )
 
-    dk = jnp.asarray(keys)  # no-op for device chunks: ONE transfer, all prefixes
+    dk = jnp.asarray(keys)  # ksel: noqa[KSL002] -- 64-bit keys only reach this device branch with x64 on: resolve_stream_hist routes them to the host 'numpy' method otherwise
     if len(prefixes) == 1 and prefixes[0] is None:
         h = masked_radix_histogram(
             dk,

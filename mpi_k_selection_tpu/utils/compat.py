@@ -1,0 +1,113 @@
+"""Version-compatibility shims for jax APIs that moved between releases.
+
+The package targets current jax (``jax.shard_map``, ``jax.typeof``,
+``jax.enable_x64``, ``jax.lax.pcast``), but the distributed and Pallas
+paths must still import — and where possible run — on the 0.4.x line,
+where the same capabilities live under ``jax.experimental`` (or do not
+exist at all, like varying-manual-axes types). Every version-sensitive
+attribute is resolved HERE and nowhere else; the static analyzer enforces
+that (analysis/ast_rules.py:KSL006), so a new jax API drift shows up as
+one shim edit instead of a scattered AttributeError hunt.
+
+Resolution map:
+
+===================  ============================  =========================
+shim                 current jax                   0.4.x fallback
+===================  ============================  =========================
+``shard_map``        ``jax.shard_map``             ``jax.experimental.
+                     (``check_vma=``)              shard_map.shard_map``
+                                                   (``check_rep=False`` —
+                                                   no vma types to check)
+``enable_x64``       ``jax.enable_x64(flag)``      ``jax.experimental.
+                                                   {enable,disable}_x64()``
+``typeof``           ``jax.typeof``                ``jax.core.get_aval``
+``vma_of``           ``jax.typeof(x).vma``         ``frozenset()`` (the
+                                                   type system predates vma)
+``shape_dtype_       ``jax.ShapeDtypeStruct(...,   drops the ``vma``
+struct``             vma=...)``                    keyword (always empty)
+``pvary``            ``jax.lax.pcast(..,           identity (replication
+                     to="varying")``               is check_rep's job)
+===================  ============================  =========================
+"""
+
+from __future__ import annotations
+
+import jax
+
+# ksel: noqa-file[KSL006] -- this module IS the shim the rule points everyone at
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` across jax versions.
+
+    ``check_vma`` is honored on current jax; the 0.4.x fallback runs with
+    ``check_rep=False`` — legacy replication inference predates the vma
+    type system these shard bodies are written against (explicit
+    ``pvary``/``pmax`` re-establishment), and letting it guess produces
+    spurious mismatches the new checker would not raise.
+    """
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as _legacy
+
+    return _legacy(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False
+    )
+
+
+def enable_x64(enable: bool = True):
+    """Context manager forcing 64-bit types on (or off), across versions."""
+    if hasattr(jax, "enable_x64"):
+        return jax.enable_x64(enable)
+    if enable:
+        from jax.experimental import enable_x64 as _ctx
+    else:
+        from jax.experimental import disable_x64 as _ctx
+    return _ctx()
+
+
+def typeof(x):
+    """``jax.typeof`` across versions (falls back to the abstract value)."""
+    if hasattr(jax, "typeof"):
+        return jax.typeof(x)
+    return jax.core.get_aval(x)
+
+
+def vma_of(x) -> frozenset:
+    """``x``'s varying-manual-axes set; empty where the type system
+    predates vma (every manual-axes value is then untyped — the legacy
+    ``check_rep`` regime)."""
+    if hasattr(jax, "typeof"):
+        return getattr(jax.typeof(x), "vma", frozenset())
+    return frozenset()
+
+
+def shape_dtype_struct(shape, dtype, *, vma: frozenset = frozenset()):
+    """``jax.ShapeDtypeStruct`` carrying ``vma`` where supported. An empty
+    ``vma`` is omitted (equivalent on current jax, required on 0.4.x whose
+    constructor rejects the keyword)."""
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def pvary(value, axes):
+    """Mark ``value`` varying over mesh ``axes`` inside shard_map bodies.
+
+    ``pcast`` on current jax, ``pvary`` on the releases that shipped it
+    under that name, identity on 0.4.x (no vma types; the legacy
+    ``check_rep=False`` regime the :func:`shard_map` shim selects needs no
+    value-level marking).
+    """
+    if isinstance(axes, str):
+        axes = (axes,)
+    else:
+        axes = tuple(axes)
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(value, axes, to="varying")
+    if hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(value, axes)
+    return value

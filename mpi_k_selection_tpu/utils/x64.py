@@ -4,16 +4,12 @@ from __future__ import annotations
 
 import contextlib
 
-import jax
+from mpi_k_selection_tpu.utils import compat
 
 
 def enable_x64():
     """Context manager enabling 64-bit types, across jax versions."""
-    if hasattr(jax, "enable_x64"):  # jax >= 0.9
-        return jax.enable_x64(True)
-    from jax.experimental import enable_x64 as _legacy  # pragma: no cover
-
-    return _legacy()  # pragma: no cover
+    return compat.enable_x64(True)
 
 
 @contextlib.contextmanager

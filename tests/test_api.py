@@ -8,6 +8,8 @@ import mpi_k_selection_tpu as ks
 from mpi_k_selection_tpu.backends import get_backend, seq
 from mpi_k_selection_tpu.utils import datagen
 
+from mpi_k_selection_tpu.utils import compat
+
 
 def test_kselect_dispatch():
     x = datagen.generate(3000, pattern="uniform", seed=1, dtype=np.int32)
@@ -107,7 +109,7 @@ def test_f64_host_route_reachable_from_api(monkeypatch, rng):
     from mpi_k_selection_tpu import api
 
     monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
-    with jax.enable_x64(True):
+    with compat.enable_x64(True):
         # large-n radix route
         x = rng.standard_normal(70_001)
         kept = api.as_selection_array(x)
@@ -147,7 +149,7 @@ def test_kselect_many_traced_scalar_ks_host_f64(monkeypatch, rng):
     # the traced calls below trip the one-time f64-approx warning; keep the
     # process-global flag's state out of other tests
     monkeypatch.setattr(radix_mod, "_f64_tpu_approx_warned", set())
-    with jax.enable_x64(True):
+    with compat.enable_x64(True):
         x = rng.standard_normal(1_000)  # size <= 2^14 -> the sort path
         with warnings.catch_warnings(record=True) as caught:
             warnings.simplefilter("always")

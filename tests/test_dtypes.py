@@ -8,6 +8,8 @@ import pytest
 from mpi_k_selection_tpu.utils import dtypes as dt
 from mpi_k_selection_tpu.utils import x64
 
+from mpi_k_selection_tpu.utils import compat
+
 DTYPES_32 = [np.int32, np.uint32, np.float32, np.int16, np.uint16, np.int8, np.uint8]
 
 
@@ -81,7 +83,7 @@ def test_f64_raw_bits_matches_bitcast_exhaustive():
 
     from mpi_k_selection_tpu.utils.dtypes import f64_raw_bits
 
-    with jax.enable_x64(True):
+    with compat.enable_x64(True):
         rng = np.random.default_rng(99)
         # every NORMAL binary exponent (XLA flushes f64 denormals to zero in
         # compiled arithmetic, so the contract maps them to signed zero)
@@ -117,7 +119,7 @@ def test_sortable_from_raw_bits_matches_to_sortable():
     )
 
     rng = np.random.default_rng(7)
-    with jax.enable_x64(True):
+    with compat.enable_x64(True):
         for dtype in (np.int32, np.uint32, np.float32, np.int64, np.uint64,
                       np.float64):
             dtype = np.dtype(dtype)
@@ -152,7 +154,7 @@ def test_f64_tpu_host_keys_and_decode_roundtrip(monkeypatch):
         rng.standard_normal(4096),
         np.array([0.0, -0.0, np.inf, -np.inf, np.finfo(np.float64).max]),
     ])
-    with jax.enable_x64(True):
+    with compat.enable_x64(True):
         keys = radix_mod._f64_tpu_host_keys(x)
         assert keys is not None and keys.dtype == jnp.uint64
         want = np.asarray(to_sortable_bits(jnp.asarray(x)))
@@ -193,7 +195,7 @@ def test_f64_tpu_host_route_declines_under_trace_and_warns(monkeypatch):
     rng = np.random.default_rng(5)
     x = rng.standard_normal(4096)
     want = float(np.sort(x, kind="stable")[499])
-    with jax.enable_x64(True):
+    with compat.enable_x64(True):
         # the gate itself: concrete x, active trace -> route declined
         seen = {}
 

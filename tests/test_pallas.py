@@ -20,6 +20,8 @@ from mpi_k_selection_tpu.ops.histogram import masked_radix_histogram
 from mpi_k_selection_tpu.ops.pallas.histogram import pallas_radix_histogram
 from mpi_k_selection_tpu.ops.radix import radix_select
 
+from mpi_k_selection_tpu.utils import compat
+
 
 def _oracle(keys, shift, radix_bits, prefix):
     keys = np.asarray(keys, np.uint64)
@@ -295,7 +297,7 @@ def test_pallas64_raw_fold_matches_keyspace(rng, dtype, shift, radix_bits):
     )
     from mpi_k_selection_tpu.utils import dtypes as _dt
 
-    with jax.enable_x64(True):
+    with compat.enable_x64(True):
         n = 2 * 256 * 128 + 77
         x = _raw_fold_case(rng, dtype, n)
         xd = jnp.asarray(x)
@@ -491,7 +493,7 @@ def test_pallas64_multi_histogram_matches_singles(rng, dtype, shift):
     )
     from mpi_k_selection_tpu.utils import dtypes as _dt
 
-    with jax.enable_x64(True):
+    with compat.enable_x64(True):
         n = 256 * 128 + 55
         x = _raw_fold_case(rng, dtype, n)
         xd = jnp.asarray(x)
@@ -519,7 +521,7 @@ def test_radix_select_pallas64_forced_cutover(rng, dtype):
     32 holds at ncut=2, rb=4, so _collect_via_counts serves rung 1)."""
     import jax
 
-    with jax.enable_x64(True):
+    with compat.enable_x64(True):
         n = 2 * 256 * 128 + 17
         x = _raw_fold_case(rng, dtype, n)
         want = np.sort(x, kind="stable")
@@ -538,7 +540,7 @@ def test_radix_select_many_pallas64_forced_cutover(rng):
 
     from mpi_k_selection_tpu.ops.radix import radix_select_many
 
-    with jax.enable_x64(True):
+    with compat.enable_x64(True):
         n = 2 * 256 * 128 + 17
         x = _raw_fold_case(rng, np.int64, n)
         # K=2: the full-branch trace unrolls ~28 multi passes whose kernel
@@ -561,7 +563,7 @@ def test_radix_select_e2e_float64_uint64_auto(rng, dtype):
 
     from mpi_k_selection_tpu.ops.radix import radix_select_many
 
-    with jax.enable_x64(True):
+    with compat.enable_x64(True):
         n = 54_321
         x = _raw_fold_case(rng, dtype, n)
         want = np.sort(x, kind="stable")
@@ -579,7 +581,7 @@ def test_radix_select_pallas64_deep_cutover_planes_collect(rng):
     unreachable from the counts path."""
     import jax
 
-    with jax.enable_x64(True):
+    with compat.enable_x64(True):
         n = 256 * 128 + 13
         x = _raw_fold_case(rng, np.int64, n)
         want = np.sort(x, kind="stable")
