@@ -12,10 +12,14 @@ asserts a property every review round has had to re-derive by hand:
 - **KSC102 counter-width discipline**: histogram accumulators are int32
   only below the documented 2^31-population bound, int64 (x64) beyond,
   and `select_count_dtype` refuses the un-representable case loudly.
+  Covers the streaming device/host histogram boundary: per-chunk device
+  counts int32, the cross-chunk host merge int64 — at two chunk sizes.
 - **KSC103 jaxpr stability across batch sizes**: the same kernel traced
   at nearby n produces the identical primitive sequence — a divergence
   means some Python-level branch depends on n in a way that recompiles
   per shape (the recompile-hazard class: jit caches are per-jaxpr).
+  Covers the streaming double-buffer ingest at two adjacent pow2 staging
+  buckets (the exact shapes streaming/pipeline.py pads chunks to).
 
 Checks report :class:`~mpi_k_selection_tpu.analysis.core.Finding`s
 against the module that owns the kernel; they have no line-level noqa
@@ -94,6 +98,52 @@ def _primitive_trail(jaxpr) -> list[str]:
 # without an x64 mode flip, plus the 64-bit pair under compat.enable_x64
 _GRID_32 = ("int32", "uint32", "float32", "int16", "bfloat16")
 _GRID_64 = ("int64", "float64")
+
+# Two nearby chunk sizes for the streaming double-buffer ingest contracts:
+# adjacent pow2 STAGING buckets (streaming/pipeline.py pads every staged
+# chunk to its pow2 ceiling, so these are exactly the shapes the pipelined
+# descent compiles) — the trail must not diverge between them, or ragged
+# streams recompile per bucket.
+_STREAMING_INGEST_SIZES = (1 << 12, 1 << 13)
+
+
+def _streaming_ingest_cases():
+    """The device programs `streaming/chunked.py:_chunk_histograms` runs per
+    chunk — single-prefix (pass 0 / single-rank descent) and shared-sweep
+    multi-prefix (multi-rank descent) — with the streaming counter
+    discipline (per-chunk int32; the host merge promotes to int64)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mpi_k_selection_tpu.ops.histogram import (
+        masked_radix_histogram,
+        multi_masked_radix_histogram,
+    )
+
+    path = "mpi_k_selection_tpu/streaming/chunked.py"
+    return [
+        (
+            path,
+            "streaming chunked ingest[uint32, single-prefix]",
+            lambda u: masked_radix_histogram(
+                u, shift=24, radix_bits=8, prefix=None, method="scatter",
+                count_dtype=jnp.int32,
+            ),
+            "uint32",
+            _STREAMING_INGEST_SIZES,
+        ),
+        (
+            path,
+            "streaming chunked ingest[uint32, multi-prefix shared sweep]",
+            lambda u: multi_masked_radix_histogram(
+                u, shift=16, radix_bits=8,
+                prefixes=np.asarray([0, 3, 129], np.uint32),
+                method="scatter", count_dtype=jnp.int32,
+            ),
+            "uint32",
+            _STREAMING_INGEST_SIZES,
+        ),
+    ]
 
 
 @contract(
@@ -232,6 +282,42 @@ def check_counter_width() -> list[Finding]:
                         f"int64 histogram accumulator traced as {out.dtype} "
                         "under x64 (silent counter demotion)")
             )
+
+    # the streaming device/host histogram boundary, at two chunk sizes (the
+    # pipeline's adjacent pow2 staging buckets): the per-chunk DEVICE
+    # accumulator stays int32 (a chunk never exceeds 2^31 elements — the
+    # guard in streaming/chunked.py:_encode_chunk), and the HOST merge the
+    # descent accumulates across chunks/passes is int64 regardless of x64,
+    # so n is exact to 2^63
+    from mpi_k_selection_tpu.streaming.chunked import _chunk_histograms
+
+    spath = "mpi_k_selection_tpu/streaming/chunked.py"
+    for _path, label, fn, dt, sizes in _streaming_ingest_cases():
+        for n in sizes:
+            out = jax.eval_shape(fn, _spec(n, dt))
+            cdt = np.dtype(jnp.result_type(out)) if not hasattr(out, "dtype") else np.dtype(out.dtype)
+            if cdt != np.dtype(np.int32):
+                findings.append(
+                    Finding("KSC102", spath, 0,
+                            f"{label} n={n}: per-chunk device accumulator "
+                            f"traced as {cdt}, want int32")
+                )
+    # host-merge side (numpy method — host-only, nothing touches a device):
+    # both the single- and multi-prefix merge inputs must already be int64
+    kdt = np.dtype(np.uint32)
+    probe = np.arange(64, dtype=np.uint32)
+    merged = _chunk_histograms(probe, 24, 8, [None], "numpy", kdt)[None]
+    multi = _chunk_histograms(probe, 16, 8, [0, 3], "numpy", kdt)
+    for label, h in [("single-prefix", merged)] + [
+        (f"prefix {p:#x}", h) for p, h in multi.items()
+    ]:
+        if np.dtype(h.dtype) != np.dtype(np.int64):
+            findings.append(
+                Finding("KSC102", spath, 0,
+                        f"streaming host-merge histogram ({label}) is "
+                        f"{np.dtype(h.dtype)}, want int64 — the cross-chunk "
+                        "accumulator would wrap past 2^31 elements")
+            )
     return findings
 
 
@@ -278,6 +364,11 @@ def check_jaxpr_stability() -> list[Finding]:
             (1 << 16, (1 << 16) + (1 << 10)),
         ),
     ]
+    # the streaming double-buffer ingest traced at two chunk sizes
+    # (adjacent pow2 staging buckets): a trail divergence would mean every
+    # distinct chunk/bucket size compiles a fresh histogram program —
+    # defeating the pipeline's pad-to-bucket design outright
+    cases += _streaming_ingest_cases()
     for path, label, fn, dt, (n1, n2) in cases:
         t1 = _primitive_trail(jax.make_jaxpr(fn)(_spec(n1, dt)))
         t2 = _primitive_trail(jax.make_jaxpr(fn)(_spec(n2, dt)))

@@ -254,8 +254,13 @@ def kselect_streaming(source, k, **kwargs):
     iterator (replayed once per radix pass); chunks may be numpy or device
     arrays. Serves ``n`` far beyond HBM, and is bit-exact for float64 on
     TPU with host chunks (keys never touch the device's ~49-bit f64
-    storage). See streaming/chunked.py:streaming_kselect for options
-    (``radix_bits``, ``hist_method``, ``collect_budget``, ``sketch``)."""
+    storage). Ingest is double-buffered by default (``pipeline_depth=2``):
+    chunk *i+1* is produced, key-encoded and staged to the device on a
+    background thread while chunk *i* histograms — pass
+    ``pipeline_depth=0`` for the fully synchronous oracle (bit-identical
+    answers). See streaming/chunked.py:streaming_kselect for the full
+    option set (``radix_bits``, ``hist_method``, ``collect_budget``,
+    ``sketch``, ``pipeline_depth``, ``timer``)."""
     from mpi_k_selection_tpu.streaming.chunked import streaming_kselect
 
     return streaming_kselect(source, k, **kwargs)
@@ -269,11 +274,24 @@ class StreamingQuantiles:
     order (``merge`` — bitwise order-invariant), read approximate quantiles
     any time (``quantiles`` — rank error per the sketch's documented
     bound), and spend extra passes over a replayable source only when an
-    exact answer is worth it (``refine_quantiles``)."""
+    exact answer is worth it (``refine_quantiles``).
 
-    def __init__(self, dtype, *, radix_bits: int = 4, levels: int = 4):
+    ``pipeline_depth`` governs how chunked ingest (``update_stream``) and
+    the exact refinement passes overlap production/encode/transfer with
+    compute (streaming/pipeline.py; 0 = synchronous, bit-identical)."""
+
+    def __init__(
+        self,
+        dtype,
+        *,
+        radix_bits: int = 4,
+        levels: int = 4,
+        pipeline_depth: int | None = None,
+    ):
+        from mpi_k_selection_tpu.streaming.pipeline import validate_pipeline_depth
         from mpi_k_selection_tpu.streaming.sketch import RadixSketch
 
+        self.pipeline_depth = validate_pipeline_depth(pipeline_depth)
         self.sketch = RadixSketch(dtype, radix_bits=radix_bits, levels=levels)
 
     @property
@@ -284,11 +302,19 @@ class StreamingQuantiles:
         self.sketch.update(chunk)
         return self
 
+    def update_stream(self, source) -> "StreamingQuantiles":
+        """Fold every chunk of a replayable/listed ``source`` in via the
+        pipelined iterator (chunk *i+1* encoded in the background while
+        chunk *i* folds) — bit-identical to sequential ``update`` calls."""
+        self.sketch.update_stream(source, pipeline_depth=self.pipeline_depth)
+        return self
+
     def merge(self, other: "StreamingQuantiles") -> "StreamingQuantiles":
         out = StreamingQuantiles(
             self.sketch.dtype,
             radix_bits=self.sketch.radix_bits,
             levels=self.sketch.levels,
+            pipeline_depth=self.pipeline_depth,
         )
         out.sketch = self.sketch.merge(
             other.sketch if isinstance(other, StreamingQuantiles) else other
@@ -313,6 +339,7 @@ class StreamingQuantiles:
             quantile_ranks(qs, self.sketch.n),
             radix_bits=self.sketch.radix_bits,
             sketch=self.sketch,
+            pipeline_depth=self.pipeline_depth,
         )
 
 

@@ -6,7 +6,7 @@ from mpi_k_selection_tpu.parallel.radix import (
     distributed_radix_select,
     distributed_radix_select_many,
 )
-from mpi_k_selection_tpu.parallel.sketch import distributed_sketch
+from mpi_k_selection_tpu.parallel.sketch import dcn_merge_sketch, distributed_sketch
 from mpi_k_selection_tpu.parallel.topk import distributed_topk
 
 DISTRIBUTED_ALGORITHMS = ("radix", "cgm")
@@ -30,6 +30,7 @@ __all__ = [
     "distributed_radix_select",
     "distributed_radix_select_many",
     "distributed_cgm_select",
+    "dcn_merge_sketch",
     "distributed_sketch",
     "distributed_topk",
     "make_mesh",

@@ -10,6 +10,13 @@ of the reference CGM's ``MPI_Allreduce`` of per-rank counts
 final queryable summary. The replicated result is lifted into a host
 :class:`RadixSketch`, interchangeable (bitwise) with one accumulated by
 sequential ``update`` calls over the same data.
+
+Multi-host: on a process-spanning mesh the psum above already rides DCN
+between slices, so the device path needs nothing extra. The host-exact
+fallback routes (64-bit-no-x64, f64-on-TPU) only ever see local data —
+:func:`dcn_merge_sketch` finishes those with ONE ``process_allgather`` of
+the packed deepest-level counts (32-bit lanes, so x64-off processes
+cannot truncate them; single-process jobs are the degenerate identity).
 """
 
 from __future__ import annotations
@@ -24,6 +31,92 @@ from mpi_k_selection_tpu.parallel import mesh as mesh_lib
 from mpi_k_selection_tpu.streaming.sketch import RadixSketch
 from mpi_k_selection_tpu.utils import compat
 from mpi_k_selection_tpu.utils import dtypes as _dt
+
+
+def _mesh_spans_processes(mesh) -> bool:
+    """True when ``mesh`` includes devices owned by more than one process —
+    the regime where host-side accumulation only ever saw LOCAL data and a
+    DCN merge must finish the job."""
+    procs = {d.process_index for d in np.asarray(mesh.devices).ravel()}
+    return len(procs) > 1
+
+
+def _split_u32(a: np.ndarray) -> np.ndarray:
+    """Pack a nonnegative int64/uint64 vector into a ``(2, n)`` uint32
+    lo/hi-word array — the DCN wire format: 32-bit lanes survive the
+    device round-trip of ``process_allgather`` bit-exactly with x64 OFF,
+    where shipping int64 directly would be silently truncated (the KSL002
+    class this repository guards everywhere else)."""
+    u = a.astype(np.uint64)
+    lo = (u & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    hi = (u >> np.uint64(32)).astype(np.uint32)
+    return np.stack([lo, hi])
+
+
+def _join_u32(packed: np.ndarray) -> np.ndarray:
+    """Inverse of :func:`_split_u32`: ``(2, n)`` uint32 -> uint64."""
+    lo, hi = packed
+    return lo.astype(np.uint64) | (hi.astype(np.uint64) << np.uint64(32))
+
+
+def _pack_sketch_payload(sk: RadixSketch) -> np.ndarray:
+    """One process's DCN payload: ``[deep histogram..., n, has_data,
+    min_key, max_key]`` as uint64. ``has_data`` masks the extremes of
+    processes that saw an empty local stream."""
+    deep = sk.hists[-1]
+    payload = np.empty((deep.size + 4,), np.uint64)
+    payload[: deep.size] = deep.astype(np.uint64)
+    payload[deep.size] = np.uint64(sk.n)
+    payload[deep.size + 1] = np.uint64(sk.n > 0)
+    payload[deep.size + 2] = (
+        np.uint64(0) if sk._min_key is None else np.uint64(sk._min_key)
+    )
+    payload[deep.size + 3] = (
+        np.uint64(0) if sk._max_key is None else np.uint64(sk._max_key)
+    )
+    return payload
+
+
+def _unpack_gathered_payloads(gathered: np.ndarray, like: RadixSketch) -> RadixSketch:
+    """Fold every process's packed payload row into a fresh sketch shaped
+    ``like`` (empty-process rows contribute nothing, including to the
+    extremes)."""
+    nbuckets = like.hists[-1].size
+    out = RadixSketch(like.dtype, radix_bits=like.radix_bits, levels=like.levels)
+    kmin = kmax = None
+    for packed in gathered:  # one (2, len) uint32 row pair per process
+        row = _join_u32(packed)
+        n_p = int(row[nbuckets])
+        if not int(row[nbuckets + 1]):
+            continue
+        out._fold_deep_histogram(row[:nbuckets].astype(np.int64))
+        out.n += n_p
+        pmin = out.kdt.type(row[nbuckets + 2])
+        pmax = out.kdt.type(row[nbuckets + 3])
+        kmin = pmin if kmin is None else min(kmin, pmin)
+        kmax = pmax if kmax is None else max(kmax, pmax)
+    out._min_key, out._max_key = kmin, kmax
+    return out
+
+
+def dcn_merge_sketch(sk: RadixSketch) -> RadixSketch:
+    """Merge per-process host-accumulated sketches across a multi-process
+    job with ONE ``process_allgather`` (utils/compat.py) of the packed
+    deepest-level arrays — ``RadixSketch.merge`` is an elementwise int64
+    sum, so the allgather-of-levels IS the merge; the shallower pyramid is
+    re-derived from the merged deepest level (bitwise identical, as in
+    :func:`distributed_sketch`). Single-process jobs return ``sk``
+    unchanged (the degenerate identity).
+
+    Payloads ship as uint32 lo/hi words (see :func:`_split_u32`) so
+    x64-off processes cannot truncate counts; extremes travel in key
+    space, masked per process by the ``has_data`` slot."""
+    if jax.process_count() == 1:
+        return sk
+    gathered = np.asarray(
+        compat.process_allgather(_split_u32(_pack_sketch_payload(sk)))
+    )
+    return _unpack_gathered_payloads(gathered, sk)
 
 
 def distributed_sketch(
@@ -50,19 +143,25 @@ def distributed_sketch(
     xh = x if hasattr(x, "dtype") else np.asarray(x)
     dtype = np.dtype(xh.dtype)  # BEFORE any device cast can narrow it
     sk = RadixSketch(dtype, radix_bits=radix_bits, levels=levels)
+    spans = _mesh_spans_processes(mesh)
     if dtype.itemsize == 8 and not jax.config.jax_enable_x64:
         # jnp.asarray would silently truncate 64-bit host input to 32 bits
         # (wrong counts, wrong sketch dtype) — the same hole
         # streaming/chunked.py:resolve_stream_hist guards; accumulate
-        # host-side instead: exact, and no x64 mode flip required
-        return sk.update(np.ravel(np.asarray(xh)))
+        # host-side instead: exact, and no x64 mode flip required. On a
+        # process-spanning mesh each process only folded its LOCAL data,
+        # so one DCN allgather finishes the merge
+        sk.update(np.ravel(np.asarray(xh)))
+        return dcn_merge_sketch(sk) if spans else sk
     x = jnp.ravel(jnp.asarray(x))
     if dtype == np.float64 and jax.default_backend() == "tpu":
         # TPU f64 device keys are the ~49-bit approximation
         # (utils/dtypes.py:f64_raw_bits), which would break the bitwise
         # host-parity contract — accumulate host-side instead, exact
-        # w.r.t. the (already storage-truncated) device contents
-        return sk.update(np.asarray(x))
+        # w.r.t. the (already storage-truncated) device contents; DCN-merge
+        # per-process accumulations as above
+        sk.update(np.asarray(x))
+        return dcn_merge_sketch(sk) if spans else sk
     n = x.shape[0]
     nmain = n - n % mesh.size
     axis = mesh.axis_names[0]
@@ -95,6 +194,11 @@ def distributed_sketch(
                 jax.lax.pmax(jnp.max(u), axis),
             )
 
+        # NOTE: on a process-spanning mesh the psum below already reduces
+        # over EVERY device in the mesh — ICI within a slice, DCN across —
+        # so the merged counts come back globally complete and need no
+        # extra process merge (dcn_merge_sketch is for the host-accumulated
+        # fallback routes above, where no collective ever ran)
         fn = jax.jit(
             compat.shard_map(shard_fn, mesh=mesh, in_specs=(P(axis),), out_specs=P())
         )

@@ -13,6 +13,10 @@ Two cooperating pieces (see docs/API.md "Streaming / out-of-core"):
   (associative AND commutative — bitwise merge-order invariant), exact
   ``rank_bounds``/``value_bounds``, approximate ``quantile``, and a
   ``refine`` hook that reuses the chunked path for exact answers.
+- :mod:`pipeline` — double-buffered ingest for both: a background producer
+  thread overlaps chunk *i+1*'s production / host key-encode / host->device
+  staging with chunk *i*'s compute (``pipeline_depth`` knob, 0 =
+  synchronous oracle, bit-identical answers either way).
 """
 
 from mpi_k_selection_tpu.streaming.chunked import (
@@ -21,11 +25,21 @@ from mpi_k_selection_tpu.streaming.chunked import (
     streaming_kselect_many,
     streaming_rank_certificate,
 )
+from mpi_k_selection_tpu.streaming.pipeline import (
+    DEFAULT_PIPELINE_DEPTH,
+    ChunkPipeline,
+    StagedKeys,
+    ingest_hidden_frac,
+)
 from mpi_k_selection_tpu.streaming.sketch import RadixSketch
 
 __all__ = [
+    "ChunkPipeline",
+    "DEFAULT_PIPELINE_DEPTH",
     "RadixSketch",
+    "StagedKeys",
     "as_chunk_source",
+    "ingest_hidden_frac",
     "streaming_kselect",
     "streaming_kselect_many",
     "streaming_rank_certificate",

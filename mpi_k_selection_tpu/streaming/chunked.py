@@ -24,12 +24,22 @@ Termination mirrors ops/radix.py's cutover: as soon as the surviving
 population fits ``collect_budget``, one extra streaming pass collects the
 survivors host-side and a tiny partition finishes — so uniform-ish data pays
 ~2 passes + collect instead of the full ``key_bits / radix_bits`` schedule.
+
+Ingest is pipelined by default (``pipeline_depth=2``): a background
+producer thread runs chunk *i+1*'s production, host key-encode and
+host->device staging while chunk *i* histograms on device — see
+streaming/pipeline.py. ``pipeline_depth=0`` is the fully synchronous
+path, kept as the correctness oracle; both return bit-identical answers.
 """
 
 from __future__ import annotations
 
+import contextlib
+
 import numpy as np
 
+from mpi_k_selection_tpu.streaming import pipeline as _pl
+from mpi_k_selection_tpu.streaming.pipeline import DEFAULT_PIPELINE_DEPTH, StagedKeys
 from mpi_k_selection_tpu.utils import dtypes as _dt
 
 DEFAULT_COLLECT_BUDGET = 1 << 20
@@ -75,44 +85,82 @@ def as_chunk_source(source):
     raise TypeError(f"unsupported chunk source type {type(source).__name__!r}")
 
 
-def _iter_key_chunks(src, dtype=None):
-    """Yield ``(keys, chunk)`` pairs for every non-empty chunk: ``keys`` is
+def _encode_chunk(chunk, dtype):
+    """Validate + key-encode ONE chunk: returns ``(keys, c)`` with ``keys``
     the order-preserving unsigned view (host numpy for host chunks, device
-    array for device chunks — each stays where it lives), ``chunk`` the
-    raveled original. Validates dtype consistency across the stream."""
+    array for device chunks — each stays where it lives) and ``c`` the
+    raveled original, or ``None`` for an empty chunk. ``dtype`` is the
+    stream dtype to validate against (``None`` = first chunk, adopt its
+    dtype — the caller reads it off ``c.dtype``). Shared verbatim by the
+    synchronous iterator below and the pipelined producer thread
+    (streaming/pipeline.py), so both paths enforce identical contracts."""
+    if _is_device_array(chunk):
+        c = chunk.ravel()
+    else:
+        c = np.ravel(np.asarray(chunk))
+    if c.size == 0:
+        return None
+    if c.size >= 1 << 31:
+        raise ValueError(
+            f"chunk of {c.size} elements: per-chunk device histogram "
+            "counts are int32-exact only below 2^31 elements — split "
+            "the stream into smaller chunks (n is unbounded, chunks "
+            "are not)"
+        )
+    if dtype is not None and np.dtype(c.dtype) != np.dtype(dtype):
+        raise TypeError(
+            f"chunk dtype {np.dtype(c.dtype)} != stream dtype "
+            f"{np.dtype(dtype)}; streaming selection requires one dtype "
+            "per stream"
+        )
+    if not _is_device_array(c):
+        return _dt.np_to_sortable_bits(c), c
+    if np.dtype(c.dtype) == np.float64 and _tpu_backend():
+        # device f64 keys on TPU are the ~49-bit approximation
+        # (utils/dtypes.py:f64_raw_bits) — decode the chunk's (already
+        # storage-truncated) values to host and key them EXACTLY, so
+        # every chunk of a stream lives in ONE key space regardless of
+        # residency and the answer is exact w.r.t. the chunk contents
+        hc = np.asarray(c)
+        return _dt.np_to_sortable_bits(hc), hc
+    return _dt.to_sortable_bits(c), c
+
+
+def _iter_key_chunks(src, dtype=None):
+    """Yield ``(keys, chunk)`` pairs for every non-empty chunk (see
+    :func:`_encode_chunk`) — the synchronous path, and the correctness
+    oracle for the pipelined one."""
     for chunk in src():
-        if _is_device_array(chunk):
-            c = chunk.ravel()
-        else:
-            c = np.ravel(np.asarray(chunk))
-        if c.size == 0:
+        pair = _encode_chunk(chunk, dtype)
+        if pair is None:
             continue
-        if c.size >= 1 << 31:
-            raise ValueError(
-                f"chunk of {c.size} elements: per-chunk device histogram "
-                "counts are int32-exact only below 2^31 elements — split "
-                "the stream into smaller chunks (n is unbounded, chunks "
-                "are not)"
-            )
+        keys, c = pair
         if dtype is None:
             dtype = np.dtype(c.dtype)
-        elif np.dtype(c.dtype) != dtype:
-            raise TypeError(
-                f"chunk dtype {np.dtype(c.dtype)} != stream dtype {dtype}; "
-                "streaming selection requires one dtype per stream"
-            )
-        if not _is_device_array(c):
-            yield _dt.np_to_sortable_bits(c), c
-        elif dtype == np.float64 and _tpu_backend():
-            # device f64 keys on TPU are the ~49-bit approximation
-            # (utils/dtypes.py:f64_raw_bits) — decode the chunk's (already
-            # storage-truncated) values to host and key them EXACTLY, so
-            # every chunk of a stream lives in ONE key space regardless of
-            # residency and the answer is exact w.r.t. the chunk contents
-            hc = np.asarray(c)
-            yield _dt.np_to_sortable_bits(hc), hc
-        else:
-            yield _dt.to_sortable_bits(c), c
+        yield keys, c
+
+
+@contextlib.contextmanager
+def _key_chunk_stream(
+    src, dtype=None, *, pipeline_depth=0, hist_method=None, timer=None
+):
+    """Context-managed ``(keys, chunk)`` iterator: the synchronous
+    generator at depth 0, a :class:`~mpi_k_selection_tpu.streaming.
+    pipeline.ChunkPipeline` (background produce/encode/stage overlapped
+    with the consuming pass) at depth >= 1. The context manager guarantees
+    the producer thread is joined on EVERY exit path — normal exhaustion,
+    early exit, and consumer-side raises like the replay-stability check."""
+    depth = _pl.validate_pipeline_depth(pipeline_depth)
+    if depth == 0:
+        yield _iter_key_chunks(src, dtype)
+        return
+    pipe = _pl.ChunkPipeline(
+        src, dtype, depth=depth, hist_method=hist_method, timer=timer
+    )
+    try:
+        yield iter(pipe)
+    finally:
+        pipe.close()
 
 
 def resolve_stream_hist(hist_method: str, dtype) -> str:
@@ -144,8 +192,18 @@ def _chunk_histograms(keys, shift, radix_bits, prefixes, method, kdt):
     work is paid ONCE and shared across prefixes: host chunks compute the
     digit/prefix arrays once, device chunks cross the tunnel once and stay
     on device for the counts (the whole point on TPU); only the
-    (2**radix_bits,) counts per prefix come back."""
+    (2**radix_bits,) counts per prefix come back.
+
+    Pipelined passes hand in :class:`~mpi_k_selection_tpu.streaming.
+    pipeline.StagedKeys` — a pow2-padded, already-device-resident buffer.
+    The histogram runs over the WHOLE padded buffer (fixed shape, one
+    compile per bucket size) and the pad contribution is subtracted
+    host-side: pad keys are key-space 0, so they land in digit bucket 0
+    and only under the all-zero prefix — an exact integer correction."""
+    staged = isinstance(keys, StagedKeys)
     if method == "numpy":
+        if staged:  # pragma: no cover - staging only feeds device methods
+            keys = np.asarray(keys.valid())
         k = keys if isinstance(keys, np.ndarray) else np.asarray(keys)
         dig = ((k >> kdt.type(shift)) & kdt.type((1 << radix_bits) - 1)).astype(
             np.int64
@@ -165,7 +223,7 @@ def _chunk_histograms(keys, shift, radix_bits, prefixes, method, kdt):
         multi_masked_radix_histogram,
     )
 
-    dk = jnp.asarray(keys)  # ksel: noqa[KSL002] -- 64-bit keys only reach this device branch with x64 on: resolve_stream_hist routes them to the host 'numpy' method otherwise
+    dk = keys.data if staged else jnp.asarray(keys)  # ksel: noqa[KSL002] -- 64-bit keys only reach this device branch with x64 on: resolve_stream_hist routes them to the host 'numpy' method otherwise
     if len(prefixes) == 1 and prefixes[0] is None:
         h = masked_radix_histogram(
             dk,
@@ -175,21 +233,35 @@ def _chunk_histograms(keys, shift, radix_bits, prefixes, method, kdt):
             method=method,
             count_dtype=jnp.int32,  # exact per chunk (chunk size < 2^31)
         )
-        return {None: np.asarray(h).astype(np.int64)}
-    # the shared-sweep primitive of the resident multi-rank descent: on the
-    # pallas methods all K prefix queries ride ONE read of the chunk (other
-    # methods fall back to K single-prefix sweeps — correct, just K reads)
-    hk = np.asarray(
-        multi_masked_radix_histogram(
-            dk,
-            shift=shift,
-            radix_bits=radix_bits,
-            prefixes=np.asarray(prefixes, kdt),
-            method=method,
-            count_dtype=jnp.int32,
-        )
-    ).astype(np.int64)
-    return {p: hk[i] for i, p in enumerate(prefixes)}
+        out = {None: np.asarray(h).astype(np.int64)}
+    else:
+        # the shared-sweep primitive of the resident multi-rank descent: on
+        # the pallas methods all K prefix queries ride ONE read of the chunk
+        # (other methods fall back to K single-prefix sweeps — correct,
+        # just K reads)
+        hk = np.asarray(
+            multi_masked_radix_histogram(
+                dk,
+                shift=shift,
+                radix_bits=radix_bits,
+                prefixes=np.asarray(prefixes, kdt),
+                method=method,
+                count_dtype=jnp.int32,
+            )
+        ).astype(np.int64)
+        out = {p: hk[i] for i, p in enumerate(prefixes)}
+    if staged:
+        if keys.pad:
+            # pad keys are key-space 0: digit (0 >> shift) & mask == 0, and
+            # they pass a prefix filter only when every upper bit is 0
+            for p, h in out.items():
+                if p is None or int(p) == 0:
+                    h[0] -= keys.pad
+        # the counts above are host-materialized (np.asarray blocked on
+        # them), so the ring slot can be donated back eagerly instead of
+        # waiting out the queue's references
+        keys.release()
+    return out
 
 
 def _np_walk(hist, kk, prefix, radix_bits):
@@ -203,31 +275,41 @@ def _np_walk(hist, kk, prefix, radix_bits):
     return prefix, kk, int(hist[b])
 
 
-def _collect_survivors(src, dtype, specs):
+def _collect_survivors(src, dtype, specs, *, pipeline_depth=0, timer=None):
     """One streamed pass collecting survivors for EVERY ``(resolved_bits,
     prefix) -> expected population`` spec at once — the shared finish of
     the multi-rank descent (a single-rank descent passes one spec). Keys
     whose top ``resolved_bits`` equal ``prefix`` survive; device chunks are
     filtered ON device (eager boolean indexing) so only survivors cross
-    back to the host. Returns ``{spec: host uint key array}``."""
+    back to the host. Returns ``{spec: host uint key array}``.
+
+    The pipelined path overlaps produce/encode with the filtering but
+    never stages (``hist_method=None``): the collect's device work is a
+    data-dependent gather, not a fixed-shape kernel, so padding buys no
+    compile reuse here."""
     kdt = np.dtype(_dt.key_dtype(dtype))
     total_bits = _dt.key_bits(dtype)
     out = {s: [] for s in specs}
-    for keys, _ in _iter_key_chunks(src, dtype):
-        host = isinstance(keys, np.ndarray)
-        for resolved, prefix in out:
-            shift = total_bits - resolved
-            if host:
-                surv = keys[(keys >> kdt.type(shift)) == kdt.type(prefix)]
-            else:
-                import jax
+    with _key_chunk_stream(
+        src, dtype, pipeline_depth=pipeline_depth, timer=timer
+    ) as kc:
+        for keys, _ in kc:
+            if isinstance(keys, StagedKeys):  # pragma: no cover - defensive
+                keys = keys.valid()
+            host = isinstance(keys, np.ndarray)
+            for resolved, prefix in out:
+                shift = total_bits - resolved
+                if host:
+                    surv = keys[(keys >> kdt.type(shift)) == kdt.type(prefix)]
+                else:
+                    import jax
 
-                m = jax.lax.shift_right_logical(
-                    keys, keys.dtype.type(shift)
-                ) == keys.dtype.type(prefix)
-                surv = np.asarray(keys[m])  # eager boolean gather, device-side
-            if surv.size:
-                out[(resolved, prefix)].append(np.asarray(surv, kdt))
+                    m = jax.lax.shift_right_logical(
+                        keys, keys.dtype.type(shift)
+                    ) == keys.dtype.type(prefix)
+                    surv = np.asarray(keys[m])  # eager boolean gather, device-side
+                if surv.size:
+                    out[(resolved, prefix)].append(np.asarray(surv, kdt))
     collected = {}
     for spec, parts in out.items():
         c = np.concatenate(parts) if parts else np.empty((0,), kdt)
@@ -255,6 +337,8 @@ def streaming_kselect(
     hist_method: str = "auto",
     collect_budget: int = DEFAULT_COLLECT_BUDGET,
     sketch=None,
+    pipeline_depth: int = DEFAULT_PIPELINE_DEPTH,
+    timer=None,
 ):
     """Exact k-th smallest (1-indexed) over a chunked stream.
 
@@ -270,6 +354,14 @@ def streaming_kselect(
     ``collect_budget`` bounds host memory for the survivor collect (keys of
     at most that many elements are materialized at once); the streamed
     chunks themselves are never concatenated.
+
+    ``pipeline_depth`` >= 1 overlaps chunk *i+1*'s production, host
+    key-encode and host->device staging with chunk *i*'s histogram
+    (streaming/pipeline.py; 2 = double buffering, the default). Depth 0 is
+    the fully synchronous path — the correctness oracle the pipelined one
+    is bit-identical to. ``timer`` (a utils/profiling.PhaseTimer) collects
+    the pipeline's produce/encode/stage/stall phases for
+    :func:`~mpi_k_selection_tpu.streaming.pipeline.ingest_hidden_frac`.
     """
     return streaming_kselect_many(
         source,
@@ -278,6 +370,8 @@ def streaming_kselect(
         hist_method=hist_method,
         collect_budget=collect_budget,
         sketch=sketch,
+        pipeline_depth=pipeline_depth,
+        timer=timer,
     )[0]
 
 
@@ -289,6 +383,8 @@ def streaming_kselect_many(
     hist_method: str = "auto",
     collect_budget: int = DEFAULT_COLLECT_BUDGET,
     sketch=None,
+    pipeline_depth: int = DEFAULT_PIPELINE_DEPTH,
+    timer=None,
 ):
     """Exact k-th smallest for EVERY (1-indexed) rank in ``ks``, sharing
     each streamed pass across ranks: the stream is replayed once per radix
@@ -296,10 +392,12 @@ def streaming_kselect_many(
     DISTINCT surviving prefix at each level (ranks whose descents land in
     the same bucket share it). For out-of-core sources the replay is the
     dominant cost, so m quantiles over one stream cost roughly the passes
-    of one. Per-rank semantics are exactly :func:`streaming_kselect`'s;
-    returns a list in input order.
+    of one. Per-rank semantics are exactly :func:`streaming_kselect`'s
+    (including its ``pipeline_depth``/``timer`` knobs); returns a list in
+    input order.
     """
     src = as_chunk_source(source)
+    pipeline_depth = _pl.validate_pipeline_depth(pipeline_depth)
     ks = [int(k) for k in ks]
     if not ks:
         return []
@@ -323,21 +421,27 @@ def streaming_kselect_many(
         # from the first chunk — nothing is produced just to be discarded
         dtype = None
         n = 0
-        for keys, chunk in _iter_key_chunks(src):
-            if dtype is None:
-                dtype = np.dtype(chunk.dtype)
-                kdt = np.dtype(_dt.key_dtype(dtype))
-                total_bits = _dt.key_bits(dtype)
-                if total_bits % radix_bits:
-                    raise ValueError(
-                        f"radix_bits={radix_bits} must divide key bits "
-                        f"{total_bits}"
-                    )
-                method = resolve_stream_hist(hist_method, dtype)
-                shift0 = total_bits - radix_bits
-                hist = np.zeros((1 << radix_bits,), np.int64)
-            hist += _chunk_histograms(keys, shift0, radix_bits, [None], method, kdt)[None]
-            n += int(keys.size)
+        with _key_chunk_stream(
+            src, pipeline_depth=pipeline_depth, hist_method=hist_method,
+            timer=timer,
+        ) as kc:
+            for keys, chunk in kc:
+                if dtype is None:
+                    dtype = np.dtype(chunk.dtype)
+                    kdt = np.dtype(_dt.key_dtype(dtype))
+                    total_bits = _dt.key_bits(dtype)
+                    if total_bits % radix_bits:
+                        raise ValueError(
+                            f"radix_bits={radix_bits} must divide key bits "
+                            f"{total_bits}"
+                        )
+                    method = resolve_stream_hist(hist_method, dtype)
+                    shift0 = total_bits - radix_bits
+                    hist = np.zeros((1 << radix_bits,), np.int64)
+                hist += _chunk_histograms(
+                    keys, shift0, radix_bits, [None], method, kdt
+                )[None]
+                n += int(keys.size)
         if n == 0:
             raise ValueError("streaming selection requires a non-empty stream")
         _validate_ks(ks, n)
@@ -358,11 +462,15 @@ def streaming_kselect_many(
         prefixes = sorted({st[0] for st in states if _active(st)})
         expected = {st[0]: st[3] for st in states if _active(st)}
         hists = {p: np.zeros((1 << radix_bits,), np.int64) for p in prefixes}
-        for keys, _ in _iter_key_chunks(src, dtype):
-            for p, h in _chunk_histograms(
-                keys, shift, radix_bits, prefixes, method, kdt
-            ).items():
-                hists[p] += h
+        with _key_chunk_stream(
+            src, dtype, pipeline_depth=pipeline_depth, hist_method=method,
+            timer=timer,
+        ) as kc:
+            for keys, _ in kc:
+                for p, h in _chunk_histograms(
+                    keys, shift, radix_bits, prefixes, method, kdt
+                ).items():
+                    hists[p] += h
         for p in prefixes:
             # replay-stability check, mirroring _collect_survivors': this
             # pass's population under each surviving prefix must equal the
@@ -385,7 +493,13 @@ def streaming_kselect_many(
     for prefix, _kk, resolved, pop in states:
         if resolved < total_bits:
             specs[(resolved, int(prefix))] = pop
-    collected = _collect_survivors(src, dtype, specs) if specs else {}
+    collected = (
+        _collect_survivors(
+            src, dtype, specs, pipeline_depth=pipeline_depth, timer=timer
+        )
+        if specs
+        else {}
+    )
 
     answers = []
     for prefix, kk, resolved, _pop in states:
@@ -402,31 +516,38 @@ def streaming_kselect_many(
     return answers
 
 
-def streaming_rank_certificate(source, value):
+def streaming_rank_certificate(
+    source, value, *, pipeline_depth: int = DEFAULT_PIPELINE_DEPTH, timer=None
+):
     """``(#elements < value, #elements <= value)`` streamed — the O(n)
     exactness proof of utils/debug.py:rank_certificate without residency:
     an answer for rank k is exact iff ``less < k <= leq``. Comparisons run
     in key space (total order: ties, -0.0/+0.0 and NaN behave exactly like
-    the selection itself)."""
+    the selection itself). ``pipeline_depth`` >= 1 overlaps chunk
+    production/encode with the counting (no staging — the counts consume
+    keys wherever they already live)."""
     src = as_chunk_source(source)
     less = leq = 0
     vkey = None
-    for keys, chunk in _iter_key_chunks(src):
-        if vkey is None:
-            # key the probe value from the first chunk's dtype — no chunk
-            # is produced just to learn it
-            vkey = _dt.np_to_sortable_bits(
-                np.asarray([value], np.dtype(chunk.dtype))
-            )[0]
-        if isinstance(keys, np.ndarray):
-            less += int(np.count_nonzero(keys < vkey))
-            leq += int(np.count_nonzero(keys <= vkey))
-        else:
-            import jax.numpy as jnp
+    with _key_chunk_stream(
+        src, pipeline_depth=pipeline_depth, timer=timer
+    ) as kc:
+        for keys, chunk in kc:
+            if vkey is None:
+                # key the probe value from the first chunk's dtype — no
+                # chunk is produced just to learn it
+                vkey = _dt.np_to_sortable_bits(
+                    np.asarray([value], np.dtype(chunk.dtype))
+                )[0]
+            if isinstance(keys, np.ndarray):
+                less += int(np.count_nonzero(keys < vkey))
+                leq += int(np.count_nonzero(keys <= vkey))
+            else:
+                import jax.numpy as jnp
 
-            v = keys.dtype.type(vkey)
-            less += int(jnp.sum(keys < v))
-            leq += int(jnp.sum(keys <= v))
+                v = keys.dtype.type(vkey)
+                less += int(jnp.sum(keys < v))
+                leq += int(jnp.sum(keys <= v))
     if vkey is None:
         raise ValueError("streaming_rank_certificate requires a non-empty stream")
     return less, leq

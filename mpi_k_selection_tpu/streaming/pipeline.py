@@ -1,0 +1,359 @@
+"""Pipelined streaming ingest — double-buffered transfer/compute overlap.
+
+The synchronous chunked descent (streaming/chunked.py) is strictly serial:
+produce chunk *i* (source callable), key-encode it on the host
+(utils/dtypes.py:np_to_sortable_bits), cross the host->device tunnel, run
+the histogram kernel — and only then start chunk *i+1*. On an out-of-core
+run the device idles for the entire host-side produce + encode + transfer
+of every chunk, every radix pass. The reference CGM program's whole point
+is hiding data movement behind local work (scatter once, O(1) communication
+rounds); this module applies the same discipline across *time*: a
+background producer thread runs chunk *i+1*'s production, host key-encode
+and host->device staging while the consumer (the descent) histograms chunk
+*i* on device.
+
+Design:
+
+- :class:`ChunkPipeline` — a bounded-queue producer/consumer pair. The
+  producer thread pulls chunks from the replayable source, validates and
+  key-encodes them with the SAME helpers the synchronous path uses
+  (streaming/chunked.py:_encode_chunk — per-stream dtype validation, the
+  2^31 per-chunk guard and the host-exact f64-on-TPU route are identical
+  by construction), and, when the resolved histogram method is a device
+  method, stages host keys to the device eagerly.
+- :class:`StagedKeys` — a device-resident key buffer padded to a
+  power-of-two bucket size, so the histogram kernel sees a handful of
+  shapes and compiles once per bucket instead of once per ragged chunk.
+  The pad keys are a known constant (0), and the padded counts are
+  corrected host-side by an exact integer subtraction
+  (streaming/chunked.py:_chunk_histograms) — bit-identical to the
+  unpadded histogram.
+- ``pipeline_depth`` bounds the queue, and with it the staging memory: at
+  peak ``depth + 2`` encoded/staged chunks exist at once (``depth``
+  queued, plus one the producer holds while blocked on a full queue, plus
+  the one the consumer is histogramming) — the "small ring of staging
+  buffers". Depth 0 is the synchronous path (no thread), kept as the
+  correctness oracle; depth 2 is classic double buffering and the
+  default.
+- Errors raised anywhere in the producer (drifting dtype, oversized
+  chunk, a failing source) are re-raised in the consumer; the consumer
+  closing the pipeline (normally or via an exception unwinding the
+  ``_key_chunk_stream`` context manager) signals the producer to stop and
+  joins the thread — no thread outlives its descent pass
+  (tests/conftest.py enforces this after every test).
+
+Instrumentation rides :class:`~mpi_k_selection_tpu.utils.profiling.
+PhaseTimer` (never raw clocks — KSL004): the producer records
+``pipeline.produce`` / ``pipeline.encode`` / ``pipeline.stage``, the
+consumer records ``pipeline.stall`` (time it blocked waiting for a chunk).
+:func:`ingest_hidden_frac` turns those into the headline number: the
+fraction of ingest wall time the overlap actually hid.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import itertools
+import queue
+import threading
+
+import numpy as np
+
+#: Classic double buffering: chunk i+1 staged while chunk i computes.
+DEFAULT_PIPELINE_DEPTH = 2
+
+#: Queue-depth ceiling — deeper rings only add memory, never overlap.
+MAX_PIPELINE_DEPTH = 64
+
+#: Worker threads carry this prefix; tests assert none outlive their pass.
+THREAD_NAME_PREFIX = "ksel-pipeline"
+
+#: Phases the producer thread accounts against the shared PhaseTimer.
+INGEST_PHASES = ("pipeline.produce", "pipeline.encode", "pipeline.stage")
+
+#: Phase the consumer accounts: time spent blocked waiting on the queue.
+STALL_PHASE = "pipeline.stall"
+
+_DONE = object()
+
+
+def validate_pipeline_depth(depth) -> int:
+    """Validate and normalize a ``pipeline_depth`` knob (int in
+    [0, MAX_PIPELINE_DEPTH]; 0 = synchronous). ``None`` resolves to
+    :data:`DEFAULT_PIPELINE_DEPTH` — the one place that default lives, so
+    every knob surface (api, CLI, sketch) resolves it identically."""
+    if depth is None:
+        return DEFAULT_PIPELINE_DEPTH
+    if isinstance(depth, bool) or not isinstance(depth, (int, np.integer)):
+        raise ValueError(
+            f"pipeline_depth must be an integer >= 0 "
+            f"(0 = synchronous), got {depth!r}"
+        )
+    d = int(depth)
+    if not 0 <= d <= MAX_PIPELINE_DEPTH:
+        raise ValueError(
+            f"pipeline_depth={d} out of range [0, {MAX_PIPELINE_DEPTH}]"
+        )
+    return d
+
+
+@dataclasses.dataclass(frozen=True)
+class StagedKeys:
+    """Device-resident key chunk, padded to a fixed power-of-two bucket.
+
+    ``data`` holds ``n_valid`` real keys followed by ``pad`` zero keys
+    (key-space 0). Consumers either slice the valid prefix
+    (:meth:`valid`) or histogram the whole buffer and subtract the exact
+    pad contribution (streaming/chunked.py:_chunk_histograms) — padding
+    never changes an answer bit.
+    """
+
+    data: object  # jax.Array, padded to bucket size
+    n_valid: int
+
+    @property
+    def size(self) -> int:
+        """Valid element count — mirrors ndarray/jax.Array ``.size`` so
+        the descent's length accounting is residency-agnostic."""
+        return self.n_valid
+
+    @property
+    def pad(self) -> int:
+        return int(self.data.shape[0]) - self.n_valid
+
+    def valid(self):
+        """The unpadded device keys (a lazy slice)."""
+        return self.data[: self.n_valid]
+
+    def release(self) -> None:
+        """Free the staging buffer eagerly (the ring slot's donation): safe
+        once every result depending on it has materialized host-side."""
+        delete = getattr(self.data, "delete", None)
+        if delete is not None:
+            try:
+                delete()
+            except Exception:  # pragma: no cover - already consumed/donated
+                pass
+
+
+def _bucket_elems(n: int) -> int:
+    """Power-of-two staging-bucket size for an ``n``-element chunk: all
+    equal-size chunks (and any ragged tail with the same ceiling) share
+    one compiled histogram program. Chunks past 2^30 stay unpadded —
+    their pow2 ceiling would cross the 2^31 per-chunk counter bound."""
+    bucket = 1 << max(0, n - 1).bit_length()
+    return n if bucket >= 1 << 31 else bucket
+
+
+def stage_keys(keys: np.ndarray) -> StagedKeys:
+    """Pad host ``keys`` to their pow2 bucket and transfer to the default
+    device, blocking until the copy lands (that wait is the whole point:
+    it happens on the producer thread, not in the descent)."""
+    import jax
+
+    n = int(keys.shape[0])
+    bucket = _bucket_elems(n)
+    if bucket == n:
+        buf = keys
+    else:
+        buf = np.empty(bucket, keys.dtype)
+        buf[:n] = keys
+        buf[n:] = 0  # zero only the pad tail, not the whole bucket
+    data = jax.device_put(buf)
+    data.block_until_ready()
+    return StagedKeys(data, n)
+
+
+@dataclasses.dataclass
+class _Raised:
+    exc: BaseException
+
+
+def _phase(timer, name: str):
+    return contextlib.nullcontext() if timer is None else timer.phase(name)
+
+
+class ChunkPipeline:
+    """Background producer of ``(keys, chunk)`` pairs — the pipelined twin
+    of streaming/chunked.py:_iter_key_chunks (same pairs, same order, same
+    validation, same errors).
+
+    ``hist_method`` is the raw method string of the pass this pipeline
+    feeds: the producer resolves it per the stream dtype exactly like the
+    consumer does (streaming/chunked.py:resolve_stream_hist) and stages
+    host keys to the device only when a device method will consume them.
+    ``None`` disables staging (collect and certificate passes: their
+    device work is data-dependent gathers, not fixed-shape kernels).
+    """
+
+    _ids = itertools.count()
+
+    def __init__(self, src, dtype=None, *, depth: int, hist_method=None, timer=None):
+        self._src = src
+        self._dtype = None if dtype is None else np.dtype(dtype)
+        self._depth = validate_pipeline_depth(depth)
+        if self._depth == 0:
+            raise ValueError(
+                "ChunkPipeline requires pipeline_depth >= 1; depth 0 is "
+                "the synchronous path (_iter_key_chunks)"
+            )
+        self._hist_method = hist_method
+        self._timer = timer
+        # jax's enable_x64 AND default_device context managers are
+        # THREAD-LOCAL: capture the consumer's effective values here
+        # (consumer thread) and re-establish them inside the producer, so
+        # the worker encodes 64-bit device chunks, resolves the histogram
+        # method, and commits staged buffers to the SAME device the
+        # synchronous path would — not wherever a fresh thread defaults to
+        import jax
+
+        self._x64 = bool(jax.config.jax_enable_x64)
+        self._device = getattr(jax.config, "jax_default_device", None)
+        self._q: queue.Queue = queue.Queue(maxsize=self._depth)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._produce,
+            name=f"{THREAD_NAME_PREFIX}-{next(self._ids)}",
+            daemon=True,
+        )
+        self._thread.start()
+
+    # -- producer thread ---------------------------------------------------
+
+    def _put(self, item) -> bool:
+        """Enqueue, yielding every 50 ms to honor a consumer-side close."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _produce(self) -> None:
+        import jax
+
+        from mpi_k_selection_tpu.utils import compat
+
+        dev_ctx = (
+            jax.default_device(self._device)
+            if self._device is not None
+            else contextlib.nullcontext()
+        )
+        with compat.enable_x64(self._x64), dev_ctx:
+            self._produce_inner()
+
+    def _produce_inner(self) -> None:
+        from mpi_k_selection_tpu.streaming import chunked as _chunked
+
+        dtype = self._dtype
+        method = None
+        try:
+            it = iter(self._src())
+            while not self._stop.is_set():
+                with _phase(self._timer, "pipeline.produce"):
+                    try:
+                        chunk = next(it)
+                    except StopIteration:
+                        break
+                with _phase(self._timer, "pipeline.encode"):
+                    pair = _chunked._encode_chunk(chunk, dtype)
+                if pair is None:  # empty chunk: a no-op, like the sync path
+                    continue
+                keys, c = pair
+                if dtype is None:
+                    dtype = np.dtype(c.dtype)
+                if method is None and self._hist_method is not None:
+                    method = _chunked.resolve_stream_hist(self._hist_method, dtype)
+                if method not in (None, "numpy") and isinstance(keys, np.ndarray):
+                    with _phase(self._timer, "pipeline.stage"):
+                        keys = stage_keys(keys)
+                # every consumer reads only `.dtype` off the companion (and
+                # only on the first chunk): a zero-length stand-in keeps the
+                # queue from pinning the full original chunk alongside its
+                # keys — at the bench's 512 MB staged chunks that dead
+                # weight would double the per-slot memory footprint
+                if not self._put((keys, np.empty((0,), c.dtype))):
+                    return
+            self._put(_DONE)
+        except BaseException as e:  # re-raised by the consumer
+            self._put(_Raised(e))
+
+    # -- consumer side -----------------------------------------------------
+
+    def __iter__(self):
+        while True:
+            with _phase(self._timer, STALL_PHASE):
+                while True:
+                    try:
+                        item = self._q.get(timeout=0.1)
+                        break
+                    except queue.Empty:
+                        if not self._thread.is_alive():
+                            # the producer may have enqueued its final item
+                            # (_DONE or _Raised) and exited between our
+                            # timeout and this check: drain once more
+                            # before declaring it dead
+                            try:
+                                item = self._q.get_nowait()
+                                break
+                            except queue.Empty:  # pragma: no cover
+                                raise RuntimeError(
+                                    "streaming pipeline producer died "
+                                    "without a result — this is a bug"
+                                ) from None
+            if item is _DONE:
+                return
+            if isinstance(item, _Raised):
+                raise item.exc
+            yield item
+
+    def close(self) -> None:
+        """Stop the producer and join its thread: set the stop flag, drain
+        the queue so a blocked put unblocks, then join. Idempotent; called
+        by the ``_key_chunk_stream`` context manager on every exit path
+        (including consumer-side exceptions like the replay-stability
+        raise), so no thread outlives its pass."""
+        self._stop.set()
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        self._thread.join(timeout=10.0)
+        if self._thread.is_alive():
+            # a source blocked past the join timeout (slow disk/network
+            # read): the no-thread-outlives-its-pass guarantee is violated
+            # and the next pass may re-open the same resource mid-read —
+            # make that observable instead of returning as if clean
+            # (raising here would mask the consumer's original exception)
+            import warnings
+
+            warnings.warn(
+                f"streaming pipeline producer {self._thread.name} did not "
+                "stop within 10 s of close(); its chunk source is blocked "
+                "mid-read and the thread has been abandoned (daemon)",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+
+    def __enter__(self) -> "ChunkPipeline":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def ingest_hidden_frac(timer) -> float | None:
+    """Fraction of producer-side ingest time (produce + encode + stage)
+    that the overlap hid from the descent: 1 - stall/ingest, clamped to
+    [0, 1]. ~1.0 means the consumer never waited (ingest fully hidden
+    behind compute); ~0.0 means the consumer stalled for the whole ingest
+    (no overlap — the synchronous regime). ``None`` when the timer carries
+    no pipeline phases (e.g. a ``pipeline_depth=0`` run)."""
+    ingest = sum(timer.phases.get(p, 0.0) for p in INGEST_PHASES)
+    if ingest <= 0.0:
+        return None
+    stall = timer.phases.get(STALL_PHASE, 0.0)
+    return max(0.0, min(1.0, 1.0 - stall / ingest))

@@ -94,7 +94,12 @@ class RadixSketch:
             raise TypeError(
                 f"chunk dtype {np.dtype(c.dtype)} != sketch dtype {self.dtype}"
             )
-        keys = _dt.np_to_sortable_bits(c)
+        return self._update_keys(_dt.np_to_sortable_bits(c))
+
+    def _update_keys(self, keys: np.ndarray) -> "RadixSketch":
+        """Fold one chunk's (host, key-space) unsigned view in — the
+        accumulation core shared by :meth:`update` and the pipelined
+        :meth:`update_stream`."""
         # one full-chunk pass builds the DEEPEST level; each shallower level
         # is that histogram with its lower digits summed out (a reshape-sum
         # over <= 2^resolution_bits counters, bitwise identical to counting
@@ -110,7 +115,37 @@ class RadixSketch:
             self._min_key = self.kdt.type(kmin)
         if self._max_key is None or kmax > self._max_key:
             self._max_key = self.kdt.type(kmax)
-        self.n += int(c.size)
+        self.n += int(keys.size)
+        return self
+
+    def update_stream(self, source, *, pipeline_depth=None, timer=None) -> "RadixSketch":
+        """Fold EVERY chunk of a replayable/listed ``source`` in (one
+        stream pass), drawing from the pipelined iterator: a background
+        thread produces and key-encodes chunk *i+1* while chunk *i*'s
+        deepest-level bincount folds in — the same overlap discipline as
+        the chunked descent (streaming/pipeline.py). ``pipeline_depth``
+        ``None`` takes the pipeline default; 0 is the synchronous path.
+        Bit-identical to sequential :meth:`update` calls over the same
+        chunks. Returns ``self``."""
+        from mpi_k_selection_tpu.streaming.chunked import (
+            _key_chunk_stream,
+            as_chunk_source,
+        )
+        from mpi_k_selection_tpu.streaming.pipeline import validate_pipeline_depth
+
+        pipeline_depth = validate_pipeline_depth(pipeline_depth)
+        src = as_chunk_source(source)
+        with _key_chunk_stream(
+            src, self.dtype, pipeline_depth=pipeline_depth, timer=timer
+        ) as kc:
+            for keys, _ in kc:
+                # device chunks arrive as device keys (bitwise twins of the
+                # host transform; the f64-on-TPU route already resolved to
+                # host-exact keys inside the iterator) — land them host-side
+                # for the bincount accumulator
+                if not isinstance(keys, np.ndarray):
+                    keys = np.asarray(keys)
+                self._update_keys(keys)
         return self
 
     def _fold_deep_histogram(self, deep: np.ndarray) -> None:

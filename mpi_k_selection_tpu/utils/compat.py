@@ -27,6 +27,9 @@ shim                 current jax                   0.4.x fallback
 struct``             vma=...)``                    keyword (always empty)
 ``pvary``            ``jax.lax.pcast(..,           identity (replication
                      to="varying")``               is check_rep's job)
+``process_           ``jax.experimental.           same location on 0.4.x;
+allgather``          multihost_utils.              resolved here so a future
+                     process_allgather``           move is one shim edit
 ===================  ============================  =========================
 """
 
@@ -92,6 +95,18 @@ def shape_dtype_struct(shape, dtype, *, vma: frozenset = frozenset()):
     if vma:
         return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
     return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def process_allgather(x, *, tiled: bool = False):
+    """``jax.experimental.multihost_utils.process_allgather`` — one DCN
+    gather of a host-local value across every process in the job; the
+    result (leading axis = process count when untiled) is identical on all
+    hosts. Single-process jobs get a length-1 leading axis. Resolved here
+    (not at call sites) so a future relocation of multihost_utils is one
+    shim edit, per the KSL006 discipline."""
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.process_allgather(x, tiled=tiled)
 
 
 def pvary(value, axes):

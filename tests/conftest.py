@@ -20,6 +20,8 @@ import jax  # noqa: E402
 # mesh regardless, so override the config directly as well.
 jax.config.update("jax_platforms", "cpu")
 
+import threading  # noqa: E402
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
@@ -27,3 +29,22 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.default_rng(1234)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_pipeline_threads():
+    """Every streaming-pipeline producer thread must be joined by the time
+    its descent pass returns — normally AND when the consumer raises
+    (drifting source, dtype mismatch). A thread surviving a test is a
+    shutdown bug in streaming/pipeline.py, not test noise."""
+    yield
+    from mpi_k_selection_tpu.streaming.pipeline import THREAD_NAME_PREFIX
+
+    stragglers = [
+        t for t in threading.enumerate()
+        if t.name.startswith(THREAD_NAME_PREFIX)
+    ]
+    for t in stragglers:  # grace for a close() racing the fixture
+        t.join(timeout=5.0)
+    alive = [t.name for t in stragglers if t.is_alive()]
+    assert not alive, f"leaked streaming-pipeline threads: {alive}"

@@ -380,6 +380,65 @@ def test_ksc103_trail_detects_structural_divergence():
     assert s1 == s2
 
 
+def test_ksc_contracts_cover_streaming_ingest():
+    """ROADMAP item: the double-buffer ingest path is on the contract
+    grid — both KSC102 (counter widths across the device/host histogram
+    boundary) and KSC103 (trail stability) trace it at two chunk sizes."""
+    from mpi_k_selection_tpu.analysis.jaxpr_checks import (
+        _STREAMING_INGEST_SIZES,
+        _streaming_ingest_cases,
+    )
+
+    cases = _streaming_ingest_cases()
+    assert len(_STREAMING_INGEST_SIZES) == 2
+    assert len(cases) >= 2  # single-prefix pass 0 + multi-prefix shared sweep
+    assert all("streaming" in label for _, label, *_ in cases)
+    assert {path for path, *_ in cases} == {
+        "mpi_k_selection_tpu/streaming/chunked.py"
+    }
+
+
+def test_ksc103_streaming_ingest_trail_stable_across_chunk_sizes():
+    """The property itself, independent of the check plumbing: the device
+    ingest programs trace to identical primitive trails at the two pow2
+    staging buckets (streaming/pipeline.py pads every staged chunk to its
+    bucket, so these are the shapes the pipelined descent actually runs)."""
+    import jax
+
+    from mpi_k_selection_tpu.analysis.jaxpr_checks import (
+        _primitive_trail,
+        _streaming_ingest_cases,
+    )
+
+    for _, label, fn, dt, (n1, n2) in _streaming_ingest_cases():
+        t1 = _primitive_trail(jax.make_jaxpr(fn)(jax.ShapeDtypeStruct((n1,), dt)))
+        t2 = _primitive_trail(jax.make_jaxpr(fn)(jax.ShapeDtypeStruct((n2,), dt)))
+        assert t1 == t2, label
+
+
+def test_ksc102_streaming_host_merge_is_int64():
+    """The host side of the KSC102 streaming boundary: per-chunk histograms
+    handed to the cross-chunk merge are int64 for every route — host
+    counting, device single-prefix, device multi-prefix, and the pipelined
+    staged buffer (whose pad correction must also stay in int64)."""
+    import numpy as np
+
+    from mpi_k_selection_tpu.streaming.chunked import _chunk_histograms
+    from mpi_k_selection_tpu.streaming.pipeline import stage_keys
+
+    kdt = np.dtype(np.uint32)
+    probe = np.arange(100, dtype=np.uint32)  # non-pow2: staged path pads
+    for mk, method in [
+        (lambda: probe, "numpy"),
+        (lambda: probe, "scatter"),
+        (lambda: stage_keys(probe), "scatter"),
+    ]:
+        single = _chunk_histograms(mk(), 24, 8, [None], method, kdt)
+        multi = _chunk_histograms(mk(), 16, 8, [0, 3], method, kdt)
+        for h in list(single.values()) + list(multi.values()):
+            assert np.dtype(h.dtype) == np.dtype(np.int64)
+
+
 # ---------------------------------------------------------------------------
 # CLI + exit codes
 

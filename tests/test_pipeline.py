@@ -1,0 +1,377 @@
+"""Pipelined streaming ingest (streaming/pipeline.py).
+
+The acceptance contract: every answer the pipelined path
+(``pipeline_depth >= 1``) produces is BIT-identical to the synchronous
+oracle (``pipeline_depth=0``) — host chunks, device chunks, ragged final
+chunks, staged pow2 padding, the host-exact 64-bit and float64 routes —
+and every error the synchronous path raises (dtype drift, replay
+instability, oversized chunks) still raises with chunks in flight, with
+the producer thread joined on every exit path (the autouse conftest
+fixture asserts no ``ksel-pipeline`` thread survives any test here).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mpi_k_selection_tpu.backends import seq
+from mpi_k_selection_tpu.streaming import (
+    streaming_kselect,
+    streaming_kselect_many,
+    streaming_rank_certificate,
+)
+from mpi_k_selection_tpu.streaming import pipeline as pl
+from mpi_k_selection_tpu.streaming.chunked import _chunk_histograms
+from mpi_k_selection_tpu.utils.profiling import PhaseTimer
+
+
+def _chunks(x, nchunks):
+    return [np.ascontiguousarray(c) for c in np.array_split(x, nchunks)]
+
+
+def _ints(rng, n, dtype=np.int32):
+    return rng.integers(-(2**31), 2**31, size=n, dtype=np.int64).astype(dtype)
+
+
+# -- bit-equality with the synchronous oracle --------------------------------
+
+
+@pytest.mark.parametrize("depth", [1, 2, 3])
+def test_pipeline_bit_equal_host_chunks(depth, rng):
+    x = _ints(rng, 1 << 14)
+    chunks = _chunks(x, 8)
+    ks = [1, 137, x.size // 2, x.size]
+    sync = streaming_kselect_many(chunks, ks, pipeline_depth=0)
+    assert sync == [seq.kselect_sort(x, k) for k in ks]
+    assert streaming_kselect_many(chunks, ks, pipeline_depth=depth) == sync
+
+
+def test_pipeline_bit_equal_device_chunks(rng):
+    import jax.numpy as jnp
+
+    x = _ints(rng, 1 << 14)
+    dchunks = [jnp.asarray(c) for c in _chunks(x, 8)]
+    k = 4321
+    sync = streaming_kselect(dchunks, k, pipeline_depth=0)
+    assert streaming_kselect(dchunks, k, pipeline_depth=2) == sync == seq.kselect_sort(x, k)
+
+
+def test_pipeline_slow_source_bit_equal(rng):
+    """An artificially slow producer (sleep per chunk) exercises real
+    consumer-side waiting; answers stay bit-equal to the synchronous
+    path for host AND device chunk streams."""
+    import jax.numpy as jnp
+
+    x = _ints(rng, 1 << 13)
+    host = _chunks(x, 6)
+    dev = [jnp.asarray(c) for c in host]
+
+    def slow(parts):
+        def source():
+            for c in parts:
+                time.sleep(0.002)
+                yield c
+
+        return source
+
+    k = x.size // 3
+    want = seq.kselect_sort(x, k)
+    for parts in (host, dev):
+        sync = streaming_kselect(slow(parts), k, pipeline_depth=0)
+        assert streaming_kselect(slow(parts), k, pipeline_depth=3) == sync == want
+
+
+def test_pipeline_ragged_final_chunk_staged_padding(rng):
+    """Non-pow2 chunk sizes force the staged pow2 padding + host-side pad
+    correction; a ragged final chunk exercises a second bucket size. Forced
+    device method so staging actually engages on the CPU backend."""
+    x = _ints(rng, 3 * 1000 + 537)  # chunks of 1000,1000,1000,537
+    chunks = [x[:1000], x[1000:2000], x[2000:3000], x[3000:]]
+    for k in (1, 1700, x.size):
+        sync = streaming_kselect(chunks, k, hist_method="scatter", pipeline_depth=0)
+        got = streaming_kselect(chunks, k, hist_method="scatter", pipeline_depth=2)
+        assert got == sync == seq.kselect_sort(x, k)
+
+
+def test_pipeline_empty_chunks_skipped(rng):
+    x = _ints(rng, 257)
+    chunks = [x[:100], np.empty(0, np.int32), x[100:], np.empty(0, np.int32)]
+    assert streaming_kselect(chunks, 19, pipeline_depth=2) == seq.kselect_sort(x, 19)
+    with pytest.raises(ValueError, match="non-empty"):
+        streaming_kselect([np.empty(0, np.int32)], 1, pipeline_depth=2)
+
+
+def test_pipeline_64bit_host_exact_route_no_x64(rng):
+    import jax
+
+    assert not jax.config.jax_enable_x64
+    x = rng.integers(-(2**62), 2**62, size=1 << 13, dtype=np.int64)
+    k = x.size // 2
+    sync = streaming_kselect(_chunks(x, 8), k, pipeline_depth=0)
+    got = streaming_kselect(_chunks(x, 8), k, pipeline_depth=2)
+    assert got == sync == seq.kselect_sort(x, k)
+
+
+def test_pipeline_64bit_device_chunks_under_x64(rng):
+    """jax's enable_x64 context is thread-local: the producer thread must
+    inherit the consumer's mode, or encoding 64-bit DEVICE chunks in the
+    worker raises where the synchronous path succeeds."""
+    from mpi_k_selection_tpu.utils import x64
+
+    x = rng.integers(-(2**62), 2**62, size=1 << 12, dtype=np.int64)
+    k = x.size // 2
+    with x64.enable_x64():
+        import jax.numpy as jnp
+
+        dchunks = [jnp.asarray(c) for c in _chunks(x, 8)]
+        sync = streaming_kselect(dchunks, k, pipeline_depth=0)
+        got = streaming_kselect(dchunks, k, pipeline_depth=2)
+    assert got == sync == seq.kselect_sort(x, k)
+
+
+def test_pipeline_f64_host_exact_route(rng):
+    x = rng.standard_normal(1 << 13)  # float64
+    k = x.size // 2
+    sync = streaming_kselect(_chunks(x, 8), k, pipeline_depth=0)
+    got = streaming_kselect(_chunks(x, 8), k, pipeline_depth=2)
+    assert got == sync == seq.kselect_sort(x, k)
+
+
+def test_pipeline_tiny_budget_multi_prefix(rng):
+    # a tiny collect budget drives deep multi-prefix passes — the staged
+    # shared-sweep path — through several pipeline generations
+    x = _ints(rng, 1 << 14)
+    chunks = _chunks(x, 8)
+    ks = [7, x.size // 4, x.size // 2, x.size - 3]
+    sync = streaming_kselect_many(chunks, ks, collect_budget=64, pipeline_depth=0)
+    got = streaming_kselect_many(chunks, ks, collect_budget=64, pipeline_depth=2)
+    assert got == sync == [seq.kselect_sort(x, k) for k in ks]
+
+
+def test_pipeline_certificate_matches_sync(rng):
+    x = _ints(rng, 1 << 13)
+    chunks = _chunks(x, 8)
+    v = int(np.sort(x)[x.size // 2])
+    sync = streaming_rank_certificate(chunks, v, pipeline_depth=0)
+    assert streaming_rank_certificate(chunks, v, pipeline_depth=2) == sync
+
+
+# -- error propagation + shutdown -------------------------------------------
+
+
+def test_pipeline_dtype_mismatch_raises(rng):
+    x = _ints(rng, 64)
+    with pytest.raises(TypeError, match="one dtype"):
+        streaming_kselect([x, x.astype(np.float32)], 1, pipeline_depth=2)
+
+
+def test_pipeline_drifting_source_raises_and_joins(rng):
+    calls = [0]
+
+    def source():
+        calls[0] += 1
+        r = np.random.default_rng(calls[0])
+        for _ in range(4):  # several chunks keep the producer busy/ahead
+            yield r.integers(-(2**31), 2**31, size=1 << 11, dtype=np.int64).astype(
+                np.int32
+            )
+
+    with pytest.raises(RuntimeError, match="not replay-stable"):
+        streaming_kselect(source, 1 << 12, collect_budget=4, pipeline_depth=3)
+    # deterministic shutdown: the consumer-side raise unwound through the
+    # stream context manager, which joined the producer thread
+    assert not [
+        t for t in threading.enumerate()
+        if t.name.startswith(pl.THREAD_NAME_PREFIX)
+    ]
+
+
+def test_pipeline_source_exception_propagates(rng):
+    x = _ints(rng, 256)
+
+    def source():
+        yield x
+        raise OSError("disk gone")
+
+    with pytest.raises(OSError, match="disk gone"):
+        streaming_kselect(source, 5, pipeline_depth=2)
+
+
+def test_pipeline_depth_validation(rng):
+    x = _ints(rng, 64)
+    for bad in (-1, 1.5, "2", True, pl.MAX_PIPELINE_DEPTH + 1):
+        with pytest.raises(ValueError, match="pipeline_depth"):
+            streaming_kselect([x], 1, pipeline_depth=bad)
+    with pytest.raises(ValueError, match="pipeline_depth"):
+        pl.ChunkPipeline(lambda: iter([x]), depth=0)
+
+
+def test_pipeline_inherits_default_device(rng):
+    """jax.default_device is thread-local like enable_x64: staged buffers
+    must land on the CALLER's device, not wherever the fresh producer
+    thread defaults to."""
+    import jax
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        pytest.skip("needs the multi-device virtual mesh")
+    target = devs[-1]
+    x = _ints(rng, 1 << 12)
+    chunks = _chunks(x, 4)  # 1024-element pow2 chunks: staged unpadded
+    with jax.default_device(target):
+        pipe = pl.ChunkPipeline(
+            lambda: iter(chunks), depth=2, hist_method="scatter"
+        )
+        try:
+            n = 0
+            for keys, _ in pipe:
+                assert isinstance(keys, pl.StagedKeys)
+                assert next(iter(keys.data.devices())) == target
+                n += keys.size
+        finally:
+            pipe.close()
+    assert n == x.size
+
+
+def test_pipeline_depth_zero_spawns_no_thread(rng):
+    x = _ints(rng, 1 << 10)
+    before = {t.ident for t in threading.enumerate()}
+    streaming_kselect(_chunks(x, 4), 17, pipeline_depth=0)
+    new = [
+        t for t in threading.enumerate()
+        if t.ident not in before and t.name.startswith(pl.THREAD_NAME_PREFIX)
+    ]
+    assert not new
+
+
+# -- staged padding machinery ------------------------------------------------
+
+
+def test_bucket_elems_pow2_ceiling():
+    assert [pl._bucket_elems(n) for n in (1, 2, 3, 4, 5, 1000, 1024)] == [
+        1, 2, 4, 4, 8, 1024, 1024,
+    ]
+    # past 2^30 the pow2 ceiling would cross the 2^31 counter bound: unpadded
+    assert pl._bucket_elems((1 << 30) + 1) == (1 << 30) + 1
+
+
+def test_staged_histogram_pad_correction_exact(rng):
+    """Histogram a padded staged buffer and the raw keys: identical counts,
+    including the all-zero prefix (where pad keys land) and real zero keys
+    in the stream (the correction must not over-subtract)."""
+    from mpi_k_selection_tpu.utils import dtypes as _dt
+
+    kdt = np.dtype(np.uint32)
+    keys = _dt.np_to_sortable_bits(_ints(rng, 1000))  # non-pow2 -> pad 24
+    keys[:5] = 0  # real zero keys alongside the pad zeros
+    staged = pl.stage_keys(keys)
+    assert staged.pad == 24 and staged.size == 1000
+    staged.release()
+
+    ups = sorted({int(u) for u in (keys >> np.uint32(16))} | {0})[:4]
+
+    def hists(mk):
+        # a fresh staging per call: _chunk_histograms releases (donates)
+        # a staged buffer once its counts are host-side
+        one = _chunk_histograms(mk(), 24, 8, [None], "scatter", kdt)[None]
+        # multi-prefix at a deeper level, INCLUDING prefix 0 (pad-sensitive)
+        many = _chunk_histograms(mk(), 8, 8, ups, "scatter", kdt)
+        return one, many
+
+    got_one, got_many = hists(lambda: pl.stage_keys(keys))
+    want_one, want_many = hists(lambda: keys)
+    np.testing.assert_array_equal(got_one, want_one)
+    assert set(got_many) == set(want_many)
+    for p in want_many:
+        np.testing.assert_array_equal(got_many[p], want_many[p])
+
+
+def test_staged_keys_valid_slice_roundtrip(rng):
+    keys = np.arange(100, dtype=np.uint32) + 7
+    staged = pl.stage_keys(keys)
+    np.testing.assert_array_equal(np.asarray(staged.valid()), keys)
+    staged.release()  # idempotent / safe post-use
+
+
+# -- instrumentation ---------------------------------------------------------
+
+
+def test_ingest_hidden_frac_recorded_and_bounded(rng):
+    x = _ints(rng, 1 << 13)
+    timer = PhaseTimer()
+    got = streaming_kselect(
+        _chunks(x, 8), x.size // 2, pipeline_depth=2, timer=timer
+    )
+    assert got == seq.kselect_sort(x, x.size // 2)
+    frac = pl.ingest_hidden_frac(timer)
+    assert frac is not None and 0.0 <= frac <= 1.0
+    assert any(p in timer.phases for p in pl.INGEST_PHASES)
+    assert pl.STALL_PHASE in timer.phases
+
+
+def test_ingest_hidden_frac_none_for_sync_run(rng):
+    x = _ints(rng, 1 << 10)
+    timer = PhaseTimer()
+    streaming_kselect(_chunks(x, 4), 5, pipeline_depth=0, timer=timer)
+    assert pl.ingest_hidden_frac(timer) is None
+
+
+# -- sketch / quantile surfaces ----------------------------------------------
+
+
+def test_sketch_update_stream_matches_sequential(rng):
+    from mpi_k_selection_tpu.streaming import RadixSketch
+
+    x = _ints(rng, 1 << 13)
+    chunks = _chunks(x, 7)
+    want = RadixSketch(np.int32)
+    for c in chunks:
+        want.update(c)
+    assert RadixSketch(np.int32).update_stream(chunks, pipeline_depth=2) == want
+    assert RadixSketch(np.int32).update_stream(chunks, pipeline_depth=0) == want
+
+
+def test_streaming_quantiles_pipeline_surface(rng):
+    from mpi_k_selection_tpu import StreamingQuantiles
+    from mpi_k_selection_tpu.api import quantile_ranks
+
+    x = _ints(rng, 1 << 13)
+    chunks = _chunks(x, 8)
+    t = StreamingQuantiles(np.int32, pipeline_depth=2).update_stream(chunks)
+    t0 = StreamingQuantiles(np.int32, pipeline_depth=0)
+    for c in chunks:
+        t0.update(c)
+    assert t.sketch == t0.sketch
+    qs = [0.5, 0.99]
+    s = np.sort(x, kind="stable")
+    want = [s[k - 1] for k in quantile_ranks(qs, x.size)]
+    assert t.refine_quantiles(qs, chunks) == want
+    assert t0.refine_quantiles(qs, chunks) == want
+    with pytest.raises(ValueError, match="pipeline_depth"):
+        StreamingQuantiles(np.int32, pipeline_depth=-2)
+
+
+def test_cli_pipeline_depth_flag(capsys):
+    import json
+
+    from mpi_k_selection_tpu import cli
+
+    args = [
+        "--backend", "tpu", "--streaming", "--n", "60000",
+        "--chunk-elems", "9973", "--verify", "--check", "--json",
+    ]
+    rc = cli.main(args + ["--pipeline-depth", "2"])
+    assert rc == 0
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["extra"]["pipeline_depth"] == 2
+    assert rec["extra"]["exact_match"] is True
+    rc = cli.main(args + ["--pipeline-depth", "0"])
+    assert rc == 0
+    rec0 = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec0["extra"]["pipeline_depth"] == 0
+    assert rec0["answer"] == rec["answer"]
+    with pytest.raises(SystemExit):
+        cli.main(args + ["--pipeline-depth", "-3"])
+    capsys.readouterr()

@@ -453,6 +453,70 @@ def test_distributed_sketch_64bit_device_path_under_x64(rng):
     assert dsk == RadixSketch(np.int64).update(x)
 
 
+def test_dcn_sketch_merge_single_process_degenerate(rng):
+    """Multi-host sketch merge: in a single-process job the DCN merge is
+    the exact identity (no allgather, same object), and the virtual
+    8-device mesh does NOT count as process-spanning."""
+    from mpi_k_selection_tpu.parallel import dcn_merge_sketch, make_mesh
+    from mpi_k_selection_tpu.parallel.sketch import _mesh_spans_processes
+
+    x = rng.integers(-(2**31), 2**31, size=1 << 12, dtype=np.int64).astype(np.int32)
+    sk = RadixSketch(np.int32).update(x)
+    assert dcn_merge_sketch(sk) is sk
+    assert _mesh_spans_processes(make_mesh()) is False
+    # the 64-bit host fallback still lands on the degenerate single-process
+    # route end-to-end (the dcn wiring must not disturb it)
+    from mpi_k_selection_tpu.parallel import distributed_sketch
+
+    y = rng.integers(-(2**62), 2**62, size=(1 << 10) + 3, dtype=np.int64)
+    assert distributed_sketch(y) == RadixSketch(np.int64).update(y)
+
+
+def test_dcn_merge_decode_loop_multiprocess_simulated(rng):
+    """The multi-process fold itself (pack -> 32-bit wire -> unpack/fold),
+    exercised without a real multi-host job: gathered rows for three fake
+    processes — two with data, one empty (its extremes must be masked
+    out) — must fold to exactly the pairwise host merge."""
+    from mpi_k_selection_tpu.parallel.sketch import (
+        _pack_sketch_payload,
+        _split_u32,
+        _unpack_gathered_payloads,
+    )
+
+    x = rng.integers(-(2**31), 2**31, size=1 << 12, dtype=np.int64).astype(np.int32)
+    s1 = RadixSketch(np.int32).update(x[: 1 << 11])
+    s2 = RadixSketch(np.int32).update(x[1 << 11 :])
+    empty = RadixSketch(np.int32)
+    gathered = np.stack(
+        [_split_u32(_pack_sketch_payload(s)) for s in (s1, empty, s2)]
+    )
+    merged = _unpack_gathered_payloads(gathered, s1)
+    assert merged == s1.merge(s2) == RadixSketch(np.int32).update(x)
+    # all-empty job: a valid (empty) sketch, not a crash
+    allempty = _unpack_gathered_payloads(
+        np.stack([_split_u32(_pack_sketch_payload(empty))] * 2), empty
+    )
+    assert allempty == RadixSketch(np.int32)
+
+
+def test_dcn_wire_format_roundtrips_full_int64_range():
+    """The DCN payload ships int64 counts / uint64 keys as uint32 lo/hi
+    words so an x64-off process cannot truncate them: the packing must
+    round-trip the FULL width bit-exactly (the silent failure it prevents
+    is exactly the KSL002 class)."""
+    from mpi_k_selection_tpu.parallel.sketch import _join_u32, _split_u32
+
+    vals = np.asarray(
+        [0, 1, (1 << 31) - 1, 1 << 31, (1 << 32) + 7, (1 << 62) + 12345,
+         (1 << 63) - 1],
+        np.int64,
+    )
+    got = _join_u32(_split_u32(vals))
+    np.testing.assert_array_equal(got.astype(np.int64), vals)
+    keys = np.asarray([0, 1 << 63, ~np.uint64(0)], np.uint64)
+    np.testing.assert_array_equal(_join_u32(_split_u32(keys)), keys)
+
+
 def test_cli_streaming_mode(capsys):
     from mpi_k_selection_tpu import cli
 
