@@ -451,7 +451,18 @@ def bench_streaming_oc(on_tpu: bool):
     and `exact_match` REQUIRES the two answers be bit-identical. Exactness
     is proven by a streamed O(n) rank certificate (less < k <= leq); CPU
     CI runs a small config with a real host oracle on top (expect ~1x
-    speedup there — a CPU "device" shares the host the producer runs on)."""
+    speedup there — a CPU "device" shares the host the producer runs on).
+
+    When more than one local device exists, a SECOND record runs the same
+    stream with `devices=<all>` — chunks staged round-robin, one histogram
+    in flight per chip — reporting per-device throughput,
+    `ingest_hidden_frac`, and `device_scaling` (devices=1 wall /
+    multi-device wall), with `exact_match` requiring bit-equality against
+    both the sync oracle and the devices=1 answer. On the CPU CI mesh the
+    virtual devices all share one core, so scaling measures pure dispatch
+    overhead and lands WELL below 1x there (r6: ~0.2x) — the CI record
+    exists for the bit-equality contract; the real factor needs TPU
+    validation."""
     import numpy as np
 
     from mpi_k_selection_tpu.streaming.chunked import (
@@ -533,7 +544,60 @@ def bench_streaming_oc(on_tpu: bool):
         rec["vs_baseline"] = round(baseline_s / dt, 3) if exact else 0.0
         rec["baseline_seconds"] = round(baseline_s, 6)
     _emit(rec)
-    return bool(exact)
+    ok = bool(exact)
+
+    # --- multi-device config: the same stream, staged round-robin across
+    # every local device (devices=p, ISSUE 4) vs the devices=1 run above.
+    # `device_scaling` is pipelined-devices=1 wall / multi-device wall;
+    # `value` is PER-DEVICE throughput so rounds at different p stay
+    # comparable; exact_match REQUIRES the answer be bit-identical to both
+    # the sync oracle and the devices=1 pipelined run
+    import jax
+
+    ndev = len(jax.devices())
+    if ndev > 1:
+        # warm the per-device compile caches: executables are per committed
+        # device, so the warmup stream must carry >= ndev chunks for the
+        # round robin to touch EVERY slot (2 chunks would leave p-2 chips
+        # compiling inside the timed run)
+        warm_md = lambda: (gen(i) for i in range(ndev))
+        streaming_kselect(warm_md, chunk, pipeline_depth=2, devices=ndev,
+                          collect_budget=64)
+        timer_md = PhaseTimer()
+        t0 = time.perf_counter()
+        ans_md = streaming_kselect(
+            source, k, pipeline_depth=2, devices=ndev, timer=timer_md
+        )
+        md_s = time.perf_counter() - t0
+        hidden_md = ingest_hidden_frac(timer_md)
+        exact_md = int(ans_md) == int(ans_sync) == int(ans)
+        _emit(
+            {
+                "metric": (
+                    "kselect_streaming_oc_8b_int32_multidev"
+                    if on_tpu
+                    else "kselect_streaming_oc_multidev"
+                ),
+                "methodology": "hostgen-v2",
+                "value": round(n / md_s / ndev, 1) if exact_md else 0.0,
+                "unit": "elems/sec/chip",
+                "n": n,
+                "k": k,
+                "chunks": nchunks,
+                "chunk_elems": chunk,
+                "devices": ndev,
+                "pipeline_depth": 2,
+                "seconds": round(md_s, 6),
+                "singledev_seconds": round(dt, 6),
+                "device_scaling": round(dt / md_s, 3) if exact_md else 0.0,
+                "ingest_hidden_frac": (
+                    round(hidden_md, 4) if hidden_md is not None else 0.0
+                ),
+                "exact_match": bool(exact_md),
+            }
+        )
+        ok = ok and exact_md
+    return ok
 
 
 def bench_cgm_native():

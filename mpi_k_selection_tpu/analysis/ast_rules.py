@@ -129,12 +129,13 @@ def _path_endswith(mod: SourceModule, *suffixes: str) -> bool:
 
 
 def _is_test_file(mod: SourceModule) -> bool:
-    """Library-path rules (KSL001-KSL003) skip test files: tests assert
-    exact values and fail loudly where the library would silently
+    """Library-path rules (KSL001-KSL003, KSL007) skip test files: tests
+    assert exact values and fail loudly where the library would silently
     truncate/sync, and they legitimately poke internals (building a
-    `_Descent` directly, converting freshly-narrowed arrays). Tests stay
-    in scope for KSL004 (no raw clocks), KSL005 (tier-1 membership — a
-    tests-only rule) and KSL006 (version-sensitive jax attrs)."""
+    `_Descent` directly, converting freshly-narrowed arrays, staging to a
+    hand-picked device). Tests stay in scope for KSL004 (no raw clocks),
+    KSL005 (tier-1 membership — a tests-only rule) and KSL006
+    (version-sensitive jax attrs)."""
     p = pathlib.Path(mod.path).resolve()
     return p.name.startswith("test_") or "tests" in p.parts or p.name == "conftest.py"
 
@@ -472,3 +473,46 @@ class DirectVersionSensitiveJaxAttr(Rule):
                             "— moved across jax versions; use the "
                             "utils/compat.py shim"
                         )
+
+
+# ---------------------------------------------------------------------------
+# KSL007 — device_put in streaming/ without an explicit device/sharding
+
+
+@register
+class StreamingDevicePutWithoutDevice(Rule):
+    id = "KSL007"
+    title = "jax.device_put in streaming/ without an explicit device/sharding"
+    rationale = (
+        "A bare `jax.device_put(x)` commits nothing: the buffer lands on "
+        "the (thread-local) default device — device 0 for a fresh "
+        "producer thread. The multi-device staged ingest round-robins "
+        "chunks across `jax.devices()`; a staging call that drops the "
+        "device argument silently lands EVERY staged buffer on one chip "
+        "and the other p-1 idle through the pass with no error — the "
+        "exact bug class the `devices` knob exists to prevent. Every "
+        "`jax.device_put` under streaming/ must name its target (a "
+        "device, a sharding, or an explicit None for the documented "
+        "single-slot default path)."
+    )
+
+    _PUT_NAMES = {"jax.device_put", "device_put"}
+    _TARGET_KWARGS = {"device", "sharding"}
+
+    def check_module(self, mod: SourceModule):
+        p = pathlib.Path(mod.path).resolve().as_posix()
+        if "/streaming/" not in p or _is_test_file(mod):
+            return
+        for node in ast.walk(mod.tree):
+            if (
+                isinstance(node, ast.Call)
+                and dotted_name(node.func) in self._PUT_NAMES
+                and len(node.args) < 2
+                and not any(kw.arg in self._TARGET_KWARGS for kw in node.keywords)
+            ):
+                yield node.lineno, (
+                    f"`{dotted_name(node.func)}` without an explicit device/"
+                    "sharding argument — staged buffers silently pile onto "
+                    "one chip; pass the round-robin slot (or an explicit "
+                    "None for the single-slot default path)"
+                )

@@ -12,14 +12,18 @@ asserts a property every review round has had to re-derive by hand:
 - **KSC102 counter-width discipline**: histogram accumulators are int32
   only below the documented 2^31-population bound, int64 (x64) beyond,
   and `select_count_dtype` refuses the un-representable case loudly.
-  Covers the streaming device/host histogram boundary: per-chunk device
-  counts int32, the cross-chunk host merge int64 — at two chunk sizes.
+  Covers the streaming device/host histogram boundary: every per-chunk
+  device count program of the (multi-device) staged ingest — chunked
+  single-/multi-prefix and the sketch deep fold — stays int32, the
+  cross-chunk host merge int64, and the multi-device collect filter
+  stays a bool predicate — at two chunk sizes.
 - **KSC103 jaxpr stability across batch sizes**: the same kernel traced
   at nearby n produces the identical primitive sequence — a divergence
   means some Python-level branch depends on n in a way that recompiles
   per shape (the recompile-hazard class: jit caches are per-jaxpr).
-  Covers the streaming double-buffer ingest at two adjacent pow2 staging
-  buckets (the exact shapes streaming/pipeline.py pads chunks to).
+  Covers the staged-ingest device programs at two adjacent pow2 staging
+  buckets (the exact shapes streaming/pipeline.py pads chunks to — and
+  the programs every round-robin ingest device compiles per bucket).
 
 Checks report :class:`~mpi_k_selection_tpu.analysis.core.Finding`s
 against the module that owns the kernel; they have no line-level noqa
@@ -108,10 +112,15 @@ _STREAMING_INGEST_SIZES = (1 << 12, 1 << 13)
 
 
 def _streaming_ingest_cases():
-    """The device programs `streaming/chunked.py:_chunk_histograms` runs per
-    chunk — single-prefix (pass 0 / single-rank descent) and shared-sweep
-    multi-prefix (multi-rank descent) — with the streaming counter
-    discipline (per-chunk int32; the host merge promotes to int64)."""
+    """The per-chunk device programs of the (multi-device) staged ingest
+    that produce INT32 COUNT PARTIALS — single-prefix (pass 0 /
+    single-rank descent), shared-sweep multi-prefix (multi-rank descent),
+    and the sketch's deepest-level fold
+    (streaming/sketch.py:RadixSketch._dispatch_staged) — with the
+    streaming counter discipline (per-chunk device int32; the host merge
+    promotes to int64). With ``devices`` > 1 each program is dispatched
+    once per round-robin slot over the SAME pow2 staging buckets, so
+    per-bucket trail stability is also per-device compile stability."""
     import jax.numpy as jnp
     import numpy as np
 
@@ -142,6 +151,49 @@ def _streaming_ingest_cases():
             ),
             "uint32",
             _STREAMING_INGEST_SIZES,
+        ),
+        (
+            "mpi_k_selection_tpu/streaming/sketch.py",
+            "streaming sketch deep fold[uint32, rb=16]",
+            lambda u: masked_radix_histogram(
+                u, shift=16, radix_bits=16, prefix=None, method="scatter",
+                count_dtype=jnp.int32,  # per-chunk partial; host fold int64
+            ),
+            "uint32",
+            _STREAMING_INGEST_SIZES,
+        ),
+    ]
+
+
+def _streaming_collect_mask_cases():
+    """The survivor-collect filter program the multi-device collect pass
+    dispatches on each staged chunk's own device
+    (streaming/chunked.py:_collect_survivors): a shift-compare PREDICATE.
+    It must trace to a bool mask (an integer-typed compare would silently
+    widen per-device memory and change the gather semantics), and its
+    trail must be stable across chunk LENGTHS: unlike the histogram
+    programs, the runtime filter runs over ``StagedKeys.valid()`` — a
+    per-``n_valid`` slice, not the padded bucket — so the grid pairs a
+    pow2 bucket size with a ragged valid-slice size (each distinct length
+    still costs one XLA compile per device; the contract gates program
+    STRUCTURE keying on n, which would make that cost a recompile storm)."""
+    import jax
+
+    path = "mpi_k_selection_tpu/streaming/chunked.py"
+
+    def collect_mask(u):
+        return jax.lax.shift_right_logical(
+            u, u.dtype.type(16)
+        ) == u.dtype.type(3)
+
+    return [
+        (
+            path,
+            "streaming collect filter[uint32, mask]",
+            collect_mask,
+            "uint32",
+            # a staging bucket AND a ragged valid-slice length
+            (_STREAMING_INGEST_SIZES[0], _STREAMING_INGEST_SIZES[0] + 311),
         ),
     ]
 
@@ -284,7 +336,8 @@ def check_counter_width() -> list[Finding]:
             )
 
     # the streaming device/host histogram boundary, at two chunk sizes (the
-    # pipeline's adjacent pow2 staging buckets): the per-chunk DEVICE
+    # pipeline's adjacent pow2 staging buckets — and with devices > 1, the
+    # exact programs every round-robin slot compiles): the per-chunk DEVICE
     # accumulator stays int32 (a chunk never exceeds 2^31 elements — the
     # guard in streaming/chunked.py:_encode_chunk), and the HOST merge the
     # descent accumulates across chunks/passes is int64 regardless of x64,
@@ -292,15 +345,28 @@ def check_counter_width() -> list[Finding]:
     from mpi_k_selection_tpu.streaming.chunked import _chunk_histograms
 
     spath = "mpi_k_selection_tpu/streaming/chunked.py"
-    for _path, label, fn, dt, sizes in _streaming_ingest_cases():
+    for case_path, label, fn, dt, sizes in _streaming_ingest_cases():
         for n in sizes:
             out = jax.eval_shape(fn, _spec(n, dt))
             cdt = np.dtype(jnp.result_type(out)) if not hasattr(out, "dtype") else np.dtype(out.dtype)
             if cdt != np.dtype(np.int32):
                 findings.append(
-                    Finding("KSC102", spath, 0,
+                    Finding("KSC102", case_path, 0,
                             f"{label} n={n}: per-chunk device accumulator "
                             f"traced as {cdt}, want int32")
+                )
+    # the multi-device collect filter must stay a bool PREDICATE: an
+    # integer-typed compare would silently change the per-device gather's
+    # memory and masking semantics
+    for case_path, label, fn, dt, sizes in _streaming_collect_mask_cases():
+        for n in sizes:
+            out = jax.eval_shape(fn, _spec(n, dt))
+            cdt = np.dtype(jnp.result_type(out)) if not hasattr(out, "dtype") else np.dtype(out.dtype)
+            if cdt != np.dtype(np.bool_):
+                findings.append(
+                    Finding("KSC102", case_path, 0,
+                            f"{label} n={n}: survivor filter traced as "
+                            f"{cdt}, want bool")
                 )
     # host-merge side (numpy method — host-only, nothing touches a device):
     # both the single- and multi-prefix merge inputs must already be int64
@@ -367,8 +433,12 @@ def check_jaxpr_stability() -> list[Finding]:
     # the streaming double-buffer ingest traced at two chunk sizes
     # (adjacent pow2 staging buckets): a trail divergence would mean every
     # distinct chunk/bucket size compiles a fresh histogram program —
-    # defeating the pipeline's pad-to-bucket design outright
+    # defeating the pipeline's pad-to-bucket design outright. With the
+    # multi-device round robin, every ingest device compiles these same
+    # programs per bucket, so a divergence multiplies by p; the collect
+    # filter predicate is on the grid for the same reason
     cases += _streaming_ingest_cases()
+    cases += _streaming_collect_mask_cases()
     for path, label, fn, dt, (n1, n2) in cases:
         t1 = _primitive_trail(jax.make_jaxpr(fn)(_spec(n1, dt)))
         t2 = _primitive_trail(jax.make_jaxpr(fn)(_spec(n2, dt)))

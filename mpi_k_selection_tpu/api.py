@@ -258,9 +258,12 @@ def kselect_streaming(source, k, **kwargs):
     chunk *i+1* is produced, key-encoded and staged to the device on a
     background thread while chunk *i* histograms — pass
     ``pipeline_depth=0`` for the fully synchronous oracle (bit-identical
-    answers). See streaming/chunked.py:streaming_kselect for the full
+    answers). ``devices=p`` spreads the pipelined ingest round-robin
+    across p chips so p chunks histogram concurrently — answers stay
+    bit-identical for every device count (the host int64 merge drains in
+    chunk order). See streaming/chunked.py:streaming_kselect for the full
     option set (``radix_bits``, ``hist_method``, ``collect_budget``,
-    ``sketch``, ``pipeline_depth``, ``timer``)."""
+    ``sketch``, ``pipeline_depth``, ``timer``, ``devices``)."""
     from mpi_k_selection_tpu.streaming.chunked import streaming_kselect
 
     return streaming_kselect(source, k, **kwargs)
@@ -278,7 +281,10 @@ class StreamingQuantiles:
 
     ``pipeline_depth`` governs how chunked ingest (``update_stream``) and
     the exact refinement passes overlap production/encode/transfer with
-    compute (streaming/pipeline.py; 0 = synchronous, bit-identical)."""
+    compute (streaming/pipeline.py; 0 = synchronous, bit-identical).
+    ``devices`` spreads that ingest round-robin across chips (None/1 =
+    single device; answers and sketches stay bit-identical for every
+    device count — see streaming/chunked.py)."""
 
     def __init__(
         self,
@@ -287,11 +293,17 @@ class StreamingQuantiles:
         radix_bits: int = 4,
         levels: int = 4,
         pipeline_depth: int | None = None,
+        devices=None,
     ):
-        from mpi_k_selection_tpu.streaming.pipeline import validate_pipeline_depth
+        from mpi_k_selection_tpu.streaming.pipeline import (
+            resolve_stream_devices,
+            validate_pipeline_depth,
+        )
         from mpi_k_selection_tpu.streaming.sketch import RadixSketch
 
         self.pipeline_depth = validate_pipeline_depth(pipeline_depth)
+        resolve_stream_devices(devices)  # validate eagerly, like depth
+        self.devices = devices
         self.sketch = RadixSketch(dtype, radix_bits=radix_bits, levels=levels)
 
     @property
@@ -305,8 +317,12 @@ class StreamingQuantiles:
     def update_stream(self, source) -> "StreamingQuantiles":
         """Fold every chunk of a replayable/listed ``source`` in via the
         pipelined iterator (chunk *i+1* encoded in the background while
-        chunk *i* folds) — bit-identical to sequential ``update`` calls."""
-        self.sketch.update_stream(source, pipeline_depth=self.pipeline_depth)
+        chunk *i* folds; with ``devices`` > 1, each chunk's deepest-level
+        histogram counted on its round-robin device) — bit-identical to
+        sequential ``update`` calls."""
+        self.sketch.update_stream(
+            source, pipeline_depth=self.pipeline_depth, devices=self.devices
+        )
         return self
 
     def merge(self, other: "StreamingQuantiles") -> "StreamingQuantiles":
@@ -315,6 +331,7 @@ class StreamingQuantiles:
             radix_bits=self.sketch.radix_bits,
             levels=self.sketch.levels,
             pipeline_depth=self.pipeline_depth,
+            devices=self.devices,
         )
         out.sketch = self.sketch.merge(
             other.sketch if isinstance(other, StreamingQuantiles) else other
@@ -340,6 +357,7 @@ class StreamingQuantiles:
             radix_bits=self.sketch.radix_bits,
             sketch=self.sketch,
             pipeline_depth=self.pipeline_depth,
+            devices=self.devices,
         )
 
 

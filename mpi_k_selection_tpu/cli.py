@@ -68,7 +68,12 @@ def build_parser() -> argparse.ArgumentParser:
         "--distribute", choices=("auto", "never", "always"), default="auto",
         help="shard over all devices (tpu backend)",
     )
-    p.add_argument("--devices", type=int, default=None, help="mesh size cap")
+    p.add_argument(
+        "--devices", type=int, default=None,
+        help="mesh size cap; with --streaming, the number of chips the "
+        "pipelined ingest stages chunks onto round-robin (default 1; "
+        "answers bit-identical at every count)",
+    )
     p.add_argument(
         "--num-procs", type=int, default=4,
         help="process count for the mpi backend (reference: mpirun -np P)",
@@ -292,9 +297,16 @@ def _run_streaming(args):
     k = args.k if args.k is not None else max(1, n // 2)
     if not 1 <= k <= n:
         raise SystemExit(f"error: k={k} out of range [1, {n}]")
-    from mpi_k_selection_tpu.streaming.pipeline import validate_pipeline_depth
+    from mpi_k_selection_tpu.streaming.pipeline import (
+        resolve_stream_devices,
+        validate_pipeline_depth,
+    )
 
     depth = validate_pipeline_depth(args.pipeline_depth)
+    # --devices caps the round-robin ingest set (seq backend = host
+    # histograms, no devices to spread over)
+    devices = args.devices if args.backend != "seq" else None
+    n_ingest = len(resolve_stream_devices(devices))
     source = _chunk_source(args)
     # the seq backend answers from host histograms; tpu streams chunks
     # through the device kernels (ops/histogram.py resolves the method)
@@ -307,7 +319,8 @@ def _run_streaming(args):
 
     ptimer = profiling.PhaseTimer() if args.profile else None
     fn = lambda: kselect_streaming(
-        source, k, hist_method=hist_method, pipeline_depth=depth, timer=ptimer
+        source, k, hist_method=hist_method, pipeline_depth=depth, timer=ptimer,
+        devices=devices,
     )
     seconds, answer = time_fn(fn, repeats=args.repeats, warmup=0)
     record = ResultRecord(
@@ -318,12 +331,14 @@ def _run_streaming(args):
         algorithm="streaming-chunked",
         dtype=args.dtype,
         seconds=seconds,
-        n_devices=_device_count(args),
+        # streaming: the devices actually staged onto, not the host total
+        n_devices=n_ingest,
     )
     nchunks = -(-n // args.chunk_elems)
     record.extra["chunks"] = nchunks
     record.extra["chunk_elems"] = args.chunk_elems
     record.extra["pipeline_depth"] = depth
+    record.extra["ingest_devices"] = n_ingest
     if ptimer is not None and ptimer.phases:
         from mpi_k_selection_tpu.streaming.pipeline import ingest_hidden_frac
 
@@ -356,7 +371,9 @@ def _run_streaming(args):
         # no timer here: the profile snapshot above covers the solve only
         # (the report is labeled "concurrent with solve"), and phases
         # recorded after it would be silently dropped anyway
-        less, leq = streaming_rank_certificate(source, answer, pipeline_depth=depth)
+        less, leq = streaming_rank_certificate(
+            source, answer, pipeline_depth=depth, devices=devices
+        )
         cert_ok = less < k <= leq
         record.extra["rank_certificate"] = [less, leq]
         record.extra["certificate_ok"] = cert_ok

@@ -17,6 +17,13 @@ fallback routes (64-bit-no-x64, f64-on-TPU) only ever see local data —
 :func:`dcn_merge_sketch` finishes those with ONE ``process_allgather`` of
 the packed deepest-level counts (32-bit lanes, so x64-off processes
 cannot truncate them; single-process jobs are the degenerate identity).
+
+The STREAMING twin of this merge lives in ``streaming/sketch.py:
+RadixSketch.update_stream(devices=p)``: same deepest-level device
+histograms, same int32-partial -> host-int64 ``_fold_deep_histogram``
+discipline, but the partials arrive per staged chunk (round-robin over
+the ingest devices, merged in chunk order) instead of per shard through a
+psum — for data that is never resident as one sharded array.
 """
 
 from __future__ import annotations

@@ -16,7 +16,10 @@ Two cooperating pieces (see docs/API.md "Streaming / out-of-core"):
 - :mod:`pipeline` — double-buffered ingest for both: a background producer
   thread overlaps chunk *i+1*'s production / host key-encode / host->device
   staging with chunk *i*'s compute (``pipeline_depth`` knob, 0 =
-  synchronous oracle, bit-identical answers either way).
+  synchronous oracle, bit-identical answers either way). With the
+  ``devices`` knob > 1 the staging goes round-robin across chips and up to
+  p chunks histogram concurrently (one in-flight dispatch per device),
+  still bit-identical — the host int64 merge drains in chunk order.
 """
 
 from mpi_k_selection_tpu.streaming.chunked import (
@@ -29,7 +32,9 @@ from mpi_k_selection_tpu.streaming.pipeline import (
     DEFAULT_PIPELINE_DEPTH,
     ChunkPipeline,
     StagedKeys,
+    StagingPool,
     ingest_hidden_frac,
+    resolve_stream_devices,
 )
 from mpi_k_selection_tpu.streaming.sketch import RadixSketch
 
@@ -38,8 +43,10 @@ __all__ = [
     "DEFAULT_PIPELINE_DEPTH",
     "RadixSketch",
     "StagedKeys",
+    "StagingPool",
     "as_chunk_source",
     "ingest_hidden_frac",
+    "resolve_stream_devices",
     "streaming_kselect",
     "streaming_kselect_many",
     "streaming_rank_certificate",

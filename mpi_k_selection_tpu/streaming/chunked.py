@@ -30,6 +30,16 @@ producer thread runs chunk *i+1*'s production, host key-encode and
 host->device staging while chunk *i* histograms on device — see
 streaming/pipeline.py. ``pipeline_depth=0`` is the fully synchronous
 path, kept as the correctness oracle; both return bit-identical answers.
+
+With ``devices`` > 1 the pipelined passes also spread across chips: the
+producer stages chunk *j* onto ``devices[j % p]`` (round-robin) and the
+consumer keeps one histogram dispatch in flight per device
+(:class:`_HistogramWindow`), merging the per-device int32 partials into
+the host int64 accumulator strictly in chunk order — the pipelined twin
+of ``parallel/sketch.py:distributed_sketch``'s psum merge, and because
+the merge order is fixed (and int64 addition is exact), answers stay
+bit-identical for every device count. ``devices=1`` (or ``None``) is the
+single-device PR 3 path.
 """
 
 from __future__ import annotations
@@ -142,20 +152,23 @@ def _iter_key_chunks(src, dtype=None):
 
 @contextlib.contextmanager
 def _key_chunk_stream(
-    src, dtype=None, *, pipeline_depth=0, hist_method=None, timer=None
+    src, dtype=None, *, pipeline_depth=0, hist_method=None, timer=None,
+    devices=None,
 ):
     """Context-managed ``(keys, chunk)`` iterator: the synchronous
     generator at depth 0, a :class:`~mpi_k_selection_tpu.streaming.
     pipeline.ChunkPipeline` (background produce/encode/stage overlapped
-    with the consuming pass) at depth >= 1. The context manager guarantees
-    the producer thread is joined on EVERY exit path — normal exhaustion,
-    early exit, and consumer-side raises like the replay-stability check."""
+    with the consuming pass, staged round-robin over ``devices``) at
+    depth >= 1. The context manager guarantees the producer thread is
+    joined on EVERY exit path — normal exhaustion, early exit, and
+    consumer-side raises like the replay-stability check."""
     depth = _pl.validate_pipeline_depth(pipeline_depth)
     if depth == 0:
         yield _iter_key_chunks(src, dtype)
         return
     pipe = _pl.ChunkPipeline(
-        src, dtype, depth=depth, hist_method=hist_method, timer=timer
+        src, dtype, depth=depth, hist_method=hist_method, timer=timer,
+        devices=devices,
     )
     try:
         yield iter(pipe)
@@ -186,20 +199,28 @@ def resolve_stream_hist(hist_method: str, dtype) -> str:
     return hist_method
 
 
-def _chunk_histograms(keys, shift, radix_bits, prefixes, method, kdt):
-    """``{prefix: int64 histogram}`` of one chunk's digit at ``shift``, for
-    every prefix in ``prefixes`` (``None`` = no filter) — the chunk-side
-    work is paid ONCE and shared across prefixes: host chunks compute the
+def _dispatch_chunk_histograms(keys, shift, radix_bits, prefixes, method, kdt):
+    """DISPATCH one chunk's digit histogram(s) at ``shift`` for every
+    prefix in ``prefixes`` (``None`` = no filter) and return an in-flight
+    handle for :func:`_finish_chunk_histograms` — the chunk-side work is
+    paid ONCE and shared across prefixes: host chunks compute the
     digit/prefix arrays once, device chunks cross the tunnel once and stay
     on device for the counts (the whole point on TPU); only the
-    (2**radix_bits,) counts per prefix come back.
+    (2**radix_bits,) counts per prefix come back at finish time.
 
-    Pipelined passes hand in :class:`~mpi_k_selection_tpu.streaming.
-    pipeline.StagedKeys` — a pow2-padded, already-device-resident buffer.
-    The histogram runs over the WHOLE padded buffer (fixed shape, one
-    compile per bucket size) and the pad contribution is subtracted
-    host-side: pad keys are key-space 0, so they land in digit bucket 0
-    and only under the all-zero prefix — an exact integer correction."""
+    Device work is dispatched asynchronously on the chunk's OWN device
+    (jax async dispatch; :class:`~mpi_k_selection_tpu.streaming.pipeline.
+    StagedKeys` are committed to their round-robin slot, so up to one
+    dispatch per ingest device runs concurrently under
+    :class:`_HistogramWindow`). The ``"numpy"`` method computes host-side
+    immediately — there is nothing to overlap.
+
+    Pipelined passes hand in :class:`StagedKeys` — a pow2-padded,
+    already-device-resident buffer. The histogram runs over the WHOLE
+    padded buffer (fixed shape, one compile per bucket size) and the pad
+    contribution is subtracted host-side at finish: pad keys are key-space
+    0, so they land in digit bucket 0 and only under the all-zero prefix —
+    an exact integer correction."""
     staged = isinstance(keys, StagedKeys)
     if method == "numpy":
         if staged:  # pragma: no cover - staging only feeds device methods
@@ -210,12 +231,15 @@ def _chunk_histograms(keys, shift, radix_bits, prefixes, method, kdt):
         )
         nb = 1 << radix_bits
         if len(prefixes) == 1 and prefixes[0] is None:
-            return {None: np.bincount(dig, minlength=nb).astype(np.int64)}
+            return (None, {None: np.bincount(dig, minlength=nb).astype(np.int64)})
         up = k >> kdt.type(shift + radix_bits)
-        return {
-            p: np.bincount(dig[up == kdt.type(p)], minlength=nb).astype(np.int64)
-            for p in prefixes
-        }
+        return (
+            None,
+            {
+                p: np.bincount(dig[up == kdt.type(p)], minlength=nb).astype(np.int64)
+                for p in prefixes
+            },
+        )
     import jax.numpy as jnp
 
     from mpi_k_selection_tpu.ops.histogram import (
@@ -233,35 +257,74 @@ def _chunk_histograms(keys, shift, radix_bits, prefixes, method, kdt):
             method=method,
             count_dtype=jnp.int32,  # exact per chunk (chunk size < 2^31)
         )
-        out = {None: np.asarray(h).astype(np.int64)}
     else:
         # the shared-sweep primitive of the resident multi-rank descent: on
         # the pallas methods all K prefix queries ride ONE read of the chunk
         # (other methods fall back to K single-prefix sweeps — correct,
         # just K reads)
-        hk = np.asarray(
-            multi_masked_radix_histogram(
-                dk,
-                shift=shift,
-                radix_bits=radix_bits,
-                prefixes=np.asarray(prefixes, kdt),
-                method=method,
-                count_dtype=jnp.int32,
-            )
-        ).astype(np.int64)
+        h = multi_masked_radix_histogram(
+            dk,
+            shift=shift,
+            radix_bits=radix_bits,
+            prefixes=np.asarray(prefixes, kdt),
+            method=method,
+            count_dtype=jnp.int32,
+        )
+    return ((keys if staged else None, list(prefixes), h), None)
+
+
+def _finish_chunk_histograms(handle):
+    """Materialize one :func:`_dispatch_chunk_histograms` handle into the
+    ``{prefix: int64 histogram}`` dict: block on the device counts, widen
+    to the host int64 accumulator dtype, apply the exact pad correction,
+    and release (donate) the staged ring slot."""
+    inflight, done = handle
+    if done is not None:
+        return done
+    staged, prefixes, h = inflight
+    if len(prefixes) == 1 and prefixes[0] is None:
+        out = {None: np.asarray(h).astype(np.int64)}
+    else:
+        hk = np.asarray(h).astype(np.int64)
         out = {p: hk[i] for i, p in enumerate(prefixes)}
-    if staged:
-        if keys.pad:
+    if staged is not None:
+        if staged.pad:
             # pad keys are key-space 0: digit (0 >> shift) & mask == 0, and
             # they pass a prefix filter only when every upper bit is 0
-            for p, h in out.items():
+            for p, hist in out.items():
                 if p is None or int(p) == 0:
-                    h[0] -= keys.pad
+                    hist[0] -= staged.pad
         # the counts above are host-materialized (np.asarray blocked on
         # them), so the ring slot can be donated back eagerly instead of
         # waiting out the queue's references
-        keys.release()
+        staged.release()
     return out
+
+
+def _chunk_histograms(keys, shift, radix_bits, prefixes, method, kdt):
+    """Dispatch + finish in one step — the serial form the synchronous
+    (depth-0 / single-device) paths and the contract checks use."""
+    return _finish_chunk_histograms(
+        _dispatch_chunk_histograms(keys, shift, radix_bits, prefixes, method, kdt)
+    )
+
+
+class _HistogramWindow(_pl.InflightWindow):
+    """The descent's :class:`~mpi_k_selection_tpu.streaming.pipeline.
+    InflightWindow` specialization: ``push`` dispatches the chunk's
+    histogram(s) and returns a list of ZERO or ONE finished
+    ``{prefix: int64 hist}`` dicts, merged by the callers strictly in
+    chunk order (int64 addition is exact and order-invariant anyway — the
+    window's fixed FIFO order is belt and braces, and keeps the
+    replay-stability diagnostics reproducible)."""
+
+    def __init__(self, window: int):
+        super().__init__(window, _finish_chunk_histograms)
+
+    def push(self, keys, shift, radix_bits, prefixes, method, kdt):
+        return super().push(
+            _dispatch_chunk_histograms(keys, shift, radix_bits, prefixes, method, kdt)
+        )
 
 
 def _np_walk(hist, kk, prefix, radix_bits):
@@ -275,7 +338,10 @@ def _np_walk(hist, kk, prefix, radix_bits):
     return prefix, kk, int(hist[b])
 
 
-def _collect_survivors(src, dtype, specs, *, pipeline_depth=0, timer=None):
+def _collect_survivors(
+    src, dtype, specs, *, pipeline_depth=0, timer=None, devices=None,
+    hist_method=None,
+):
     """One streamed pass collecting survivors for EVERY ``(resolved_bits,
     prefix) -> expected population`` spec at once — the shared finish of
     the multi-rank descent (a single-rank descent passes one spec). Keys
@@ -283,33 +349,45 @@ def _collect_survivors(src, dtype, specs, *, pipeline_depth=0, timer=None):
     filtered ON device (eager boolean indexing) so only survivors cross
     back to the host. Returns ``{spec: host uint key array}``.
 
-    The pipelined path overlaps produce/encode with the filtering but
-    never stages (``hist_method=None``): the collect's device work is a
-    data-dependent gather, not a fixed-shape kernel, so padding buys no
-    compile reuse here."""
+    The single-device pipelined path overlaps produce/encode with the
+    filtering but never stages (``hist_method`` stays ``None``): the
+    collect's device work is a data-dependent gather, not a fixed-shape
+    kernel, so padding buys no compile reuse there. With > 1 ingest
+    device (and a device ``hist_method`` — the host-exact routes keep
+    filtering on host), chunks ARE staged round-robin so each device
+    filters its own resident chunks: the host->device transfer rides the
+    producer thread and only survivors cross back. Survivor order stays
+    the chunk order either way (and the final ``np.partition`` is
+    order-invariant over the collected multiset regardless)."""
     kdt = np.dtype(_dt.key_dtype(dtype))
     total_bits = _dt.key_bits(dtype)
+    devs = _pl.resolve_stream_devices(devices)
+    multi = len(devs) > 1 and _pl.validate_pipeline_depth(pipeline_depth) > 0
     out = {s: [] for s in specs}
     with _key_chunk_stream(
-        src, dtype, pipeline_depth=pipeline_depth, timer=timer
+        src, dtype, pipeline_depth=pipeline_depth, timer=timer,
+        hist_method=hist_method if multi else None,
+        devices=devs if multi else None,
     ) as kc:
         for keys, _ in kc:
-            if isinstance(keys, StagedKeys):  # pragma: no cover - defensive
-                keys = keys.valid()
-            host = isinstance(keys, np.ndarray)
+            staged = isinstance(keys, StagedKeys)
+            kv = keys.valid() if staged else keys
+            host = isinstance(kv, np.ndarray)
             for resolved, prefix in out:
                 shift = total_bits - resolved
                 if host:
-                    surv = keys[(keys >> kdt.type(shift)) == kdt.type(prefix)]
+                    surv = kv[(kv >> kdt.type(shift)) == kdt.type(prefix)]
                 else:
                     import jax
 
                     m = jax.lax.shift_right_logical(
-                        keys, keys.dtype.type(shift)
-                    ) == keys.dtype.type(prefix)
-                    surv = np.asarray(keys[m])  # eager boolean gather, device-side
+                        kv, kv.dtype.type(shift)
+                    ) == kv.dtype.type(prefix)
+                    surv = np.asarray(kv[m])  # eager boolean gather, device-side
                 if surv.size:
                     out[(resolved, prefix)].append(np.asarray(surv, kdt))
+            if staged:
+                keys.release()
     collected = {}
     for spec, parts in out.items():
         c = np.concatenate(parts) if parts else np.empty((0,), kdt)
@@ -339,6 +417,7 @@ def streaming_kselect(
     sketch=None,
     pipeline_depth: int = DEFAULT_PIPELINE_DEPTH,
     timer=None,
+    devices=None,
 ):
     """Exact k-th smallest (1-indexed) over a chunked stream.
 
@@ -362,6 +441,15 @@ def streaming_kselect(
     is bit-identical to. ``timer`` (a utils/profiling.PhaseTimer) collects
     the pipeline's produce/encode/stage/stall phases for
     :func:`~mpi_k_selection_tpu.streaming.pipeline.ingest_hidden_frac`.
+
+    ``devices`` spreads the pipelined ingest across chips (None/1 = the
+    single-device path; an int takes the first p of ``jax.devices()``, a
+    device sequence is used as given): staged chunks land round-robin and
+    up to p histograms run concurrently, with the host int64 merge drained
+    in chunk order — answers are bit-identical for EVERY device count and
+    depth. Multi-device staging engages only with ``pipeline_depth >= 1``
+    and a device histogram method (the host-exact 64-bit-no-x64 and
+    f64-on-TPU routes stay host-side and ignore extra devices).
     """
     return streaming_kselect_many(
         source,
@@ -372,6 +460,7 @@ def streaming_kselect(
         sketch=sketch,
         pipeline_depth=pipeline_depth,
         timer=timer,
+        devices=devices,
     )[0]
 
 
@@ -385,6 +474,7 @@ def streaming_kselect_many(
     sketch=None,
     pipeline_depth: int = DEFAULT_PIPELINE_DEPTH,
     timer=None,
+    devices=None,
 ):
     """Exact k-th smallest for EVERY (1-indexed) rank in ``ks``, sharing
     each streamed pass across ranks: the stream is replayed once per radix
@@ -393,11 +483,21 @@ def streaming_kselect_many(
     the same bucket share it). For out-of-core sources the replay is the
     dominant cost, so m quantiles over one stream cost roughly the passes
     of one. Per-rank semantics are exactly :func:`streaming_kselect`'s
-    (including its ``pipeline_depth``/``timer`` knobs); returns a list in
-    input order.
+    (including its ``pipeline_depth``/``timer``/``devices`` knobs);
+    returns a list in input order.
     """
     src = as_chunk_source(source)
     pipeline_depth = _pl.validate_pipeline_depth(pipeline_depth)
+    devs = _pl.resolve_stream_devices(devices)
+    # one in-flight histogram slot per ingest device; the synchronous
+    # (depth-0) oracle stays strictly serial regardless of the knob
+    window = len(devs) if pipeline_depth > 0 else 1
+    # None keeps the PR 3 uncommitted default-device staging; an explicit
+    # knob (even a single device) commits staged chunks to its slots
+    stream_kw = dict(
+        pipeline_depth=pipeline_depth, timer=timer,
+        devices=None if devices is None else devs,
+    )
     ks = [int(k) for k in ks]
     if not ks:
         return []
@@ -421,10 +521,8 @@ def streaming_kselect_many(
         # from the first chunk — nothing is produced just to be discarded
         dtype = None
         n = 0
-        with _key_chunk_stream(
-            src, pipeline_depth=pipeline_depth, hist_method=hist_method,
-            timer=timer,
-        ) as kc:
+        win = _HistogramWindow(window)
+        with _key_chunk_stream(src, hist_method=hist_method, **stream_kw) as kc:
             for keys, chunk in kc:
                 if dtype is None:
                     dtype = np.dtype(chunk.dtype)
@@ -438,10 +536,11 @@ def streaming_kselect_many(
                     method = resolve_stream_hist(hist_method, dtype)
                     shift0 = total_bits - radix_bits
                     hist = np.zeros((1 << radix_bits,), np.int64)
-                hist += _chunk_histograms(
-                    keys, shift0, radix_bits, [None], method, kdt
-                )[None]
                 n += int(keys.size)
+                for h in win.push(keys, shift0, radix_bits, [None], method, kdt):
+                    hist += h[None]
+            for h in win.drain():
+                hist += h[None]
         if n == 0:
             raise ValueError("streaming selection requires a non-empty stream")
         _validate_ks(ks, n)
@@ -462,14 +561,14 @@ def streaming_kselect_many(
         prefixes = sorted({st[0] for st in states if _active(st)})
         expected = {st[0]: st[3] for st in states if _active(st)}
         hists = {p: np.zeros((1 << radix_bits,), np.int64) for p in prefixes}
-        with _key_chunk_stream(
-            src, dtype, pipeline_depth=pipeline_depth, hist_method=method,
-            timer=timer,
-        ) as kc:
+        win = _HistogramWindow(window)
+        with _key_chunk_stream(src, dtype, hist_method=method, **stream_kw) as kc:
             for keys, _ in kc:
-                for p, h in _chunk_histograms(
-                    keys, shift, radix_bits, prefixes, method, kdt
-                ).items():
+                for hd in win.push(keys, shift, radix_bits, prefixes, method, kdt):
+                    for p, h in hd.items():
+                        hists[p] += h
+            for hd in win.drain():
+                for p, h in hd.items():
                     hists[p] += h
         for p in prefixes:
             # replay-stability check, mirroring _collect_survivors': this
@@ -495,7 +594,8 @@ def streaming_kselect_many(
             specs[(resolved, int(prefix))] = pop
     collected = (
         _collect_survivors(
-            src, dtype, specs, pipeline_depth=pipeline_depth, timer=timer
+            src, dtype, specs, pipeline_depth=pipeline_depth, timer=timer,
+            devices=None if devices is None else devs, hist_method=method,
         )
         if specs
         else {}
@@ -517,20 +617,37 @@ def streaming_kselect_many(
 
 
 def streaming_rank_certificate(
-    source, value, *, pipeline_depth: int = DEFAULT_PIPELINE_DEPTH, timer=None
+    source, value, *, pipeline_depth: int = DEFAULT_PIPELINE_DEPTH, timer=None,
+    devices=None,
 ):
     """``(#elements < value, #elements <= value)`` streamed — the O(n)
     exactness proof of utils/debug.py:rank_certificate without residency:
     an answer for rank k is exact iff ``less < k <= leq``. Comparisons run
     in key space (total order: ties, -0.0/+0.0 and NaN behave exactly like
     the selection itself). ``pipeline_depth`` >= 1 overlaps chunk
-    production/encode with the counting (no staging — the counts consume
-    keys wherever they already live)."""
+    production/encode with the counting (single-device: no staging — the
+    counts consume keys wherever they already live). ``devices`` > 1
+    stages chunks round-robin so each device counts its own resident
+    chunks, with the per-chunk int counts folded into the host int
+    accumulators in chunk order (integer addition — order-exact either
+    way); the host-exact 64-bit/f64-on-TPU routes keep counting on host."""
     src = as_chunk_source(source)
+    devs = _pl.resolve_stream_devices(devices)
+    multi = len(devs) > 1 and _pl.validate_pipeline_depth(pipeline_depth) > 0
     less = leq = 0
     vkey = None
+
+    def _finish_counts(handle):
+        staged, lt, le = handle
+        counts = (int(lt), int(le))
+        if staged is not None:
+            staged.release()
+        return counts
+
+    win = _pl.InflightWindow(len(devs), _finish_counts)
     with _key_chunk_stream(
-        src, pipeline_depth=pipeline_depth, timer=timer
+        src, pipeline_depth=pipeline_depth, timer=timer,
+        hist_method="auto" if multi else None, devices=devs if multi else None,
     ) as kc:
         for keys, chunk in kc:
             if vkey is None:
@@ -539,15 +656,25 @@ def streaming_rank_certificate(
                 vkey = _dt.np_to_sortable_bits(
                     np.asarray([value], np.dtype(chunk.dtype))
                 )[0]
-            if isinstance(keys, np.ndarray):
-                less += int(np.count_nonzero(keys < vkey))
-                leq += int(np.count_nonzero(keys <= vkey))
+            staged = isinstance(keys, StagedKeys)
+            kv = keys.valid() if staged else keys
+            if isinstance(kv, np.ndarray):
+                less += int(np.count_nonzero(kv < vkey))
+                leq += int(np.count_nonzero(kv <= vkey))
             else:
                 import jax.numpy as jnp
 
-                v = keys.dtype.type(vkey)
-                less += int(jnp.sum(keys < v))
-                leq += int(jnp.sum(keys <= v))
+                v = kv.dtype.type(vkey)
+                # dispatch both counts async on the chunk's own device;
+                # materialize FIFO once one count per device is in flight
+                for lt, le in win.push(
+                    (keys if staged else None, jnp.sum(kv < v), jnp.sum(kv <= v))
+                ):
+                    less += lt
+                    leq += le
+        for lt, le in win.drain():
+            less += lt
+            leq += le
     if vkey is None:
         raise ValueError("streaming_rank_certificate requires a non-empty stream")
     return less, leq

@@ -1,4 +1,5 @@
-"""Pipelined streaming ingest — double-buffered transfer/compute overlap.
+"""Pipelined streaming ingest — double-buffered transfer/compute overlap,
+round-robin across every ingest device.
 
 The synchronous chunked descent (streaming/chunked.py) is strictly serial:
 produce chunk *i* (source callable), key-encode it on the host
@@ -12,6 +13,15 @@ background producer thread runs chunk *i+1*'s production, host key-encode
 and host->device staging while the consumer (the descent) histograms chunk
 *i* on device.
 
+With ``devices`` > 1 the same discipline also applies across *chips*: the
+producer stages successive chunks round-robin onto the ingest device set
+(chunk *j* lands on ``devices[j % p]`` via an explicit
+``jax.device_put(..., device)``), so up to *p* chunks histogram
+concurrently — the pipelined twin of ``parallel/sketch.py:
+distributed_sketch``'s psum merge, with the per-device int32 partials
+merged into the host int64 accumulator in chunk order
+(streaming/chunked.py:_HistogramWindow).
+
 Design:
 
 - :class:`ChunkPipeline` — a bounded-queue producer/consumer pair. The
@@ -20,7 +30,8 @@ Design:
   (streaming/chunked.py:_encode_chunk — per-stream dtype validation, the
   2^31 per-chunk guard and the host-exact f64-on-TPU route are identical
   by construction), and, when the resolved histogram method is a device
-  method, stages host keys to the device eagerly.
+  method, stages host keys to the device eagerly — round-robin over the
+  resolved ``devices`` tuple.
 - :class:`StagedKeys` — a device-resident key buffer padded to a
   power-of-two bucket size, so the histogram kernel sees a handful of
   shapes and compiles once per bucket instead of once per ragged chunk.
@@ -28,6 +39,12 @@ Design:
   corrected host-side by an exact integer subtraction
   (streaming/chunked.py:_chunk_histograms) — bit-identical to the
   unpadded histogram.
+- :class:`StagingPool` — a small-allocator free-list for the host pad
+  buffers ``stage_keys`` fills before the transfer, keyed by
+  ``(bucket, dtype, device)``. Once ``device_put`` has landed (the
+  producer blocks on it), the host buffer is immediately reusable; the
+  pool hands it back to the next same-bucket chunk instead of paying a
+  fresh ``np.empty`` per chunk, every pass.
 - ``pipeline_depth`` bounds the queue, and with it the staging memory: at
   peak ``depth + 2`` encoded/staged chunks exist at once (``depth``
   queued, plus one the producer holds while blocked on a full queue, plus
@@ -52,6 +69,7 @@ fraction of ingest wall time the overlap actually hid.
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import dataclasses
 import itertools
@@ -98,6 +116,170 @@ def validate_pipeline_depth(depth) -> int:
     return d
 
 
+def resolve_stream_devices(devices):
+    """Resolve the ``devices`` ingest knob to a concrete device tuple.
+
+    - ``None`` -> ``(None,)``: the single-slot default-device path —
+      staging stays an UNCOMMITTED ``device_put`` honoring the caller's
+      (thread-local) ``jax.default_device``, bit-for-bit the PR 3
+      behavior.
+    - an int ``p >= 1`` -> the first ``min(p, len(jax.devices()))``
+      devices (the CLI's ``--devices`` cap semantics); ``1`` is the
+      explicit single-device form of the default path.
+    - a sequence of ``jax.Device`` objects -> used as given (order
+      defines the round-robin slots, and with it the deterministic
+      chunk->device assignment).
+
+    Every resolution is consumed on the CALLER's thread before the
+    producer starts, so the round-robin slot list is fixed for the whole
+    pass and the host int64 merge can drain results in chunk order —
+    answers are bit-identical for every device count.
+    """
+    if devices is None:
+        return (None,)
+    if isinstance(devices, bool):
+        raise ValueError(f"devices must be an int >= 1 or a device sequence, got {devices!r}")
+    if isinstance(devices, (int, np.integer)):
+        p = int(devices)
+        if p < 1:
+            raise ValueError(f"devices={p} out of range (need >= 1)")
+        import jax
+
+        devs = jax.devices()
+        return tuple(devs[: min(p, len(devs))])
+    if isinstance(devices, (list, tuple)):
+        devs = tuple(devices)
+        if not devs:
+            raise ValueError("devices sequence must not be empty")
+        for d in devs:
+            if not (hasattr(d, "platform") and hasattr(d, "id")):
+                raise ValueError(
+                    f"devices entries must be jax Device objects, got {d!r}"
+                )
+        return devs
+    raise ValueError(
+        f"devices must be None, an int >= 1, or a sequence of jax devices, "
+        f"got {type(devices).__name__!r}"
+    )
+
+
+class StagingPool:
+    """Free-list of host staging (pad) buffers, keyed by
+    ``(bucket, dtype, device)``.
+
+    ``stage_keys`` fills a pow2-padded host buffer per chunk before the
+    transfer; the buffer becomes reusable when the consumer ``release()``s
+    the staged slot (not at stage time — ``device_put`` may alias host
+    memory on the CPU backend). Streams are dominated by equal-size chunks
+    (every pass replays the same chunking), so without a pool every chunk
+    of every pass pays a fresh ``np.empty`` of up to 2^30 elements — pure
+    allocator churn. The pool retains up to ``max_per_key`` released
+    buffers per key and evicts oldest-first past ``max_bytes`` total, so
+    steady state is a small ring of resident buffers per distinct
+    (bucket, dtype, device) slot.
+
+    Thread-compatible (a lock guards the free lists): each pipeline's
+    producer is a single thread, but concurrent passes may share the
+    module-level pool.
+    """
+
+    def __init__(self, *, max_per_key: int = 4, max_bytes: int = 1 << 31):
+        self._lock = threading.Lock()
+        self._free: dict = {}  # key -> [np.ndarray, ...]
+        self._order: list = []  # insertion order of (key, nbytes) for eviction
+        self._bytes = 0
+        self.max_per_key = int(max_per_key)
+        self.max_bytes = int(max_bytes)
+        self.hits = 0
+        self.misses = 0
+
+    @staticmethod
+    def _key(bucket: int, dtype, device):
+        dev = None if device is None else (device.platform, device.id)
+        return (int(bucket), np.dtype(dtype).str, dev)
+
+    def acquire(self, bucket: int, dtype, device=None) -> np.ndarray:
+        """A ``bucket``-element host buffer of ``dtype`` — recycled when a
+        same-key buffer was released, freshly allocated otherwise."""
+        key = self._key(bucket, dtype, device)
+        with self._lock:
+            bufs = self._free.get(key)
+            if bufs:
+                buf = bufs.pop()
+                self._bytes -= buf.nbytes
+                self._order.remove((key, buf.nbytes))
+                self.hits += 1
+                return buf
+            self.misses += 1
+        return np.empty(int(bucket), np.dtype(dtype))
+
+    def release(self, buf: np.ndarray, device=None) -> None:
+        """Hand a staging buffer back for reuse (caller must be done with
+        its contents — the device copy has landed)."""
+        key = self._key(buf.shape[0], buf.dtype, device)
+        with self._lock:
+            bufs = self._free.setdefault(key, [])
+            if len(bufs) >= self.max_per_key:
+                return
+            bufs.append(buf)
+            self._order.append((key, buf.nbytes))
+            self._bytes += buf.nbytes
+            while self._bytes > self.max_bytes and self._order:
+                old_key, nbytes = self._order.pop(0)
+                old = self._free.get(old_key)
+                if old:
+                    old.pop(0)
+                    self._bytes -= nbytes
+
+    def clear(self) -> None:
+        with self._lock:
+            self._free.clear()
+            self._order.clear()
+            self._bytes = 0
+
+
+#: Module-level pool: staging buckets recur across passes (every pass
+#: replays the same chunking), so reuse across ChunkPipeline instances is
+#: where the churn fix pays the most.
+STAGING_POOL = StagingPool()
+
+
+class InflightWindow:
+    """FIFO window of in-flight device dispatches — at most ``window``
+    handles pending, finished strictly in push order.
+
+    The one multi-device consumption discipline, shared by the descent's
+    histogram merge (streaming/chunked.py:_HistogramWindow), the rank
+    certificate's count folds, and the sketch's deepest-level folds
+    (streaming/sketch.py:update_stream): dispatch per-chunk device work
+    asynchronously (one slot per ingest device), materialize the OLDEST
+    handle once the window fills, drain the stragglers at end of stream.
+    The strict FIFO order makes every host merge device-order-
+    deterministic: results fold in chunk order no matter which device
+    finishes first. With ``window=1`` every push finishes its own handle
+    immediately — exactly the serial single-device behavior.
+    """
+
+    def __init__(self, window: int, finish):
+        self._window = max(1, int(window))
+        self._finish = finish
+        self._q: collections.deque = collections.deque()
+
+    def push(self, handle) -> list:
+        """Enqueue a dispatch handle; returns a list of ZERO or ONE
+        finished results (a plain list, NOT a generator: the pop must
+        happen at call time even if a caller drops the result)."""
+        self._q.append(handle)
+        if len(self._q) >= self._window:
+            return [self._finish(self._q.popleft())]
+        return []
+
+    def drain(self):
+        """Finish every pending handle, oldest first."""
+        while self._q:
+            yield self._finish(self._q.popleft())
+
+
 @dataclasses.dataclass(frozen=True)
 class StagedKeys:
     """Device-resident key chunk, padded to a fixed power-of-two bucket.
@@ -111,6 +293,13 @@ class StagedKeys:
 
     data: object  # jax.Array, padded to bucket size
     n_valid: int
+    # host pad buffer to recycle into `pool` on release (None = none: the
+    # chunk was staged unpadded, or the buffer is pool-less). Held until
+    # release because device_put may ALIAS the host buffer (CPU backend
+    # zero-copy): reusing it while `data` lives would corrupt staged keys.
+    host_buf: object = None
+    pool: object = None
+    device: object = None
 
     @property
     def size(self) -> int:
@@ -128,13 +317,21 @@ class StagedKeys:
 
     def release(self) -> None:
         """Free the staging buffer eagerly (the ring slot's donation): safe
-        once every result depending on it has materialized host-side."""
+        once every result depending on it has materialized host-side. The
+        host pad buffer goes back to its :class:`StagingPool` free-list
+        here — not at stage time — because the device array may alias it.
+        Idempotent (the pool hand-back happens exactly once)."""
         delete = getattr(self.data, "delete", None)
         if delete is not None:
             try:
                 delete()
             except Exception:  # pragma: no cover - already consumed/donated
                 pass
+        if self.host_buf is not None and self.pool is not None:
+            self.pool.release(self.host_buf, self.device)
+            # frozen dataclass: clear via object.__setattr__ so a second
+            # release() cannot double-insert the buffer (aliasing hazard)
+            object.__setattr__(self, "host_buf", None)
 
 
 def _bucket_elems(n: int) -> int:
@@ -146,23 +343,35 @@ def _bucket_elems(n: int) -> int:
     return n if bucket >= 1 << 31 else bucket
 
 
-def stage_keys(keys: np.ndarray) -> StagedKeys:
-    """Pad host ``keys`` to their pow2 bucket and transfer to the default
-    device, blocking until the copy lands (that wait is the whole point:
-    it happens on the producer thread, not in the descent)."""
+def stage_keys(keys: np.ndarray, device=None, pool: StagingPool | None = None) -> StagedKeys:
+    """Pad host ``keys`` to their pow2 bucket and transfer to ``device``
+    (``None`` = the caller's default device, uncommitted — the single-slot
+    path; a concrete device commits the buffer there, the round-robin
+    path), blocking until the copy lands (that wait is the whole point: it
+    happens on the producer thread, not in the descent). The pad buffer is
+    drawn from ``pool`` (default: the module :data:`STAGING_POOL`) and
+    recycled when the consumer ``release()``s the staged slot — so
+    same-bucket chunks reuse a small ring of host buffers instead of
+    re-allocating every chunk."""
     import jax
 
     n = int(keys.shape[0])
     bucket = _bucket_elems(n)
     if bucket == n:
-        buf = keys
-    else:
-        buf = np.empty(bucket, keys.dtype)
-        buf[:n] = keys
-        buf[n:] = 0  # zero only the pad tail, not the whole bucket
-    data = jax.device_put(buf)
+        data = jax.device_put(keys, device)
+        data.block_until_ready()
+        return StagedKeys(data, n)
+    if pool is None:
+        pool = STAGING_POOL
+    buf = pool.acquire(bucket, keys.dtype, device)
+    buf[:n] = keys
+    buf[n:] = 0  # zero only the pad tail, not the whole bucket
+    data = jax.device_put(buf, device)
     data.block_until_ready()
-    return StagedKeys(data, n)
+    # the pad buffer is NOT recycled yet: device_put may alias host memory
+    # (CPU zero-copy), so it rides the StagedKeys and returns to the pool
+    # when the consumer release()s the slot
+    return StagedKeys(data, n, host_buf=buf, pool=pool, device=device)
 
 
 @dataclasses.dataclass
@@ -183,13 +392,24 @@ class ChunkPipeline:
     feeds: the producer resolves it per the stream dtype exactly like the
     consumer does (streaming/chunked.py:resolve_stream_hist) and stages
     host keys to the device only when a device method will consume them.
-    ``None`` disables staging (collect and certificate passes: their
-    device work is data-dependent gathers, not fixed-shape kernels).
+    ``None`` disables staging (single-device collect and certificate
+    passes: their device work is data-dependent gathers, not fixed-shape
+    kernels).
+
+    ``devices`` is the resolved ingest tuple
+    (:func:`resolve_stream_devices`): staged chunk *j* commits to
+    ``devices[j % p]`` with an explicit ``jax.device_put`` target —
+    round-robin, so the consumer can keep one histogram in flight per
+    device. ``(None,)`` (the default) is the single-slot uncommitted PR 3
+    path.
     """
 
     _ids = itertools.count()
 
-    def __init__(self, src, dtype=None, *, depth: int, hist_method=None, timer=None):
+    def __init__(
+        self, src, dtype=None, *, depth: int, hist_method=None, timer=None,
+        devices=None,
+    ):
         self._src = src
         self._dtype = None if dtype is None else np.dtype(dtype)
         self._depth = validate_pipeline_depth(depth)
@@ -200,6 +420,9 @@ class ChunkPipeline:
             )
         self._hist_method = hist_method
         self._timer = timer
+        # resolved on the CALLER's thread (jax.devices() may initialize the
+        # backend; the slot order must be fixed before the producer starts)
+        self._devices = resolve_stream_devices(devices)
         # jax's enable_x64 AND default_device context managers are
         # THREAD-LOCAL: capture the consumer's effective values here
         # (consumer thread) and re-establish them inside the producer, so
@@ -249,6 +472,7 @@ class ChunkPipeline:
 
         dtype = self._dtype
         method = None
+        slot = 0  # round-robin staging cursor over the resolved devices
         try:
             it = iter(self._src())
             while not self._stop.is_set():
@@ -268,7 +492,13 @@ class ChunkPipeline:
                     method = _chunked.resolve_stream_hist(self._hist_method, dtype)
                 if method not in (None, "numpy") and isinstance(keys, np.ndarray):
                     with _phase(self._timer, "pipeline.stage"):
-                        keys = stage_keys(keys)
+                        # the slot advances ONLY on staged chunks, so the
+                        # chunk->device assignment is a pure function of
+                        # the staged sequence — identical on every replay
+                        keys = stage_keys(
+                            keys, self._devices[slot % len(self._devices)]
+                        )
+                        slot += 1
                 # every consumer reads only `.dtype` off the companion (and
                 # only on the first chunk): a zero-length stand-in keeps the
                 # queue from pinning the full original chunk alongside its

@@ -47,6 +47,33 @@ from mpi_k_selection_tpu.utils import dtypes as _dt
 # fixed-size cap: 2^20 int64 counters = 8 MB for the deepest level
 _MAX_RESOLUTION_BITS = 20
 
+_staged_extremes_fn = None
+
+
+def _staged_extremes(data, n_valid):
+    """``(min, max)`` over the first ``n_valid`` keys of a padded staged
+    buffer, computed over the FULL bucket shape with the pad lanes masked
+    to the exact unsigned min/max identities — so the extremes program
+    compiles once per (bucket, dtype), like the histogram half, instead of
+    once per distinct chunk length (``n_valid`` rides as a traced scalar,
+    not a baked constant). Bitwise identical to min/max over the valid
+    slice: chunks are non-empty, so at least one unmasked lane wins."""
+    global _staged_extremes_fn
+    if _staged_extremes_fn is None:
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def fn(d, nv):
+            valid = jax.lax.iota(jnp.int32, d.shape[0]) < nv
+            return (
+                jnp.min(jnp.where(valid, d, ~jnp.zeros((), d.dtype))),
+                jnp.max(jnp.where(valid, d, jnp.zeros((), d.dtype))),
+            )
+
+        _staged_extremes_fn = fn
+    return _staged_extremes_fn(data, n_valid)
+
 
 class RadixSketch:
     """Mergeable multi-level radix-digit histogram over one dtype's streams."""
@@ -118,27 +145,52 @@ class RadixSketch:
         self.n += int(keys.size)
         return self
 
-    def update_stream(self, source, *, pipeline_depth=None, timer=None) -> "RadixSketch":
+    def update_stream(
+        self, source, *, pipeline_depth=None, timer=None, devices=None
+    ) -> "RadixSketch":
         """Fold EVERY chunk of a replayable/listed ``source`` in (one
         stream pass), drawing from the pipelined iterator: a background
         thread produces and key-encodes chunk *i+1* while chunk *i*'s
         deepest-level bincount folds in — the same overlap discipline as
         the chunked descent (streaming/pipeline.py). ``pipeline_depth``
         ``None`` takes the pipeline default; 0 is the synchronous path.
+
+        ``devices`` > 1 stages chunks round-robin across that many chips
+        and counts each chunk's DEEPEST-level histogram (plus key-space
+        extremes) on its own device, folding the per-device int32 partials
+        into the host int64 pyramid in chunk order — exactly how
+        ``parallel/sketch.py:distributed_sketch`` merges its psum lanes,
+        minus the collective (the partials ride the host accumulator
+        instead). The host-exact 64-bit-no-x64 and f64-on-TPU routes keep
+        counting on host regardless.
+
         Bit-identical to sequential :meth:`update` calls over the same
-        chunks. Returns ``self``."""
+        chunks, for every ``pipeline_depth`` x ``devices`` combination.
+        Returns ``self``."""
+        from mpi_k_selection_tpu.streaming import pipeline as _pl
         from mpi_k_selection_tpu.streaming.chunked import (
             _key_chunk_stream,
             as_chunk_source,
         )
-        from mpi_k_selection_tpu.streaming.pipeline import validate_pipeline_depth
 
-        pipeline_depth = validate_pipeline_depth(pipeline_depth)
+        pipeline_depth = _pl.validate_pipeline_depth(pipeline_depth)
+        devs = _pl.resolve_stream_devices(devices)
+        multi = len(devs) > 1 and pipeline_depth > 0
         src = as_chunk_source(source)
+        win = _pl.InflightWindow(len(devs), self._fold_staged)
         with _key_chunk_stream(
-            src, self.dtype, pipeline_depth=pipeline_depth, timer=timer
+            src, self.dtype, pipeline_depth=pipeline_depth, timer=timer,
+            # "scatter" handles the deepest level's 2**resolution_bits
+            # buckets (the same method distributed_sketch defaults to);
+            # resolve_stream_hist downgrades it to host counting exactly
+            # where the device would not be bit-exact
+            hist_method="scatter" if multi else None,
+            devices=devs if multi else None,
         ) as kc:
             for keys, _ in kc:
+                if isinstance(keys, _pl.StagedKeys):
+                    win.push(self._dispatch_staged(keys))
+                    continue
                 # device chunks arrive as device keys (bitwise twins of the
                 # host transform; the f64-on-TPU route already resolved to
                 # host-exact keys inside the iterator) — land them host-side
@@ -146,7 +198,50 @@ class RadixSketch:
                 if not isinstance(keys, np.ndarray):
                     keys = np.asarray(keys)
                 self._update_keys(keys)
+            for _ in win.drain():
+                pass
         return self
+
+    def _dispatch_staged(self, staged) -> tuple:
+        """Dispatch one staged chunk's deepest-level int32 histogram and
+        key-space extremes on ITS device (async); finished by
+        :meth:`_fold_staged` in chunk order."""
+        import jax.numpy as jnp
+
+        from mpi_k_selection_tpu.ops.histogram import masked_radix_histogram
+
+        deep = masked_radix_histogram(
+            staged.data,  # the whole padded bucket: fixed shape, one compile
+            shift=self.total_bits - self.resolution_bits,
+            radix_bits=self.resolution_bits,
+            prefix=None,
+            method="scatter",
+            count_dtype=jnp.int32,  # exact per chunk (chunk size < 2^31)
+        )
+        # extremes must not see the pad zeros — computed over the FULL
+        # bucket with the pad masked to the identities, so this half stays
+        # bucket-shaped (one compile per bucket) like the histogram half
+        dmin, dmax = _staged_extremes(staged.data, np.int32(staged.n_valid))
+        return staged, deep, dmin, dmax
+
+    def _fold_staged(self, handle) -> None:
+        """Materialize one :meth:`_dispatch_staged` handle into the host
+        int64 pyramid — the same int32-partial -> int64-accumulator merge
+        discipline as ``parallel/sketch.py:distributed_sketch`` (pad keys
+        are key-space 0: an exact subtraction from deep bucket 0)."""
+        staged, deep, dmin, dmax = handle
+        h = np.asarray(deep).astype(np.int64)
+        if staged.pad:
+            h[0] -= staged.pad
+        self._fold_deep_histogram(h)
+        kmin = self.kdt.type(np.asarray(dmin))
+        kmax = self.kdt.type(np.asarray(dmax))
+        if self._min_key is None or kmin < self._min_key:
+            self._min_key = kmin
+        if self._max_key is None or kmax > self._max_key:
+            self._max_key = kmax
+        self.n += staged.n_valid
+        staged.release()
 
     def _fold_deep_histogram(self, deep: np.ndarray) -> None:
         """Accumulate one deepest-level int64 histogram into every level

@@ -318,6 +318,67 @@ def test_ksl006_allowed_in_compat(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# KSL007 — device_put in streaming/ without an explicit device/sharding
+
+
+KSL007_POSITIVE = """
+    import jax
+
+    def stage(buf):
+        data = jax.device_put(buf)
+        data.block_until_ready()
+        return data
+"""
+
+KSL007_NEGATIVE = """
+    import jax
+
+    def stage_committed(buf, device):
+        return jax.device_put(buf, device)
+
+    def stage_kw(buf, device):
+        return jax.device_put(buf, device=device)
+
+    def stage_sharded(buf, sharding):
+        return jax.device_put(buf, sharding=sharding)
+
+    def stage_default(buf):
+        # an explicit None IS a declared target: the documented
+        # single-slot default-device path
+        return jax.device_put(buf, None)
+"""
+
+
+def test_ksl007_positive_in_streaming(tmp_path):
+    report = _lint_source(tmp_path, KSL007_POSITIVE, name="streaming/stage.py")
+    hits = [f for f in report.unsuppressed if f.rule == "KSL007"]
+    assert len(hits) == 1 and "device" in hits[0].message
+
+
+def test_ksl007_negative_explicit_targets(tmp_path):
+    report = _lint_source(tmp_path, KSL007_NEGATIVE, name="streaming/stage.py")
+    assert "KSL007" not in _rules_hit(report)
+
+
+def test_ksl007_quiet_outside_streaming(tmp_path):
+    # the rule gates the staged-ingest bug class, not device_put at large
+    # (tpu_smoke/test code legitimately uses default-device puts)
+    report = _lint_source(tmp_path, KSL007_POSITIVE, name="ops/stage.py")
+    assert "KSL007" not in _rules_hit(report)
+
+
+def test_ksl007_noqa(tmp_path):
+    src = KSL007_POSITIVE.replace(
+        "data = jax.device_put(buf)",
+        "data = jax.device_put(buf)  # ksel: noqa[KSL007] -- fixture justification",
+    )
+    report = _lint_source(tmp_path, src, name="streaming/stage.py")
+    assert "KSL007" not in _rules_hit(report)
+    sup = [f for f in report.findings if f.rule == "KSL007" and f.suppressed]
+    assert sup and sup[0].justification == "fixture justification"
+
+
+# ---------------------------------------------------------------------------
 # jaxpr contract checks (KSC101-KSC103) self-tests
 
 
@@ -383,19 +444,26 @@ def test_ksc103_trail_detects_structural_divergence():
 def test_ksc_contracts_cover_streaming_ingest():
     """ROADMAP item: the double-buffer ingest path is on the contract
     grid — both KSC102 (counter widths across the device/host histogram
-    boundary) and KSC103 (trail stability) trace it at two chunk sizes."""
+    boundary) and KSC103 (trail stability) trace it at two chunk sizes.
+    The multi-device round robin added the sketch deep-fold program and
+    the collect filter predicate to that grid."""
     from mpi_k_selection_tpu.analysis.jaxpr_checks import (
         _STREAMING_INGEST_SIZES,
+        _streaming_collect_mask_cases,
         _streaming_ingest_cases,
     )
 
     cases = _streaming_ingest_cases()
     assert len(_STREAMING_INGEST_SIZES) == 2
-    assert len(cases) >= 2  # single-prefix pass 0 + multi-prefix shared sweep
+    # single-prefix pass 0 + multi-prefix shared sweep + sketch deep fold
+    assert len(cases) >= 3
     assert all("streaming" in label for _, label, *_ in cases)
     assert {path for path, *_ in cases} == {
-        "mpi_k_selection_tpu/streaming/chunked.py"
+        "mpi_k_selection_tpu/streaming/chunked.py",
+        "mpi_k_selection_tpu/streaming/sketch.py",
     }
+    masks = _streaming_collect_mask_cases()
+    assert masks and all("collect" in label for _, label, *_ in masks)
 
 
 def test_ksc103_streaming_ingest_trail_stable_across_chunk_sizes():
@@ -469,10 +537,12 @@ def test_cli_exit_codes(tmp_path, capsys):
         ("KSL003", KSL003_POSITIVE, "mod.py"),
         ("KSL004", KSL004_POSITIVE, "mod.py"),
         ("KSL006", KSL006_POSITIVE, "mod.py"),
+        ("KSL007", KSL007_POSITIVE, "streaming/mod.py"),
     ],
 )
 def test_cli_exits_nonzero_on_each_positive_fixture(tmp_path, capsys, rule, src, name):
     f = tmp_path / name
+    f.parent.mkdir(parents=True, exist_ok=True)
     f.write_text(textwrap.dedent(src))
     assert lint_main([str(f), "--no-contracts", "--select", rule]) == 1
     capsys.readouterr()
@@ -492,7 +562,7 @@ def test_cli_exits_nonzero_on_ksl005_positive(tmp_path, capsys):
 def test_cli_list_rules(capsys):
     assert lint_main(["--list-rules"]) == 0
     out = capsys.readouterr().out
-    for rid in ("KSL001", "KSL005", "KSL006", "KSC101", "KSC103"):
+    for rid in ("KSL001", "KSL005", "KSL006", "KSL007", "KSC101", "KSC103"):
         assert rid in out
 
 

@@ -1,0 +1,357 @@
+"""Multi-device staged ingest (ISSUE 4): round-robin staging across
+`jax.devices()`.
+
+The acceptance contract: streaming answers are BIT-identical across
+``devices`` in {1, 2, max} x ``pipeline_depth`` in {0, 2} on the 8-device
+virtual CPU mesh (conftest.py) — heterogeneous chunk sizes, ragged final
+chunks, empty chunks, host-exact fallback routes, survivor collect and
+rank certificate included — with the host int64 merge drained in chunk
+order, one staged buffer per round-robin slot, and no producer thread
+surviving any pass (the autouse conftest fixture backstops every test
+here).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from mpi_k_selection_tpu.backends import seq
+from mpi_k_selection_tpu.streaming import (
+    RadixSketch,
+    streaming_kselect,
+    streaming_kselect_many,
+    streaming_rank_certificate,
+)
+from mpi_k_selection_tpu.streaming import pipeline as pl
+
+
+def _chunks(x, nchunks):
+    return [np.ascontiguousarray(c) for c in np.array_split(x, nchunks)]
+
+
+def _ints(rng, n, dtype=np.int32):
+    return rng.integers(-(2**31), 2**31, size=n, dtype=np.int64).astype(dtype)
+
+
+def _device_grid():
+    import jax
+
+    return sorted({1, 2, len(jax.devices())})
+
+
+# -- the determinism grid ----------------------------------------------------
+
+
+@pytest.mark.parametrize("depth", [0, 2])
+def test_grid_bit_identical_heterogeneous_chunks(depth, rng):
+    """Heterogeneous chunk sizes (np.array_split on a non-multiple) across
+    the full devices x depth grid, vs the devices=1 depth=0 oracle."""
+    x = _ints(rng, (1 << 14) + 311)
+    chunks = _chunks(x, 7)  # ragged: sizes differ by one across chunks
+    ks = [1, 137, x.size // 2, x.size]
+    oracle = streaming_kselect_many(chunks, ks, pipeline_depth=0, devices=1)
+    assert oracle == [seq.kselect_sort(x, k) for k in ks]
+    for devices in _device_grid():
+        got = streaming_kselect_many(
+            chunks, ks, pipeline_depth=depth, devices=devices
+        )
+        assert got == oracle, (devices, depth)
+
+
+def test_grid_bit_identical_ragged_final_chunk(rng):
+    """A short final chunk lands in a DIFFERENT pow2 staging bucket than
+    its predecessors: the round robin must keep chunk->device assignment
+    and pad correction exact across bucket changes."""
+    x = _ints(rng, 5 * 1000 + 537)
+    chunks = [x[i * 1000:(i + 1) * 1000] for i in range(5)] + [x[5000:]]
+    want = seq.kselect_sort(x, x.size // 2)
+    for devices in _device_grid():
+        got = streaming_kselect(
+            chunks, x.size // 2, hist_method="scatter",
+            pipeline_depth=2, devices=devices,
+        )
+        assert got == want, devices
+
+
+def test_grid_bit_identical_empty_chunks(rng):
+    """Empty chunks are no-ops and must NOT advance the round-robin slot
+    (the chunk->device assignment is a function of the staged sequence)."""
+    x = _ints(rng, 4096)
+    chunks = [
+        x[:1000], np.empty(0, np.int32), x[1000:2048],
+        np.empty(0, np.int32), x[2048:],
+    ]
+    want = seq.kselect_sort(x, 19)
+    for devices in _device_grid():
+        assert streaming_kselect(
+            chunks, 19, pipeline_depth=2, devices=devices
+        ) == want
+
+
+def test_grid_host_exact_64bit_route_ignores_devices(rng):
+    """64-bit keys without x64 resolve to host counting: the devices knob
+    must not push them onto a device (where jnp would truncate)."""
+    import jax
+
+    assert not jax.config.jax_enable_x64
+    x = rng.integers(-(2**62), 2**62, size=1 << 13, dtype=np.int64)
+    k = x.size // 2
+    want = seq.kselect_sort(x, k)
+    for devices in _device_grid():
+        got = streaming_kselect(
+            _chunks(x, 8), k, pipeline_depth=2, devices=devices
+        )
+        assert got == want, devices
+
+
+def test_grid_tiny_budget_multi_prefix_and_collect(rng):
+    """A tiny collect budget drives deep shared-sweep passes AND the
+    multi-device survivor collect (each device filters its own resident
+    chunks) through several pipeline generations."""
+    x = _ints(rng, 1 << 14)
+    chunks = _chunks(x, 9)
+    ks = [7, x.size // 4, x.size // 2, x.size - 3]
+    oracle = streaming_kselect_many(chunks, ks, collect_budget=64, pipeline_depth=0)
+    for devices in _device_grid():
+        got = streaming_kselect_many(
+            chunks, ks, collect_budget=64, pipeline_depth=2, devices=devices
+        )
+        assert got == oracle, devices
+
+
+def test_certificate_grid_matches_sync(rng):
+    x = _ints(rng, 1 << 13)
+    chunks = _chunks(x, 8)
+    v = int(np.sort(x)[x.size // 2])
+    oracle = streaming_rank_certificate(chunks, v, pipeline_depth=0)
+    for devices in _device_grid():
+        got = streaming_rank_certificate(
+            chunks, v, pipeline_depth=2, devices=devices
+        )
+        assert got == oracle, devices
+
+
+def test_sketch_update_stream_devices_bit_identical(rng):
+    """The multi-device deepest-level device fold must produce a sketch ==
+    sequential host update() accumulation (counts, n, AND key-space
+    extremes — the pad zeros must not leak into min)."""
+    x = _ints(rng, (1 << 13) + 77)
+    chunks = _chunks(x, 7)
+    want = RadixSketch(np.int32)
+    for c in chunks:
+        want.update(c)
+    for devices in _device_grid():
+        got = RadixSketch(np.int32).update_stream(
+            chunks, pipeline_depth=2, devices=devices
+        )
+        assert got == want, devices
+
+
+def test_streaming_quantiles_devices_surface(rng):
+    from mpi_k_selection_tpu import StreamingQuantiles
+    from mpi_k_selection_tpu.api import quantile_ranks
+
+    x = _ints(rng, 1 << 13)
+    chunks = _chunks(x, 8)
+    t = StreamingQuantiles(np.int32, devices=2).update_stream(chunks)
+    t1 = StreamingQuantiles(np.int32, pipeline_depth=0)
+    for c in chunks:
+        t1.update(c)
+    assert t.sketch == t1.sketch
+    assert t.merge(t1).devices == 2  # knob survives the (pure) merge
+    qs = [0.5, 0.99]
+    s = np.sort(x, kind="stable")
+    want = [s[k - 1] for k in quantile_ranks(qs, x.size)]
+    assert t.refine_quantiles(qs, chunks) == want
+    with pytest.raises(ValueError, match="devices"):
+        StreamingQuantiles(np.int32, devices=0)
+
+
+# -- round-robin placement ---------------------------------------------------
+
+
+def test_round_robin_places_chunks_on_successive_devices(rng):
+    import jax
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        pytest.skip("needs the multi-device virtual mesh")
+    chunks = _chunks(_ints(rng, 6 * 1024), 6)  # pow2 chunks: staged unpadded
+    pipe = pl.ChunkPipeline(
+        lambda: iter(chunks), depth=2, hist_method="scatter", devices=devs
+    )
+    try:
+        seen = []
+        for keys, _ in pipe:
+            assert isinstance(keys, pl.StagedKeys)
+            seen.append(next(iter(keys.data.devices())))
+    finally:
+        pipe.close()
+    assert seen == [devs[i % len(devs)] for i in range(6)]
+
+
+def test_resolve_stream_devices_knob():
+    import jax
+
+    devs = jax.devices()
+    assert pl.resolve_stream_devices(None) == (None,)
+    assert pl.resolve_stream_devices(1) == (devs[0],)
+    assert pl.resolve_stream_devices(2) == tuple(devs[:2])
+    # caps at the available count (the CLI's --devices semantics)
+    assert pl.resolve_stream_devices(10**6) == tuple(devs)
+    assert pl.resolve_stream_devices([devs[-1]]) == (devs[-1],)
+    for bad in (0, -1, True, 1.5, "all", [], ["x"]):
+        with pytest.raises(ValueError):
+            pl.resolve_stream_devices(bad)
+    with pytest.raises(ValueError, match="devices"):
+        streaming_kselect([np.arange(4, dtype=np.int32)], 1, devices=-2)
+
+
+def test_depth_zero_stays_synchronous_oracle(rng):
+    """devices > 1 with pipeline_depth=0 must neither spawn a thread nor
+    stage: the synchronous oracle is untouched by the knob."""
+    x = _ints(rng, 1 << 10)
+    before = {t.ident for t in threading.enumerate()}
+    got = streaming_kselect(_chunks(x, 4), 17, pipeline_depth=0, devices=8)
+    assert got == seq.kselect_sort(x, 17)
+    new = [
+        t for t in threading.enumerate()
+        if t.ident not in before and t.name.startswith(pl.THREAD_NAME_PREFIX)
+    ]
+    assert not new
+
+
+# -- error paths with multi-device producers in flight -----------------------
+
+
+def test_drifting_source_raises_and_joins_multidevice(rng):
+    """The replay-stability raise must unwind cleanly with round-robin
+    staged buffers in flight on several devices — and join the producer
+    (the leaked-thread check of the ISSUE)."""
+    calls = [0]
+
+    def source():
+        calls[0] += 1
+        r = np.random.default_rng(calls[0])
+        for _ in range(8):  # enough chunks to fill every round-robin slot
+            yield r.integers(-(2**31), 2**31, size=1 << 11, dtype=np.int64).astype(
+                np.int32
+            )
+
+    with pytest.raises(RuntimeError, match="not replay-stable"):
+        streaming_kselect(
+            source, 1 << 12, collect_budget=4, pipeline_depth=3, devices=8
+        )
+    assert not [
+        t for t in threading.enumerate()
+        if t.name.startswith(pl.THREAD_NAME_PREFIX)
+    ]
+
+
+def test_source_exception_propagates_multidevice(rng):
+    x = _ints(rng, 2048)
+
+    def source():
+        yield x[:1024]
+        yield x[1024:]
+        raise OSError("disk gone")
+
+    with pytest.raises(OSError, match="disk gone"):
+        streaming_kselect(source, 5, pipeline_depth=2, devices=8)
+
+
+# -- staging-buffer free list ------------------------------------------------
+
+
+def test_staging_pool_reuses_released_buffers():
+    pool = pl.StagingPool()
+    keys = np.arange(1000, dtype=np.uint32)  # pads to the 1024 bucket
+    s1 = pl.stage_keys(keys, None, pool)
+    buf1 = s1.host_buf
+    assert buf1 is not None and buf1.shape[0] == 1024
+    assert pool.misses == 1 and pool.hits == 0
+    s1.release()
+    s1.release()  # idempotent: the buffer must enter the free list ONCE
+    s2 = pl.stage_keys(keys + 1, None, pool)
+    assert s2.host_buf is buf1  # recycled, not re-allocated
+    assert pool.hits == 1
+    np.testing.assert_array_equal(np.asarray(s2.valid()), keys + 1)
+    s2.release()
+
+
+def test_staging_pool_keys_by_bucket_dtype_device():
+    import jax
+
+    devs = jax.devices()
+    pool = pl.StagingPool()
+    a = pl.stage_keys(np.arange(1000, dtype=np.uint32), devs[0], pool)
+    a.release()
+    # different bucket -> fresh allocation
+    b = pl.stage_keys(np.arange(2000, dtype=np.uint32), devs[0], pool)
+    assert b.host_buf.shape[0] == 2048 and pool.hits == 0
+    b.release()
+    if len(devs) > 1:
+        # same bucket, different device -> its own free list
+        c = pl.stage_keys(np.arange(1000, dtype=np.uint32), devs[1], pool)
+        assert pool.hits == 0
+        c.release()
+        d = pl.stage_keys(np.arange(1000, dtype=np.uint32), devs[1], pool)
+        assert pool.hits == 1  # now recycled from device 1's list
+        d.release()
+
+
+def test_staging_pool_holds_buffer_until_release():
+    """The host pad buffer must NOT be reused while the device array
+    lives: device_put may alias host memory (CPU zero-copy), so recycling
+    early would corrupt staged keys."""
+    pool = pl.StagingPool()
+    keys = np.arange(1000, dtype=np.uint32)
+    s = pl.stage_keys(keys, None, pool)
+    # not in the free list yet: an acquire must MISS while s is alive
+    buf = pool.acquire(1024, np.uint32, None)
+    assert pool.misses == 2 and buf is not s.host_buf
+    np.testing.assert_array_equal(np.asarray(s.valid()), keys)
+    s.release()
+
+
+def test_staging_pool_respects_byte_cap():
+    pool = pl.StagingPool(max_per_key=4, max_bytes=3 * 4096)
+    bufs = [pool.acquire(1024, np.uint32, None) for _ in range(4)]
+    for b in bufs:
+        pool.release(b, None)
+    # 4 x 4096 bytes released into a 3-buffer budget: oldest evicted
+    assert pool._bytes <= 3 * 4096
+
+
+def test_unpadded_pow2_chunk_carries_no_pool_buffer():
+    staged = pl.stage_keys(np.arange(1024, dtype=np.uint32))
+    assert staged.pad == 0 and staged.host_buf is None
+    staged.release()
+
+
+# -- CLI ----------------------------------------------------------------------
+
+
+def test_cli_streaming_devices_flag(capsys):
+    import json
+
+    from mpi_k_selection_tpu import cli
+
+    args = [
+        "--backend", "tpu", "--streaming", "--n", "60000",
+        "--chunk-elems", "9973", "--verify", "--check", "--json",
+        "--pipeline-depth", "2",
+    ]
+    rc = cli.main(args + ["--devices", "8"])
+    assert rc == 0
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["n_devices"] == 8
+    assert rec["extra"]["ingest_devices"] == 8
+    assert rec["extra"]["exact_match"] is True
+    assert rec["extra"]["certificate_ok"] is True
+    rc = cli.main(args)  # default: single-device ingest
+    assert rc == 0
+    rec1 = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec1["n_devices"] == 1
+    assert rec1["answer"] == rec["answer"]

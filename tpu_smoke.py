@@ -200,6 +200,38 @@ def main() -> int:
               np.asarray(rv).view(np.uint16))
         check(f"block bf16 k={kk} indices", i, ri)
 
+    # --- multi-device staged ingest (the bench_streaming_oc multi-device
+    # config at smoke scale): chunks staged round-robin over every chip,
+    # answers bit-identical across devices {1, all} x depth {0, 2} and
+    # exact vs the host oracle — so MULTICHIP runs record the streaming
+    # round-robin path on real silicon, not only the virtual CPU mesh ---
+    ndev = len(jax.devices())
+    if ndev > 1:
+        print(f"streaming multi-device ingest ({ndev} chips):")
+        from mpi_k_selection_tpu.streaming import (
+            streaming_kselect,
+            streaming_rank_certificate,
+        )
+
+        chunk = 1 << 19
+        nchunks = 9  # odd count: the round robin wraps unevenly
+        sn = chunk * nchunks
+        rng_chunks = [
+            np.random.default_rng(100 + i).integers(
+                -(2**31), 2**31 - 1, size=chunk, dtype=np.int32
+            )
+            for i in range(nchunks)
+        ]
+        sk = sn // 2
+        want_s = int(np.sort(np.concatenate(rng_chunks), kind="stable")[sk - 1])
+        got_sync = int(streaming_kselect(rng_chunks, sk, pipeline_depth=0))
+        check("streaming sync oracle", got_sync, want_s)
+        for dv in (1, ndev):
+            got_d = int(streaming_kselect(rng_chunks, sk, pipeline_depth=2, devices=dv))
+            check(f"streaming devices={dv} bit-identical", got_d, want_s)
+        less, leq = streaming_rank_certificate(rng_chunks, want_s, devices=ndev)
+        check("streaming multi-device certificate", less < sk <= leq, True)
+
     if failures:
         print(f"tpu_smoke: {len(failures)} FAILURES")
         return 1
