@@ -546,6 +546,83 @@ def bench_streaming_oc(on_tpu: bool):
     _emit(rec)
     ok = bool(exact)
 
+    # --- spill config: the survivor spill store (ISSUE 5) on a deeper
+    # descent — radix_bits=4 and a tiny collect budget force several
+    # prefix-filtered passes, so the record can PROVE the geometric
+    # shrink: pass 0 reads the source (and tees gen 0), pass 1 reads gen 0
+    # whole, every later pass reads ~1/2^radix_bits of its predecessor.
+    # `pass_shrink_ratio` is the worst (largest) bytes_read ratio between
+    # consecutive spill-read histogram passes after pass 1 — the issue's
+    # acceptance bound is <= ~1/2^(radix_bits-1); `exact_match` REQUIRES
+    # bit-equality against the spill=off answer on the same source. Run at
+    # a reduced n on TPU (the shrink contract is scale-free and gen 0
+    # costs n key bytes of disk).
+    from mpi_k_selection_tpu.streaming.spill import SpillStore
+
+    sp_n, sp_chunk = (1 << 27, 1 << 24) if on_tpu else (1 << 22, 1 << 19)
+    sp_nchunks, sp_k = sp_n // sp_chunk, sp_n // 2
+
+    def sp_gen(i):
+        return np.random.default_rng(23 + i).integers(
+            -(2**31), 2**31 - 1, size=sp_chunk, dtype=np.int32
+        )
+
+    sp_source = lambda: (sp_gen(i) for i in range(sp_nchunks))
+    sp_rb, sp_budget = 4, 512
+    ans_off = streaming_kselect(
+        sp_source, sp_k, radix_bits=sp_rb, collect_budget=sp_budget,
+        spill="off",
+    )
+    with SpillStore() as sp_store:
+        t0 = time.perf_counter()
+        ans_spill = streaming_kselect(
+            sp_source, sp_k, radix_bits=sp_rb, collect_budget=sp_budget,
+            spill=sp_store,
+        )
+        sp_s = time.perf_counter() - t0
+        sp_passes = list(sp_store.pass_log)
+    # one-shot leg: the same stream as a consumed generator, spill=auto —
+    # the lifted replayable-source requirement must yield the SAME bits
+    ans_oneshot = streaming_kselect(
+        (sp_gen(i) for i in range(sp_nchunks)), sp_k,
+        radix_bits=sp_rb, collect_budget=sp_budget,
+    )
+    spill_reads = [
+        p["bytes_read"] for p in sp_passes
+        if isinstance(p["pass"], int) and p["pass"] >= 1
+    ]
+    shrink = (
+        max(
+            b / a for a, b in zip(spill_reads, spill_reads[1:])
+        )
+        if len(spill_reads) >= 2
+        else 0.0
+    )
+    exact_sp = int(ans_spill) == int(ans_off) == int(ans_oneshot)
+    _emit(
+        {
+            "metric": "kselect_streaming_oc_spill",
+            "value": round(sp_n / sp_s, 1) if exact_sp else 0.0,
+            "unit": "elems/sec/chip",
+            "n": sp_n,
+            "k": sp_k,
+            "chunks": sp_nchunks,
+            "chunk_elems": sp_chunk,
+            "radix_bits": sp_rb,
+            "collect_budget": sp_budget,
+            "seconds": round(sp_s, 6),
+            "_spill": {
+                "passes": sp_passes,
+                "bytes_streamed_per_pass": [p["bytes_read"] for p in sp_passes],
+                "pass_shrink_ratio": round(shrink, 6),
+                "shrink_bound": 1.0 / (1 << (sp_rb - 1)),
+                "one_shot_ok": int(ans_oneshot) == int(ans_off),
+            },
+            "exact_match": bool(exact_sp),
+        }
+    )
+    ok = ok and exact_sp and (0.0 < shrink <= 1.0 / (1 << (sp_rb - 1)))
+
     # --- multi-device config: the same stream, staged round-robin across
     # every local device (devices=p, ISSUE 4) vs the devices=1 run above.
     # `device_scaling` is pipelined-devices=1 wall / multi-device wall;

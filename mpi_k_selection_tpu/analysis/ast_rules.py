@@ -1,4 +1,4 @@
-"""AST lint rules (KSL001-KSL006) — each encodes a bug class a human
+"""AST lint rules (KSL001-KSL008) — each encodes a bug class a human
 reviewer caught in this repository at least once. docs/ANALYSIS.md holds
 the catalog with the historical incident behind every rule.
 
@@ -515,4 +515,103 @@ class StreamingDevicePutWithoutDevice(Rule):
                     "sharding argument — staged buffers silently pile onto "
                     "one chip; pass the round-robin slot (or an explicit "
                     "None for the single-slot default path)"
+                )
+
+
+# ---------------------------------------------------------------------------
+# KSL008 — raw file writes in streaming/ outside the spill store API
+
+
+@register
+class StreamingRawFileWrite(Rule):
+    id = "KSL008"
+    title = "raw file write in streaming/ outside the spill store API"
+    rationale = (
+        "streaming/spill.py is the ONE sanctioned file-writing surface "
+        "under streaming/: its records carry the (chunk_index, bucket, "
+        "dtype, device) key, a CRC32, and a lifecycle (generations dropped "
+        "eagerly, stores removed on every exit path — the leaked-dir test "
+        "fixture). A raw `open(..., 'w')`/`np.save`/`.tofile` in the "
+        "streaming layer dodges all three: no replay keying (the "
+        "chunk->device determinism contract breaks silently), no checksum "
+        "(a truncated write feeds the descent wrong survivors instead of "
+        "raising SpillRecordError), and no cleanup discipline (temp files "
+        "outlive the pass). Route every write through "
+        "SpillStore/SpillWriter."
+    )
+
+    # call names that write files outright
+    _WRITE_CALLS = {
+        "np.save", "np.savez", "np.savez_compressed",
+        "numpy.save", "numpy.savez", "numpy.savez_compressed",
+        "np.memmap", "numpy.memmap",
+        "pickle.dump", "shutil.copyfile", "shutil.copy", "shutil.copy2",
+    }
+    # method names that write files on their receiver (ndarray.tofile,
+    # Path.write_bytes/write_text)
+    _WRITE_METHODS = {"tofile", "write_bytes", "write_text"}
+    _OPEN_NAMES = {"open", "io.open", "os.fdopen"}
+    _WRITE_MODE = re.compile(r"[wax+]")
+
+    def _open_writes(self, call: ast.Call, mode_pos: int) -> bool:
+        """True when an ``open``-family call provably (or possibly) opens
+        for writing: a constant mode containing w/a/x/+, or a NON-constant
+        mode (can't prove read-only). A missing/constant read mode passes.
+        ``mode_pos`` is the mode's positional index — 1 for the builtin
+        ``open(path, mode)``, 0 for the receiver-qualified
+        ``Path(...).open(mode)``."""
+        mode = None
+        if len(call.args) > mode_pos:
+            mode = call.args[mode_pos]
+        for kw in call.keywords:
+            if kw.arg == "mode":
+                mode = kw.value
+        if mode is None:
+            return False  # bare open(path) = read
+        if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+            return bool(self._WRITE_MODE.search(mode.value))
+        return True  # dynamic mode: cannot prove it reads
+
+    def check_module(self, mod: SourceModule):
+        p = pathlib.Path(mod.path).resolve().as_posix()
+        if "/streaming/" not in p or _is_test_file(mod):
+            return
+        if p.endswith("streaming/spill.py"):
+            return  # the sanctioned writer
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if name in self._WRITE_CALLS:
+                yield node.lineno, (
+                    f"`{name}` writes a file outside the spill store API — "
+                    "route it through SpillStore/SpillWriter "
+                    "(streaming/spill.py) so it gets record keying, "
+                    "checksums and cleanup"
+                )
+            elif (
+                (name in self._OPEN_NAMES and self._open_writes(node, 1))
+                or (
+                    # receiver-qualified .open() — Path(p).open('wb') and
+                    # friends; the mode is the FIRST argument there
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "open"
+                    and name not in self._OPEN_NAMES
+                    and self._open_writes(node, 0)
+                )
+            ):
+                yield node.lineno, (
+                    f"`{name or '.open'}` with a write mode outside the "
+                    "spill store API — route it through "
+                    "SpillStore/SpillWriter (streaming/spill.py) so it "
+                    "gets record keying, checksums and cleanup"
+                )
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in self._WRITE_METHODS
+            ):
+                yield node.lineno, (
+                    f"`.{node.func.attr}(...)` writes a file outside the "
+                    "spill store API — route it through "
+                    "SpillStore/SpillWriter (streaming/spill.py)"
                 )

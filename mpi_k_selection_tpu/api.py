@@ -261,9 +261,18 @@ def kselect_streaming(source, k, **kwargs):
     answers). ``devices=p`` spreads the pipelined ingest round-robin
     across p chips so p chunks histogram concurrently — answers stay
     bit-identical for every device count (the host int64 merge drains in
-    chunk order). See streaming/chunked.py:streaming_kselect for the full
-    option set (``radix_bits``, ``hist_method``, ``collect_budget``,
-    ``sketch``, ``pipeline_depth``, ``timer``, ``devices``)."""
+    chunk order). ``spill`` engages the survivor spill store
+    (streaming/spill.py): pass 0 tees encoded keys to disk and later
+    passes read back only the geometrically-shrinking survivors, so a
+    ONE-SHOT iterator/generator is a first-class source (``"auto"``, the
+    default, spills exactly for those; ``"force"`` always; ``"off"``
+    keeps today's replay path and rejects one-shot sources;
+    ``spill_dir`` roots the temp store). Answers are bit-identical to
+    ``spill="off"`` in every mode. See
+    streaming/chunked.py:streaming_kselect for the full option set
+    (``radix_bits``, ``hist_method``, ``collect_budget``, ``sketch``,
+    ``pipeline_depth``, ``timer``, ``devices``, ``spill``,
+    ``spill_dir``)."""
     from mpi_k_selection_tpu.streaming.chunked import streaming_kselect
 
     return streaming_kselect(source, k, **kwargs)
@@ -314,14 +323,20 @@ class StreamingQuantiles:
         self.sketch.update(chunk)
         return self
 
-    def update_stream(self, source) -> "StreamingQuantiles":
+    def update_stream(self, source, *, spill=None) -> "StreamingQuantiles":
         """Fold every chunk of a replayable/listed ``source`` in via the
         pipelined iterator (chunk *i+1* encoded in the background while
         chunk *i* folds; with ``devices`` > 1, each chunk's deepest-level
         histogram counted on its round-robin device) — bit-identical to
-        sequential ``update`` calls."""
+        sequential ``update`` calls. ``spill`` (a
+        :class:`~mpi_k_selection_tpu.streaming.spill.SpillStore`) tees the
+        stream's encoded keys to disk during this ONE pass, making
+        one-shot sources refinable: pass the store to
+        :meth:`refine_quantiles` afterwards and the exact descent runs
+        entirely from the spilled generation."""
         self.sketch.update_stream(
-            source, pipeline_depth=self.pipeline_depth, devices=self.devices
+            source, pipeline_depth=self.pipeline_depth, devices=self.devices,
+            spill=spill,
         )
         return self
 
@@ -348,7 +363,10 @@ class StreamingQuantiles:
         (which must replay the very stream this tracker accumulated): ONE
         sketch-seeded multi-rank descent shares every streamed pass across
         all requested ranks, so m quantiles cost roughly the stream replays
-        of one (streaming/chunked.py:streaming_kselect_many)."""
+        of one (streaming/chunked.py:streaming_kselect_many). ``source``
+        may be the SpillStore a one-shot :meth:`update_stream` teed into —
+        the descent then reads (and geometrically shrinks) the spilled
+        generation instead of replaying the original stream."""
         from mpi_k_selection_tpu.streaming.chunked import streaming_kselect_many
 
         return streaming_kselect_many(

@@ -124,6 +124,21 @@ def build_parser() -> argparse.ArgumentParser:
         "synchronous, the correctness oracle; default 2 = double "
         "buffering). Answers are bit-identical at every depth",
     )
+    p.add_argument(
+        "--spill", choices=("auto", "off", "force"), default="auto",
+        help="--streaming survivor spill store: tee pass-0 keys to disk "
+        "and serve later passes from the geometrically-shrinking spilled "
+        "survivors instead of replaying the source (auto = only for "
+        "one-shot sources — the CLI's generated stream is replayable, so "
+        "auto stays on the replay path; force = always spill; off = "
+        "never). Answers are bit-identical in every mode",
+    )
+    p.add_argument(
+        "--spill-dir", default=None,
+        help="directory for --spill stores (default: the system temp dir); "
+        "worst-case footprint ~2x the stream's key bytes (~3x for "
+        "caller-owned stores that keep their pass-0 generation)",
+    )
     p.add_argument("--repeats", type=int, default=1)
     p.add_argument("--verify", action="store_true", help="check against the seq oracle")
     p.add_argument(
@@ -318,67 +333,93 @@ def _run_streaming(args):
     from mpi_k_selection_tpu.utils import profiling
 
     ptimer = profiling.PhaseTimer() if args.profile else None
+    # --spill=force with a single run routes through a CLI-owned store so
+    # the per-pass streamed-bytes log rides the result record (and the
+    # --check certificate replays the spilled keys instead of regenerating
+    # the stream). With --repeats, each run tees a fresh generation into a
+    # caller-owned store — pass the mode string instead, so every repeat
+    # cleans up its own internal store. auto/off always pass the string
+    # (the generated source is replayable, so auto = the replay path).
+    from mpi_k_selection_tpu.streaming.spill import SpillStore
+
+    spill_store = (
+        SpillStore(args.spill_dir)
+        if args.spill == "force" and args.repeats <= 1
+        else None
+    )
     fn = lambda: kselect_streaming(
         source, k, hist_method=hist_method, pipeline_depth=depth, timer=ptimer,
         devices=devices,
+        spill=spill_store if spill_store is not None else args.spill,
+        spill_dir=args.spill_dir,
     )
-    seconds, answer = time_fn(fn, repeats=args.repeats, warmup=0)
-    record = ResultRecord(
-        answer=np.asarray(answer).item(),
-        n=n,
-        k=k,
-        backend=args.backend,
-        algorithm="streaming-chunked",
-        dtype=args.dtype,
-        seconds=seconds,
-        # streaming: the devices actually staged onto, not the host total
-        n_devices=n_ingest,
-    )
-    nchunks = -(-n // args.chunk_elems)
-    record.extra["chunks"] = nchunks
-    record.extra["chunk_elems"] = args.chunk_elems
-    record.extra["pipeline_depth"] = depth
-    record.extra["ingest_devices"] = n_ingest
-    if ptimer is not None and ptimer.phases:
-        from mpi_k_selection_tpu.streaming.pipeline import ingest_hidden_frac
-
-        # phases accumulate across --repeats while `seconds` is the best
-        # single run: report per-repeat seconds so the two are comparable
-        # (ingest_hidden_frac is a ratio of same-scale sums — unaffected)
-        reps = max(1, args.repeats)
-        record.extra["pipeline_phases"] = {
-            name: {
-                "seconds": d["seconds"] / reps,
-                "calls": max(1, d["calls"] // reps),
-            }
-            for name, d in ptimer.as_dict().items()
-        }
-        hidden = ingest_hidden_frac(ptimer)
-        if hidden is not None:
-            record.extra["ingest_hidden_frac"] = round(hidden, 4)
-    ok = True
-    if args.verify:
-        # the oracle NEEDS the whole array resident — only meaningful at
-        # sizes where that is still possible; --check stays streaming
-        from mpi_k_selection_tpu.backends import seq
-
-        x = np.concatenate([np.ravel(c) for c in source()])
-        want = np.asarray(seq.kselect(x, k)).item()
-        ok = record.answer == want
-        record.extra["oracle"] = want
-        record.extra["exact_match"] = ok
-    if args.check:
-        # no timer here: the profile snapshot above covers the solve only
-        # (the report is labeled "concurrent with solve"), and phases
-        # recorded after it would be silently dropped anyway
-        less, leq = streaming_rank_certificate(
-            source, answer, pipeline_depth=depth, devices=devices
+    try:
+        seconds, answer = time_fn(fn, repeats=args.repeats, warmup=0)
+        record = ResultRecord(
+            answer=np.asarray(answer).item(),
+            n=n,
+            k=k,
+            backend=args.backend,
+            algorithm="streaming-chunked",
+            dtype=args.dtype,
+            seconds=seconds,
+            # streaming: the devices actually staged onto, not the host total
+            n_devices=n_ingest,
         )
-        cert_ok = less < k <= leq
-        record.extra["rank_certificate"] = [less, leq]
-        record.extra["certificate_ok"] = cert_ok
-        ok = ok and cert_ok
-    return record, ok
+        nchunks = -(-n // args.chunk_elems)
+        record.extra["chunks"] = nchunks
+        record.extra["chunk_elems"] = args.chunk_elems
+        record.extra["pipeline_depth"] = depth
+        record.extra["ingest_devices"] = n_ingest
+        record.extra["spill"] = args.spill
+        if spill_store is not None:
+            record.extra["spill_passes"] = list(spill_store.pass_log)
+        if ptimer is not None and ptimer.phases:
+            from mpi_k_selection_tpu.streaming.pipeline import ingest_hidden_frac
+
+            # phases accumulate across --repeats while `seconds` is the best
+            # single run: report per-repeat seconds so the two are comparable
+            # (ingest_hidden_frac is a ratio of same-scale sums — unaffected)
+            reps = max(1, args.repeats)
+            record.extra["pipeline_phases"] = {
+                name: {
+                    "seconds": d["seconds"] / reps,
+                    "calls": max(1, d["calls"] // reps),
+                }
+                for name, d in ptimer.as_dict().items()
+            }
+            hidden = ingest_hidden_frac(ptimer)
+            if hidden is not None:
+                record.extra["ingest_hidden_frac"] = round(hidden, 4)
+        ok = True
+        if args.verify:
+            # the oracle NEEDS the whole array resident — only meaningful at
+            # sizes where that is still possible; --check stays streaming
+            from mpi_k_selection_tpu.backends import seq
+
+            x = np.concatenate([np.ravel(c) for c in source()])
+            want = np.asarray(seq.kselect(x, k)).item()
+            ok = record.answer == want
+            record.extra["oracle"] = want
+            record.extra["exact_match"] = ok
+        if args.check:
+            # no timer here: the profile snapshot above covers the solve only
+            # (the report is labeled "concurrent with solve"), and phases
+            # recorded after it would be silently dropped anyway. With a
+            # spill store in hand, the certificate replays the spilled gen-0
+            # keys — the one-shot-friendly path — instead of regenerating.
+            less, leq = streaming_rank_certificate(
+                spill_store if spill_store is not None else source,
+                answer, pipeline_depth=depth, devices=devices,
+            )
+            cert_ok = less < k <= leq
+            record.extra["rank_certificate"] = [less, leq]
+            record.extra["certificate_ok"] = cert_ok
+            ok = ok and cert_ok
+        return record, ok
+    finally:
+        if spill_store is not None:
+            spill_store.close()
 
 
 def _run_topk(args, x):

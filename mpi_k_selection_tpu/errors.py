@@ -13,3 +13,15 @@ class NativeUnavailableError(RuntimeError):
     e.g. no C++ toolchain. Environmental, not a bug: harness code (bench.py)
     treats it as a tolerable skip, while any other exception from the native
     backend is a real failure."""
+
+
+class SpillError(RuntimeError):
+    """Misuse of the streaming spill store (streaming/spill.py): reading an
+    empty/closed store, writing after commit, and similar lifecycle errors."""
+
+
+class SpillRecordError(SpillError):
+    """A spill record on disk failed validation — missing file, truncated
+    header/payload, or a checksum/metadata mismatch. Raised BEFORE any key
+    reaches a histogram: a corrupt spill cache must fail loudly, never feed
+    the descent silently wrong survivors."""

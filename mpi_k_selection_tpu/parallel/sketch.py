@@ -24,6 +24,15 @@ histograms, same int32-partial -> host-int64 ``_fold_deep_histogram``
 discipline, but the partials arrive per staged chunk (round-robin over
 the ingest devices, merged in chunk order) instead of per shard through a
 psum — for data that is never resident as one sharded array.
+
+Exact refinement of EITHER sketch (``RadixSketch.refine``) needs a
+second read of the data. A sketch built here has it by construction (the
+sharded array is resident); a streamed sketch over a one-shot source
+does not — there, ``update_stream(..., spill=SpillStore(...))`` tees the
+single pass's encoded keys to the survivor spill store
+(streaming/spill.py), and ``refine(store, k)`` runs the sketch-seeded
+descent entirely from disk, shrinking the spilled generation
+geometrically pass over pass.
 """
 
 from __future__ import annotations

@@ -20,9 +20,16 @@ Two cooperating pieces (see docs/API.md "Streaming / out-of-core"):
   ``devices`` knob > 1 the staging goes round-robin across chips and up to
   p chunks histogram concurrently (one in-flight dispatch per device),
   still bit-identical — the host int64 merge drains in chunk order.
+- :mod:`spill` — the survivor spill store (``spill`` knob): pass 0 tees
+  each chunk's encoded keys to per-device disk records, later passes read
+  the previous generation, filter to the surviving prefixes on the owning
+  device, and write only the compacted survivors — passes shrink
+  geometrically (~N·(2 + 1/2^radix_bits + ...) total bytes instead of
+  ~passes·N) and one-shot generators become first-class sources.
 """
 
 from mpi_k_selection_tpu.streaming.chunked import (
+    DEFAULT_SPILL,
     as_chunk_source,
     streaming_kselect,
     streaming_kselect_many,
@@ -37,11 +44,22 @@ from mpi_k_selection_tpu.streaming.pipeline import (
     resolve_stream_devices,
 )
 from mpi_k_selection_tpu.streaming.sketch import RadixSketch
+from mpi_k_selection_tpu.streaming.spill import (
+    SPILL_DIR_PREFIX,
+    SPILL_MODES,
+    SpillGeneration,
+    SpillStore,
+)
 
 __all__ = [
     "ChunkPipeline",
     "DEFAULT_PIPELINE_DEPTH",
+    "DEFAULT_SPILL",
     "RadixSketch",
+    "SPILL_DIR_PREFIX",
+    "SPILL_MODES",
+    "SpillGeneration",
+    "SpillStore",
     "StagedKeys",
     "StagingPool",
     "as_chunk_source",

@@ -40,6 +40,17 @@ of ``parallel/sketch.py:distributed_sketch``'s psum merge, and because
 the merge order is fixed (and int64 addition is exact), answers stay
 bit-identical for every device count. ``devices=1`` (or ``None``) is the
 single-device PR 3 path.
+
+The ``spill`` knob adds the reference CGM's OTHER perf idea — the discard
+step — to this axis (streaming/spill.py): pass 0 tees each chunk's
+encoded keys to an on-disk survivor store, and every later pass reads the
+previous generation, filters it to the surviving prefixes on the owning
+device, and writes only the compacted ~1/2^radix_bits as the next
+generation, so the replay above becomes a geometrically shrinking
+generation read (~N·(2 + 1/2^b + ...) total bytes instead of ~passes·N)
+and one-shot sources become first-class. ``spill="off"`` is the pure
+replay path, bit-identical to the spill path at every devices x depth
+combination.
 """
 
 from __future__ import annotations
@@ -49,10 +60,16 @@ import contextlib
 import numpy as np
 
 from mpi_k_selection_tpu.streaming import pipeline as _pl
+from mpi_k_selection_tpu.streaming import spill as _sp
 from mpi_k_selection_tpu.streaming.pipeline import DEFAULT_PIPELINE_DEPTH, StagedKeys
 from mpi_k_selection_tpu.utils import dtypes as _dt
 
 DEFAULT_COLLECT_BUDGET = 1 << 20
+
+#: Default for the ``spill`` knob: spill only when the source cannot be
+#: replayed (a one-shot iterator/generator) — replayable sources keep the
+#: bit-identical replay path unless ``"force"`` asks for the spill descent.
+DEFAULT_SPILL = "auto"
 
 
 def _is_device_array(chunk) -> bool:
@@ -67,17 +84,52 @@ def _tpu_backend() -> bool:
     return jax.default_backend() == "tpu"
 
 
-def as_chunk_source(source):
+def _is_one_shot_source(source) -> bool:
+    """True for a bare iterator/generator — consumable exactly once."""
+    if callable(source) or isinstance(source, (list, tuple, np.ndarray)):
+        return False
+    if isinstance(source, _sp.SpillStore) or _is_device_array(source):
+        return False
+    return hasattr(source, "__iter__") or hasattr(source, "__next__")
+
+
+class _OneShotSource:
+    """The spill path's wrapper for a bare iterator: pass 0 consumes it
+    once (teeing every chunk to the spill store); any second invocation is
+    a bug in the spill descent — passes >= 1 must read spill generations —
+    and raises instead of silently yielding an empty (or drifted) stream."""
+
+    def __init__(self, it):
+        self._it = iter(it)
+        self._used = False
+
+    def __call__(self):
+        if self._used:
+            raise RuntimeError(
+                "one-shot chunk source invoked a second time: the spill "
+                "descent must serve every pass after pass 0 from the spill "
+                "store. This is a bug in streaming/chunked.py, not in the "
+                "caller's stream."
+            )
+        self._used = True
+        return self._it
+
+
+def as_chunk_source(source, *, one_shot_ok: bool = False):
     """Normalize ``source`` to a zero-arg callable returning a fresh chunk
     iterator — the replayable form every streaming pass needs.
 
-    Accepted: a list/tuple of arrays, a single array (one chunk), or a
-    zero-arg callable returning an iterable of arrays. A bare one-shot
-    iterator/generator is rejected with instructions: exact selection
-    re-reads the stream once per radix pass, which a consumed generator
-    cannot serve (use :class:`~mpi_k_selection_tpu.streaming.sketch.
-    RadixSketch` for single-pass approximate answers).
+    Accepted: a list/tuple of arrays, a single array (one chunk), a
+    zero-arg callable returning an iterable of arrays, or a
+    :class:`~mpi_k_selection_tpu.streaming.spill.SpillStore` with a
+    committed generation (replayed from disk). A bare one-shot
+    iterator/generator is accepted only under ``one_shot_ok`` (the spill
+    descent: pass 0 tees it to disk and never reads it again); otherwise
+    it is rejected with instructions — exact selection re-reads the
+    stream once per radix pass, which a consumed generator cannot serve.
     """
+    if isinstance(source, _sp.SpillStore):
+        return source.latest_generation().as_source()
     if callable(source):
         return source
     if isinstance(source, (list, tuple)):
@@ -85,12 +137,19 @@ def as_chunk_source(source):
     if isinstance(source, np.ndarray) or _is_device_array(source):
         return lambda: iter((source,))
     if hasattr(source, "__iter__") or hasattr(source, "__next__"):
+        if one_shot_ok:
+            return _OneShotSource(source)
         raise TypeError(
             "streaming selection re-reads the data once per radix pass; a "
             "one-shot iterator/generator cannot be replayed. Pass a "
             "list/tuple of chunks or a zero-arg callable returning a fresh "
-            "iterator (e.g. lambda: (load(i) for i in range(nchunks))). "
-            "For single-pass streams, use RadixSketch (approximate) instead."
+            "iterator (e.g. lambda: (load(i) for i in range(nchunks))) — or "
+            "keep the one-shot stream and let the spill store serve the "
+            "later passes: spill='auto'|'force' on the streaming entry "
+            "points tees pass 0's encoded keys to disk (streaming/spill.py),"
+            " and RadixSketch.update_stream(..., spill=store) does the same "
+            "for the sketch-then-refine flow. For single-pass approximate "
+            "answers, RadixSketch alone suffices."
         )
     raise TypeError(f"unsupported chunk source type {type(source).__name__!r}")
 
@@ -104,6 +163,21 @@ def _encode_chunk(chunk, dtype):
     dtype — the caller reads it off ``c.dtype``). Shared verbatim by the
     synchronous iterator below and the pipelined producer thread
     (streaming/pipeline.py), so both paths enforce identical contracts."""
+    if isinstance(chunk, _sp.SpillChunk):
+        # replayed spill record: keys are ALREADY the host key-space view
+        # (encoded once, at pass-0 tee time) — validate the recorded stream
+        # dtype and hand them through; the zero-length companion carries
+        # the dtype for first-chunk probes exactly like the pipelined path
+        keys = chunk.keys
+        if keys.size == 0:
+            return None
+        odt = np.dtype(chunk.orig_dtype)
+        if dtype is not None and odt != np.dtype(dtype):
+            raise TypeError(
+                f"spill chunk dtype {odt} != stream dtype {np.dtype(dtype)}; "
+                "streaming selection requires one dtype per stream"
+            )
+        return keys, np.empty((0,), odt)
     if _is_device_array(chunk):
         c = chunk.ravel()
     else:
@@ -136,10 +210,13 @@ def _encode_chunk(chunk, dtype):
     return _dt.to_sortable_bits(c), c
 
 
-def _iter_key_chunks(src, dtype=None):
+def _iter_key_chunks(src, dtype=None, spill=None):
     """Yield ``(keys, chunk)`` pairs for every non-empty chunk (see
     :func:`_encode_chunk`) — the synchronous path, and the correctness
-    oracle for the pipelined one."""
+    oracle for the pipelined one. ``spill`` is an optional
+    :class:`~mpi_k_selection_tpu.streaming.spill.SpillWriter` teeing every
+    chunk's host encoded keys (the synchronous twin of the pipelined
+    producer's tee; the caller commits/aborts it)."""
     for chunk in src():
         pair = _encode_chunk(chunk, dtype)
         if pair is None:
@@ -147,13 +224,19 @@ def _iter_key_chunks(src, dtype=None):
         keys, c = pair
         if dtype is None:
             dtype = np.dtype(c.dtype)
+        if spill is not None:
+            hk = keys if isinstance(keys, np.ndarray) else np.asarray(keys)
+            slot = (
+                chunk.device_slot if isinstance(chunk, _sp.SpillChunk) else None
+            )
+            spill.append(hk, dtype, device_slot=slot)
         yield keys, c
 
 
 @contextlib.contextmanager
 def _key_chunk_stream(
     src, dtype=None, *, pipeline_depth=0, hist_method=None, timer=None,
-    devices=None,
+    devices=None, spill=None,
 ):
     """Context-managed ``(keys, chunk)`` iterator: the synchronous
     generator at depth 0, a :class:`~mpi_k_selection_tpu.streaming.
@@ -161,14 +244,16 @@ def _key_chunk_stream(
     with the consuming pass, staged round-robin over ``devices``) at
     depth >= 1. The context manager guarantees the producer thread is
     joined on EVERY exit path — normal exhaustion, early exit, and
-    consumer-side raises like the replay-stability check."""
+    consumer-side raises like the replay-stability check. ``spill`` tees
+    every chunk's encoded keys to a SpillWriter (on the producer thread
+    when pipelined); the caller owns commit/abort."""
     depth = _pl.validate_pipeline_depth(pipeline_depth)
     if depth == 0:
-        yield _iter_key_chunks(src, dtype)
+        yield _iter_key_chunks(src, dtype, spill=spill)
         return
     pipe = _pl.ChunkPipeline(
         src, dtype, depth=depth, hist_method=hist_method, timer=timer,
-        devices=devices,
+        devices=devices, spill=spill,
     )
     try:
         yield iter(pipe)
@@ -338,6 +423,23 @@ def _np_walk(hist, kk, prefix, radix_bits):
     return prefix, kk, int(hist[b])
 
 
+def _prefix_mask(kv, resolved, prefix, kdt, total_bits):
+    """The survivor filter predicate — keys whose top ``resolved`` bits
+    equal ``prefix`` — on ``kv``'s own residency (host numpy, or a device
+    shift-compare tracing to a bool mask). The ONE predicate shared by the
+    survivor collect and the spill tee, so the KSC102/KSC103 contract
+    coverage of its traced program transfers to every caller by
+    construction."""
+    shift = total_bits - resolved
+    if isinstance(kv, np.ndarray):
+        return (kv >> kdt.type(shift)) == kdt.type(prefix)
+    import jax
+
+    return jax.lax.shift_right_logical(
+        kv, kv.dtype.type(shift)
+    ) == kv.dtype.type(prefix)
+
+
 def _collect_survivors(
     src, dtype, specs, *, pipeline_depth=0, timer=None, devices=None,
     hist_method=None,
@@ -374,16 +476,9 @@ def _collect_survivors(
             kv = keys.valid() if staged else keys
             host = isinstance(kv, np.ndarray)
             for resolved, prefix in out:
-                shift = total_bits - resolved
-                if host:
-                    surv = kv[(kv >> kdt.type(shift)) == kdt.type(prefix)]
-                else:
-                    import jax
-
-                    m = jax.lax.shift_right_logical(
-                        kv, kv.dtype.type(shift)
-                    ) == kv.dtype.type(prefix)
-                    surv = np.asarray(kv[m])  # eager boolean gather, device-side
+                m = _prefix_mask(kv, resolved, prefix, kdt, total_bits)
+                # host indexing, or an eager boolean gather device-side
+                surv = kv[m] if host else np.asarray(kv[m])
                 if surv.size:
                     out[(resolved, prefix)].append(np.asarray(surv, kdt))
             if staged:
@@ -407,6 +502,67 @@ def _validate_ks(ks, n):
             raise ValueError(f"k={k} out of range [1, {n}]")
 
 
+def _spill_tee_survivors(writer, keys, specs, dtype, kdt, total_bits, devs):
+    """Filter ONE chunk to the union of surviving ``(resolved_bits,
+    prefix)`` specs and append the compacted survivors to the next spill
+    generation — the geometric-shrink half of the spill descent. The
+    filter is the survivor-collect predicate (shift-compare -> bool mask,
+    the program KSC102/KSC103 trace), OR-ed over the specs and run on the
+    chunk's OWN device for staged chunks (only survivors cross back to the
+    host); host-exact routes filter host-side. Runs at push time — before
+    the histogram window can ``release()`` the staged buffer."""
+    staged = isinstance(keys, StagedKeys)
+    kv = keys.valid() if staged else keys
+    slot = None
+    if staged and keys.device is not None:
+        try:
+            slot = devs.index(keys.device)
+        except ValueError:  # pragma: no cover - device outside the pass set
+            slot = None
+    m = None
+    for resolved, prefix in specs:
+        mi = _prefix_mask(kv, resolved, prefix, kdt, total_bits)
+        m = mi if m is None else (m | mi)
+    if m is None:  # pragma: no cover - a pass always has >= 1 spec
+        return
+    # host indexing, or an eager boolean gather on the owning device —
+    # only survivors cross back
+    surv = kv[m] if isinstance(kv, np.ndarray) else np.asarray(kv[m])
+    if surv.size:
+        writer.append(np.asarray(surv, kdt), dtype, device_slot=slot)
+
+
+def _resolve_spill(source, spill, spill_dir):
+    """Resolve the ``spill`` knob against the source's replayability.
+
+    Returns ``(store, own_store, read_gen)``:
+
+    - ``store`` — the :class:`~mpi_k_selection_tpu.streaming.spill.
+      SpillStore` the descent tees into and reads back from (``None`` =
+      the pure replay path);
+    - ``own_store`` — True when this call created the store and must
+      close (delete) it on every exit path;
+    - ``read_gen`` — a pre-existing generation to serve pass 0 from
+      (the source IS a store: the sketch-then-refine flow).
+    """
+    spill = _sp.validate_spill_mode(spill)
+    in_store = source if isinstance(source, _sp.SpillStore) else None
+    read_gen = in_store.latest_generation() if in_store is not None else None
+    if isinstance(spill, _sp.SpillStore):
+        return spill, False, read_gen
+    if spill == "force":
+        return _sp.SpillStore(spill_dir), True, read_gen
+    if spill == "auto":
+        if in_store is not None:
+            # the source's own store serves the descent's generations too
+            return in_store, False, read_gen
+        if _is_one_shot_source(source):
+            return _sp.SpillStore(spill_dir), True, None
+    # "off", or "auto" with a replayable source: today's replay path,
+    # bit-identical (a store source still replays its gen 0 every pass)
+    return None, False, read_gen
+
+
 def streaming_kselect(
     source,
     k,
@@ -418,6 +574,8 @@ def streaming_kselect(
     pipeline_depth: int = DEFAULT_PIPELINE_DEPTH,
     timer=None,
     devices=None,
+    spill=DEFAULT_SPILL,
+    spill_dir=None,
 ):
     """Exact k-th smallest (1-indexed) over a chunked stream.
 
@@ -450,6 +608,23 @@ def streaming_kselect(
     depth. Multi-device staging engages only with ``pipeline_depth >= 1``
     and a device histogram method (the host-exact 64-bit-no-x64 and
     f64-on-TPU routes stay host-side and ignore extra devices).
+
+    ``spill`` engages the survivor spill store (streaming/spill.py):
+    pass 0 tees each chunk's encoded keys to disk and every later pass
+    reads the previous generation, filters to the surviving prefixes on
+    the owning device, and writes only the compacted survivors — total
+    bytes streamed drop from ~passes·N to ~N·(2 + 1/2^radix_bits + ...),
+    and one-shot iterators/generators become first-class sources (passes
+    >= 1 never touch the source). ``"auto"`` (default) spills only for
+    one-shot sources, keeping replayable sources on the bit-identical
+    replay path; ``"force"`` always spills; ``"off"`` never does (one-shot
+    sources are then rejected); a
+    :class:`~mpi_k_selection_tpu.streaming.spill.SpillStore` tees into a
+    caller-owned store whose pass-0 generation survives the call (and a
+    store with a committed generation is itself a valid ``source``).
+    ``spill_dir`` roots internally-created stores (default: the system
+    temp dir). Answers are bit-identical to ``spill="off"`` in every mode,
+    for every devices x pipeline_depth combination.
     """
     return streaming_kselect_many(
         source,
@@ -461,6 +636,8 @@ def streaming_kselect(
         pipeline_depth=pipeline_depth,
         timer=timer,
         devices=devices,
+        spill=spill,
+        spill_dir=spill_dir,
     )[0]
 
 
@@ -475,6 +652,8 @@ def streaming_kselect_many(
     pipeline_depth: int = DEFAULT_PIPELINE_DEPTH,
     timer=None,
     devices=None,
+    spill=DEFAULT_SPILL,
+    spill_dir=None,
 ):
     """Exact k-th smallest for EVERY (1-indexed) rank in ``ks``, sharing
     each streamed pass across ranks: the stream is replayed once per radix
@@ -483,10 +662,17 @@ def streaming_kselect_many(
     the same bucket share it). For out-of-core sources the replay is the
     dominant cost, so m quantiles over one stream cost roughly the passes
     of one. Per-rank semantics are exactly :func:`streaming_kselect`'s
-    (including its ``pipeline_depth``/``timer``/``devices`` knobs);
-    returns a list in input order.
+    (including its ``pipeline_depth``/``timer``/``devices`` and
+    ``spill``/``spill_dir`` knobs); returns a list in input order.
+
+    With spill engaged the "replay" above is a generation read: pass 0
+    tees the encoded keys to the spill store, every later pass filters the
+    previous generation to the union of unfinished prefixes (the active
+    set of that pass plus parked ranks awaiting the collect) and writes
+    only the compacted survivors — so the bytes streamed per pass shrink
+    by ~2^radix_bits while the multiset of keys each histogram counts is
+    unchanged, keeping answers bit-identical to the replay path.
     """
-    src = as_chunk_source(source)
     pipeline_depth = _pl.validate_pipeline_depth(pipeline_depth)
     devs = _pl.resolve_stream_devices(devices)
     # one in-flight histogram slot per ingest device; the synchronous
@@ -502,118 +688,245 @@ def streaming_kselect_many(
     if not ks:
         return []
 
-    # per-rank descent state: [prefix, rebased_k, resolved_bits, population]
-    if sketch is not None:
-        # the sketch names the stream dtype (later passes validate every
-        # chunk against it); check_stream validates divisibility of the
-        # bits BELOW its resolved prefix — what the remaining passes walk
-        dtype = sketch.dtype
-        kdt = np.dtype(_dt.key_dtype(dtype))
-        total_bits = _dt.key_bits(dtype)
-        method = resolve_stream_hist(hist_method, dtype)
-        sketch.check_stream(dtype, radix_bits)
-        _validate_ks(ks, sketch.n)
-        states = [list(sketch.walk(k)) for k in ks]
-    else:
-        # pass 0 triples as the length scan and the dtype probe: ONE
-        # streamed histogram of the top digit (rank-independent — no prefix
-        # filter yet), with dtype (hence key geometry and method) captured
-        # from the first chunk — nothing is produced just to be discarded
-        dtype = None
-        n = 0
-        win = _HistogramWindow(window)
-        with _key_chunk_stream(src, hist_method=hist_method, **stream_kw) as kc:
-            for keys, chunk in kc:
-                if dtype is None:
-                    dtype = np.dtype(chunk.dtype)
-                    kdt = np.dtype(_dt.key_dtype(dtype))
-                    total_bits = _dt.key_bits(dtype)
-                    if total_bits % radix_bits:
-                        raise ValueError(
-                            f"radix_bits={radix_bits} must divide key bits "
-                            f"{total_bits}"
-                        )
-                    method = resolve_stream_hist(hist_method, dtype)
-                    shift0 = total_bits - radix_bits
-                    hist = np.zeros((1 << radix_bits,), np.int64)
-                n += int(keys.size)
-                for h in win.push(keys, shift0, radix_bits, [None], method, kdt):
-                    hist += h[None]
-            for h in win.drain():
-                hist += h[None]
-        if n == 0:
-            raise ValueError("streaming selection requires a non-empty stream")
-        _validate_ks(ks, n)
-        states = []
-        for k in ks:
-            prefix, kk, pop = _np_walk(hist, k, None, radix_bits)
-            states.append([prefix, kk, radix_bits, pop])
+    store, own_store, read_gen = _resolve_spill(source, spill, spill_dir)
+    src = as_chunk_source(source, one_shot_ok=store is not None)
+    created = []  # generations this call wrote — its cleanup set
+    keep_gen0 = None  # the pass-0 tee, preserved in caller-owned stores
 
-    def _active(st):
-        return st[2] < total_bits and st[3] > collect_budget
+    def _gen_src():
+        return read_gen.as_source() if read_gen is not None else src
 
-    while any(_active(st) for st in states):
-        # active ranks advance in lockstep (a rank only ever EXITS the
-        # active set), so they all sit at one resolved depth: one streamed
-        # pass serves every distinct surviving prefix
-        resolved = next(st[2] for st in states if _active(st))
-        shift = total_bits - resolved - radix_bits
-        prefixes = sorted({st[0] for st in states if _active(st)})
-        expected = {st[0]: st[3] for st in states if _active(st)}
-        hists = {p: np.zeros((1 << radix_bits,), np.int64) for p in prefixes}
-        win = _HistogramWindow(window)
-        with _key_chunk_stream(src, dtype, hist_method=method, **stream_kw) as kc:
-            for keys, _ in kc:
-                for hd in win.push(keys, shift, radix_bits, prefixes, method, kdt):
-                    for p, h in hd.items():
-                        hists[p] += h
-            for hd in win.drain():
-                for p, h in hd.items():
-                    hists[p] += h
-        for p in prefixes:
-            # replay-stability check, mirroring _collect_survivors': this
-            # pass's population under each surviving prefix must equal the
-            # bucket count the PREVIOUS pass (or the seeding sketch)
-            # established — a drifting source fails loudly here instead of
-            # walking a corrupt histogram to a wrong answer
-            if int(hists[p].sum()) != expected[p]:
-                raise RuntimeError(
-                    f"chunk source is not replay-stable: prefix {p:#x} holds "
-                    f"{int(hists[p].sum())} elements this pass, previous "
-                    f"pass counted {expected[p]}. The source callable must "
-                    "yield identical data on every invocation."
-                )
-        for st in states:
-            if _active(st):
-                st[0], st[1], st[3] = _np_walk(hists[st[0]], st[1], st[0], radix_bits)
-                st[2] = resolved + radix_bits
-
-    specs = {}
-    for prefix, _kk, resolved, pop in states:
-        if resolved < total_bits:
-            specs[(resolved, int(prefix))] = pop
-    collected = (
-        _collect_survivors(
-            src, dtype, specs, pipeline_depth=pipeline_depth, timer=timer,
-            devices=None if devices is None else devs, hist_method=method,
-        )
-        if specs
-        else {}
-    )
-
-    answers = []
-    for prefix, kk, resolved, _pop in states:
-        if resolved == total_bits:
-            # every key bit determined (either the schedule ran out or the
-            # survivors are duplicates of one key): the prefix IS the answer
-            ans_key = kdt.type(prefix)
+    def _log_pass(label, wrote=None):
+        if store is None:
+            return
+        if read_gen is not None:
+            entry = {
+                "pass": label, "read": "spill",
+                "keys_read": int(read_gen.keys),
+                "bytes_read": int(read_gen.nbytes),
+            }
         else:
-            surv = collected[(resolved, int(prefix))]
-            ans_key = np.partition(surv, kk - 1)[kk - 1]
-        answers.append(
-            _dt.np_from_sortable_bits(np.asarray([ans_key], kdt), dtype)[0]
-        )
-    return answers
+            entry = {
+                "pass": label, "read": "source",
+                "keys_read": int(n), "bytes_read": int(n) * kdt.itemsize,
+            }
+        if wrote is not None:
+            entry["keys_written"] = int(wrote.keys)
+            entry["bytes_written"] = int(wrote.nbytes)
+        store.pass_log.append(entry)
+
+    def _rotate(gen):
+        """Make the just-committed survivor generation the next read
+        source and drop the one it replaces — at most two generations
+        ever coexist on disk (a caller-owned store keeps its pass-0 tee
+        for later calls)."""
+        nonlocal read_gen
+        created.append(gen)
+        prev = read_gen
+        read_gen = gen
+        if (
+            prev is not None
+            and prev in created
+            and (own_store or prev is not keep_gen0)
+        ):
+            store.drop_generation(prev)
+            created.remove(prev)
+
+    try:
+        # per-rank descent state: [prefix, rebased_k, resolved_bits, population]
+        if sketch is not None:
+            # the sketch names the stream dtype (later passes validate every
+            # chunk against it); check_stream validates divisibility of the
+            # bits BELOW its resolved prefix — what the remaining passes walk
+            dtype = sketch.dtype
+            kdt = np.dtype(_dt.key_dtype(dtype))
+            total_bits = _dt.key_bits(dtype)
+            method = resolve_stream_hist(hist_method, dtype)
+            sketch.check_stream(dtype, radix_bits)
+            n = sketch.n
+            _validate_ks(ks, n)
+            states = [list(sketch.walk(k)) for k in ks]
+        else:
+            # pass 0 triples as the length scan and the dtype probe: ONE
+            # streamed histogram of the top digit (rank-independent — no
+            # prefix filter yet), with dtype (hence key geometry and method)
+            # captured from the first chunk — nothing is produced just to be
+            # discarded. With spill engaged it ALSO tees every chunk's
+            # encoded keys to generation 0 (on the producer thread when
+            # pipelined), so no later pass touches the source again.
+            dtype = None
+            n = 0
+            writer = (
+                store.new_generation()
+                if store is not None and read_gen is None
+                else None
+            )
+            win = _HistogramWindow(window)
+            try:
+                with _key_chunk_stream(
+                    _gen_src(), hist_method=hist_method, spill=writer,
+                    **stream_kw,
+                ) as kc:
+                    for keys, chunk in kc:
+                        if dtype is None:
+                            dtype = np.dtype(chunk.dtype)
+                            kdt = np.dtype(_dt.key_dtype(dtype))
+                            total_bits = _dt.key_bits(dtype)
+                            if total_bits % radix_bits:
+                                raise ValueError(
+                                    f"radix_bits={radix_bits} must divide "
+                                    f"key bits {total_bits}"
+                                )
+                            method = resolve_stream_hist(hist_method, dtype)
+                            shift0 = total_bits - radix_bits
+                            hist = np.zeros((1 << radix_bits,), np.int64)
+                        n += int(keys.size)
+                        for h in win.push(
+                            keys, shift0, radix_bits, [None], method, kdt
+                        ):
+                            hist += h[None]
+                    for h in win.drain():
+                        hist += h[None]
+                if n == 0:
+                    raise ValueError(
+                        "streaming selection requires a non-empty stream"
+                    )
+            except BaseException:
+                if writer is not None:
+                    writer.abort()
+                raise
+            if writer is not None:
+                gen0 = writer.commit()
+                created.append(gen0)
+                if not own_store:
+                    keep_gen0 = gen0
+                _log_pass(0, gen0)
+                read_gen = gen0
+            else:
+                _log_pass(0)
+            _validate_ks(ks, n)
+            states = []
+            for k in ks:
+                prefix, kk, pop = _np_walk(hist, k, None, radix_bits)
+                states.append([prefix, kk, radix_bits, pop])
+
+        def _active(st):
+            return st[2] < total_bits and st[3] > collect_budget
+
+        while any(_active(st) for st in states):
+            # active ranks advance in lockstep (a rank only ever EXITS the
+            # active set), so they all sit at one resolved depth: one
+            # streamed pass serves every distinct surviving prefix
+            resolved = next(st[2] for st in states if _active(st))
+            shift = total_bits - resolved - radix_bits
+            prefixes = sorted({st[0] for st in states if _active(st)})
+            expected = {st[0]: st[3] for st in states if _active(st)}
+            hists = {p: np.zeros((1 << radix_bits,), np.int64) for p in prefixes}
+            writer = filter_specs = None
+            if store is not None:
+                # survivors this pass must carry forward: the active
+                # prefixes at this depth, plus parked ranks (population
+                # already <= collect_budget) still awaiting the collect —
+                # so the final generation serves every collect spec
+                filter_specs = sorted(
+                    {(resolved, int(st[0])) for st in states if _active(st)}
+                    | {
+                        (st[2], int(st[0]))
+                        for st in states
+                        if not _active(st) and st[2] < total_bits
+                    }
+                )
+                writer = store.new_generation()
+            win = _HistogramWindow(window)
+            try:
+                with _key_chunk_stream(
+                    _gen_src(), dtype, hist_method=method, **stream_kw
+                ) as kc:
+                    for keys, _ in kc:
+                        if writer is not None:
+                            # tee BEFORE the window can release the staged
+                            # buffer; the filter runs on the chunk's own
+                            # device, only survivors cross back
+                            _spill_tee_survivors(
+                                writer, keys, filter_specs, dtype, kdt,
+                                total_bits, devs,
+                            )
+                        for hd in win.push(
+                            keys, shift, radix_bits, prefixes, method, kdt
+                        ):
+                            for p, h in hd.items():
+                                hists[p] += h
+                    for hd in win.drain():
+                        for p, h in hd.items():
+                            hists[p] += h
+            except BaseException:
+                if writer is not None:
+                    writer.abort()
+                raise
+            for p in prefixes:
+                # replay-stability check, mirroring _collect_survivors':
+                # this pass's population under each surviving prefix must
+                # equal the bucket count the PREVIOUS pass (or the seeding
+                # sketch) established — a drifting source fails loudly here
+                # instead of walking a corrupt histogram to a wrong answer.
+                # On the spill path the read is a checksummed generation,
+                # so this is unreachable short of a store bug; it stays as
+                # the belt to the spill records' braces.
+                if int(hists[p].sum()) != expected[p]:
+                    raise RuntimeError(
+                        f"chunk source is not replay-stable: prefix {p:#x} "
+                        f"holds {int(hists[p].sum())} elements this pass, "
+                        f"previous pass counted {expected[p]}. The source "
+                        "callable must yield identical data on every "
+                        "invocation."
+                    )
+            if writer is not None:
+                gen = writer.commit()
+                _log_pass(resolved // radix_bits, gen)
+                _rotate(gen)
+            for st in states:
+                if _active(st):
+                    st[0], st[1], st[3] = _np_walk(
+                        hists[st[0]], st[1], st[0], radix_bits
+                    )
+                    st[2] = resolved + radix_bits
+
+        specs = {}
+        for prefix, _kk, resolved, pop in states:
+            if resolved < total_bits:
+                specs[(resolved, int(prefix))] = pop
+        collected = {}
+        if specs:
+            collected = _collect_survivors(
+                _gen_src(), dtype, specs, pipeline_depth=pipeline_depth,
+                timer=timer, devices=None if devices is None else devs,
+                hist_method=method,
+            )
+            _log_pass("collect")
+
+        answers = []
+        for prefix, kk, resolved, _pop in states:
+            if resolved == total_bits:
+                # every key bit determined (either the schedule ran out or
+                # the survivors are duplicates of one key): the prefix IS
+                # the answer
+                ans_key = kdt.type(prefix)
+            else:
+                surv = collected[(resolved, int(prefix))]
+                ans_key = np.partition(surv, kk - 1)[kk - 1]
+            answers.append(
+                _dt.np_from_sortable_bits(np.asarray([ans_key], kdt), dtype)[0]
+            )
+        return answers
+    finally:
+        if own_store:
+            store.close()
+        elif store is not None:
+            # caller-owned store: drop descent-internal generations, keep
+            # the pass-0 tee (it can serve refine/certificate/next calls)
+            for g in created:
+                if g is not keep_gen0 and not g.dropped:
+                    store.drop_generation(g)
 
 
 def streaming_rank_certificate(
@@ -630,7 +943,11 @@ def streaming_rank_certificate(
     stages chunks round-robin so each device counts its own resident
     chunks, with the per-chunk int counts folded into the host int
     accumulators in chunk order (integer addition — order-exact either
-    way); the host-exact 64-bit/f64-on-TPU routes keep counting on host."""
+    way); the host-exact 64-bit/f64-on-TPU routes keep counting on host.
+    ``source`` may be a :class:`~mpi_k_selection_tpu.streaming.spill.
+    SpillStore` with a committed generation: the single counting pass then
+    replays the spilled keys instead of the original stream (certifying a
+    one-shot source's answer without re-reading it)."""
     src = as_chunk_source(source)
     devs = _pl.resolve_stream_devices(devices)
     multi = len(devs) > 1 and _pl.validate_pipeline_depth(pipeline_depth) > 0

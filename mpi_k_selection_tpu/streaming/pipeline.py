@@ -87,8 +87,12 @@ MAX_PIPELINE_DEPTH = 64
 #: Worker threads carry this prefix; tests assert none outlive their pass.
 THREAD_NAME_PREFIX = "ksel-pipeline"
 
-#: Phases the producer thread accounts against the shared PhaseTimer.
-INGEST_PHASES = ("pipeline.produce", "pipeline.encode", "pipeline.stage")
+#: Phases the producer thread accounts against the shared PhaseTimer
+#: (``pipeline.spill`` is the pass-0 tee writing encoded keys to the
+#: survivor spill store — producer-side ingest work like the rest).
+INGEST_PHASES = (
+    "pipeline.produce", "pipeline.encode", "pipeline.stage", "pipeline.spill",
+)
 
 #: Phase the consumer accounts: time spent blocked waiting on the queue.
 STALL_PHASE = "pipeline.stall"
@@ -360,7 +364,9 @@ def stage_keys(keys: np.ndarray, device=None, pool: StagingPool | None = None) -
     if bucket == n:
         data = jax.device_put(keys, device)
         data.block_until_ready()
-        return StagedKeys(data, n)
+        # device recorded even without a pad buffer: the spill tee keys
+        # its records by the staged slot (chunk->device determinism)
+        return StagedKeys(data, n, device=device)
     if pool is None:
         pool = STAGING_POOL
     buf = pool.acquire(bucket, keys.dtype, device)
@@ -401,14 +407,25 @@ class ChunkPipeline:
     ``devices[j % p]`` with an explicit ``jax.device_put`` target —
     round-robin, so the consumer can keep one histogram in flight per
     device. ``(None,)`` (the default) is the single-slot uncommitted PR 3
-    path.
+    path. Replayed spill chunks (streaming/spill.py:SpillChunk) carry the
+    slot their record was staged to originally; the producer honors it, so
+    a replay re-stages every chunk onto the device that already compiled
+    its bucket programs instead of re-dealing the round robin.
+
+    ``spill`` is an optional
+    :class:`~mpi_k_selection_tpu.streaming.spill.SpillWriter`: the pass-0
+    tee. The producer appends each non-empty chunk's HOST encoded keys
+    (plus the staged slot) to it right after staging — on this thread, so
+    the disk write overlaps the consumer's device compute. The caller
+    commits/aborts the writer after the stream closes (the thread is
+    joined first, so there is no concurrent append).
     """
 
     _ids = itertools.count()
 
     def __init__(
         self, src, dtype=None, *, depth: int, hist_method=None, timer=None,
-        devices=None,
+        devices=None, spill=None,
     ):
         self._src = src
         self._dtype = None if dtype is None else np.dtype(dtype)
@@ -420,6 +437,7 @@ class ChunkPipeline:
             )
         self._hist_method = hist_method
         self._timer = timer
+        self._spill = spill
         # resolved on the CALLER's thread (jax.devices() may initialize the
         # backend; the slot order must be fixed before the producer starts)
         self._devices = resolve_stream_devices(devices)
@@ -469,6 +487,7 @@ class ChunkPipeline:
 
     def _produce_inner(self) -> None:
         from mpi_k_selection_tpu.streaming import chunked as _chunked
+        from mpi_k_selection_tpu.streaming import spill as _sp
 
         dtype = self._dtype
         method = None
@@ -490,15 +509,33 @@ class ChunkPipeline:
                     dtype = np.dtype(c.dtype)
                 if method is None and self._hist_method is not None:
                     method = _chunked.resolve_stream_hist(self._hist_method, dtype)
+                # a replayed spill record re-stages onto its ORIGINAL slot
+                # (the device that already compiled its bucket programs)
+                replay_slot = (
+                    chunk.device_slot
+                    if isinstance(chunk, _sp.SpillChunk)
+                    else None
+                )
+                host_keys = keys if isinstance(keys, np.ndarray) else None
+                staged_slot = None
                 if method not in (None, "numpy") and isinstance(keys, np.ndarray):
                     with _phase(self._timer, "pipeline.stage"):
-                        # the slot advances ONLY on staged chunks, so the
-                        # chunk->device assignment is a pure function of
-                        # the staged sequence — identical on every replay
-                        keys = stage_keys(
-                            keys, self._devices[slot % len(self._devices)]
-                        )
-                        slot += 1
+                        if replay_slot is None:
+                            # the slot advances ONLY on staged chunks, so
+                            # the chunk->device assignment is a pure
+                            # function of the staged sequence — identical
+                            # on every replay
+                            staged_slot = slot % len(self._devices)
+                            slot += 1
+                        else:
+                            staged_slot = replay_slot % len(self._devices)
+                        keys = stage_keys(keys, self._devices[staged_slot])
+                if self._spill is not None:
+                    with _phase(self._timer, "pipeline.spill"):
+                        # device-chunk keys live on device: land them host-
+                        # side for the record (host chunks tee in place)
+                        hk = host_keys if host_keys is not None else np.asarray(keys)
+                        self._spill.append(hk, dtype, device_slot=staged_slot)
                 # every consumer reads only `.dtype` off the companion (and
                 # only on the first chunk): a zero-length stand-in keeps the
                 # queue from pinning the full original chunk alongside its

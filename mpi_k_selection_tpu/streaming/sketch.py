@@ -146,7 +146,8 @@ class RadixSketch:
         return self
 
     def update_stream(
-        self, source, *, pipeline_depth=None, timer=None, devices=None
+        self, source, *, pipeline_depth=None, timer=None, devices=None,
+        spill=None,
     ) -> "RadixSketch":
         """Fold EVERY chunk of a replayable/listed ``source`` in (one
         stream pass), drawing from the pipelined iterator: a background
@@ -164,10 +165,20 @@ class RadixSketch:
         instead). The host-exact 64-bit-no-x64 and f64-on-TPU routes keep
         counting on host regardless.
 
+        ``spill`` is an optional
+        :class:`~mpi_k_selection_tpu.streaming.spill.SpillStore`: the ONE
+        stream pass also tees every chunk's encoded keys into a new
+        generation of it (the sketch-then-refine flow for one-shot
+        sources — a bare iterator/generator is accepted when teeing).
+        Afterwards the STORE is a first-class chunk source:
+        ``sketch.refine(store, k)`` runs the exact descent entirely from
+        disk, never re-reading the original stream.
+
         Bit-identical to sequential :meth:`update` calls over the same
         chunks, for every ``pipeline_depth`` x ``devices`` combination.
         Returns ``self``."""
         from mpi_k_selection_tpu.streaming import pipeline as _pl
+        from mpi_k_selection_tpu.streaming import spill as _sp
         from mpi_k_selection_tpu.streaming.chunked import (
             _key_chunk_stream,
             as_chunk_source,
@@ -176,30 +187,44 @@ class RadixSketch:
         pipeline_depth = _pl.validate_pipeline_depth(pipeline_depth)
         devs = _pl.resolve_stream_devices(devices)
         multi = len(devs) > 1 and pipeline_depth > 0
-        src = as_chunk_source(source)
+        if spill is not None and not isinstance(spill, _sp.SpillStore):
+            raise TypeError(
+                "update_stream's spill must be a SpillStore (the caller "
+                f"owns its lifecycle), got {type(spill).__name__!r}"
+            )
+        src = as_chunk_source(source, one_shot_ok=spill is not None)
+        writer = spill.new_generation() if spill is not None else None
         win = _pl.InflightWindow(len(devs), self._fold_staged)
-        with _key_chunk_stream(
-            src, self.dtype, pipeline_depth=pipeline_depth, timer=timer,
-            # "scatter" handles the deepest level's 2**resolution_bits
-            # buckets (the same method distributed_sketch defaults to);
-            # resolve_stream_hist downgrades it to host counting exactly
-            # where the device would not be bit-exact
-            hist_method="scatter" if multi else None,
-            devices=devs if multi else None,
-        ) as kc:
-            for keys, _ in kc:
-                if isinstance(keys, _pl.StagedKeys):
-                    win.push(self._dispatch_staged(keys))
-                    continue
-                # device chunks arrive as device keys (bitwise twins of the
-                # host transform; the f64-on-TPU route already resolved to
-                # host-exact keys inside the iterator) — land them host-side
-                # for the bincount accumulator
-                if not isinstance(keys, np.ndarray):
-                    keys = np.asarray(keys)
-                self._update_keys(keys)
-            for _ in win.drain():
-                pass
+        try:
+            with _key_chunk_stream(
+                src, self.dtype, pipeline_depth=pipeline_depth, timer=timer,
+                # "scatter" handles the deepest level's 2**resolution_bits
+                # buckets (the same method distributed_sketch defaults to);
+                # resolve_stream_hist downgrades it to host counting exactly
+                # where the device would not be bit-exact
+                hist_method="scatter" if multi else None,
+                devices=devs if multi else None,
+                spill=writer,
+            ) as kc:
+                for keys, _ in kc:
+                    if isinstance(keys, _pl.StagedKeys):
+                        win.push(self._dispatch_staged(keys))
+                        continue
+                    # device chunks arrive as device keys (bitwise twins of
+                    # the host transform; the f64-on-TPU route already
+                    # resolved to host-exact keys inside the iterator) —
+                    # land them host-side for the bincount accumulator
+                    if not isinstance(keys, np.ndarray):
+                        keys = np.asarray(keys)
+                    self._update_keys(keys)
+                for _ in win.drain():
+                    pass
+        except BaseException:
+            if writer is not None:
+                writer.abort()
+            raise
+        if writer is not None:
+            writer.commit()
         return self
 
     def _dispatch_staged(self, staged) -> tuple:
@@ -397,7 +422,12 @@ class RadixSketch:
     def refine(self, source, k: int, **kwargs):
         """Exact k-th smallest over ``source`` (which must replay the very
         stream this sketch accumulated), reusing the sketch's resolved
-        prefix to skip its ``levels`` passes. Keyword options are those of
+        prefix to skip its ``levels`` passes. ``source`` may be the
+        :class:`~mpi_k_selection_tpu.streaming.spill.SpillStore` a
+        one-shot :meth:`update_stream` teed into — the refinement then
+        runs entirely from the spilled generation, shrinking it
+        geometrically pass over pass, and the original stream is never
+        read again. Keyword options are those of
         streaming/chunked.py:streaming_kselect."""
         from mpi_k_selection_tpu.streaming.chunked import streaming_kselect
 

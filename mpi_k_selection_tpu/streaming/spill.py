@@ -1,0 +1,406 @@
+"""Survivor spill store — the per-device on-disk chunk cache that lets the
+out-of-core descent shrink geometrically instead of replaying the source.
+
+The chunked descent (streaming/chunked.py) is a ``key_bits / radix_bits``-
+pass walk, and without a cache EVERY pass re-streams the entire source:
+a P-pass descent over an out-of-core input moves ~P·N key bytes across the
+host->device boundary when only pass 0 actually needs all N. The reference
+CGM's core perf idea is the opposite discipline — discard the partitions
+that provably cannot hold the k-th element and recurse on a shrinking
+window (``TODO-kth-problem-cgm.c`` L/E/G counts + window rebase). This
+module is that discipline applied to the streaming axis:
+
+- pass 0 TEES each chunk's encoded keys to a spill *generation* (written on
+  the pipeline's producer thread, so the disk write overlaps device
+  compute);
+- every later pass reads the previous generation, filters each chunk to the
+  surviving prefixes ON its owning device, and writes only the compacted
+  survivors — ~1/2^radix_bits of the prior generation — as the next
+  generation;
+- total bytes streamed drop from ~P·N to ~N·(2 + 1/2^b + 1/4^b + ...), and
+  one-shot (non-replayable) sources become first-class: passes >= 1 never
+  touch the source.
+
+Records are bucket-sized and keyed by ``(chunk_index, bucket, dtype,
+device)`` — the :class:`~mpi_k_selection_tpu.streaming.pipeline.
+StagingPool` key plus the chunk index — so a replay re-stages every chunk
+onto the round-robin device that already compiled its bucket programs,
+preserving the chunk->device determinism contract of the multi-device
+ingest. Every record carries a CRC32 and a full metadata header; any
+mismatch raises :class:`~mpi_k_selection_tpu.errors.SpillRecordError`
+before a single key reaches a histogram (a corrupt cache fails loudly,
+never answers wrong).
+
+Disk bound: descents drop older generations eagerly, so an
+internally-created store holds at most two generations at once —
+~2·N·key_bytes worst case (adversarial duplicates), ~N·(1 + 1/2^b)
+typically. A CALLER-owned store additionally keeps its pass-0 tee alive
+for later calls, so its worst case is ~3·N·key_bytes (kept gen 0 + the
+generation being read + the one being written), ~N typical.
+
+Lifecycle: stores created internally by ``streaming_kselect{,_many}``
+live in a ``ksel-spill-*`` temp directory and are removed on EVERY exit
+path (success, consumer raise, producer raise — tests/conftest.py fails
+any test that leaks one). Caller-owned stores (``spill=SpillStore(...)``,
+or a sketch ``update_stream(..., spill=store)`` tee) keep their pass-0
+generation so it can serve later calls (``refine``, the rank
+certificate, a second descent); only descent-internal generations are
+dropped.
+
+This module is the ONE sanctioned file-writing surface under streaming/ —
+lint rule KSL008 flags any other raw ``open``/``np.save``-class write
+there, because a write that dodges the record keying, checksums and
+cleanup discipline is exactly how a cache silently feeds a descent stale
+or truncated survivors.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import shutil
+import struct
+import tempfile
+import zlib
+
+import numpy as np
+
+from mpi_k_selection_tpu.errors import SpillError, SpillRecordError
+from mpi_k_selection_tpu.streaming.pipeline import _bucket_elems
+
+#: Temp-directory prefix for internally-created stores; tests assert none
+#: outlive their call (the spill twin of pipeline.THREAD_NAME_PREFIX).
+SPILL_DIR_PREFIX = "ksel-spill-"
+
+#: The ``spill=`` knob's string modes (a SpillStore instance is also legal).
+SPILL_MODES = ("auto", "off", "force")
+
+_MAGIC = b"KSPILL1\x00"
+_VERSION = 1
+# magic, version, chunk_index, n_valid, bucket, device_slot,
+# key dtype str, orig dtype str, payload crc32, payload nbytes
+_HEADER = struct.Struct("<8sIqqqq8s8sIQ")
+
+
+def validate_spill_mode(spill):
+    """Normalize the ``spill`` knob: one of :data:`SPILL_MODES`, or an open
+    :class:`SpillStore` to tee into / read from (caller-owned lifecycle)."""
+    if isinstance(spill, SpillStore):
+        if spill.closed:
+            raise SpillError("spill store is closed")
+        return spill
+    if spill in SPILL_MODES:
+        return spill
+    raise ValueError(
+        f"spill must be one of {SPILL_MODES} or a SpillStore, got {spill!r}"
+    )
+
+
+def _pack_dtype(dt) -> bytes:
+    s = np.dtype(dt).str.encode("ascii")
+    if len(s) > 8:  # pragma: no cover - no supported dtype exceeds '<u8'
+        raise SpillError(f"dtype tag {s!r} exceeds the 8-byte record field")
+    return s.ljust(8, b"\x00")
+
+
+def _unpack_dtype(raw: bytes, path: str) -> np.dtype:
+    try:
+        return np.dtype(raw.rstrip(b"\x00").decode("ascii"))
+    except (TypeError, UnicodeDecodeError) as e:
+        raise SpillRecordError(f"spill record {path}: bad dtype tag {raw!r}") from e
+
+
+@dataclasses.dataclass(frozen=True)
+class SpillRecord:
+    """On-disk metadata of one spilled chunk — the ``(chunk_index, bucket,
+    dtype, device)`` key plus payload size/checksum. The header written to
+    disk repeats all of it, and the reader cross-checks both."""
+
+    path: str
+    chunk_index: int
+    n_valid: int
+    bucket: int
+    device_slot: int | None
+    key_dtype: np.dtype
+    orig_dtype: np.dtype
+    crc32: int
+    nbytes: int
+
+
+@dataclasses.dataclass(frozen=True)
+class SpillChunk:
+    """One replayed chunk: already-encoded keys (host, key space) plus the
+    staging metadata the pipeline needs to re-stage it onto the SAME
+    round-robin slot that consumed it originally. ``streaming/chunked.py:
+    _encode_chunk`` recognizes this type and skips re-encoding."""
+
+    keys: np.ndarray
+    orig_dtype: np.dtype
+    device_slot: int | None
+    chunk_index: int
+    bucket: int
+
+
+class SpillWriter:
+    """Append-only writer for ONE spill generation. ``append`` is called
+    from a single thread per pass (the pipeline's producer for the pass-0
+    tee, the descent's consumer for the filtered survivor writes);
+    ``commit``/``abort`` run after the pass's threads are joined."""
+
+    def __init__(self, store: "SpillStore", index: int, path: str):
+        self.store = store
+        self.index = index
+        self.path = path
+        os.makedirs(path)
+        self._records: list[SpillRecord] = []
+        self._count = 0
+        self._done = False
+
+    def append(self, keys: np.ndarray, orig_dtype, device_slot=None) -> SpillRecord:
+        """Write one chunk's encoded keys as a record. ``keys`` must be a
+        host key-space array (the caller materializes device survivors);
+        ``orig_dtype`` is the STREAM dtype the keys encode (recorded so a
+        replay validates against the stream like any other chunk)."""
+        if self._done:
+            raise SpillError("spill generation already committed/aborted")
+        keys = np.ascontiguousarray(keys)
+        if keys.ndim != 1:  # pragma: no cover - callers always ravel
+            keys = keys.ravel()
+        n = int(keys.shape[0])
+        slot = -1 if device_slot is None else int(device_slot)
+        rec_path = os.path.join(self.path, f"r{self._count:08d}.kspill")
+        crc = zlib.crc32(keys.data) & 0xFFFFFFFF
+        header = _HEADER.pack(
+            _MAGIC,
+            _VERSION,
+            self._count,
+            n,
+            _bucket_elems(n),
+            slot,
+            _pack_dtype(keys.dtype),
+            _pack_dtype(orig_dtype),
+            crc,
+            keys.nbytes,
+        )
+        with open(rec_path, "wb") as f:
+            f.write(header)
+            f.write(keys.data)
+        rec = SpillRecord(
+            path=rec_path,
+            chunk_index=self._count,
+            n_valid=n,
+            bucket=_bucket_elems(n),
+            device_slot=device_slot,
+            key_dtype=np.dtype(keys.dtype),
+            orig_dtype=np.dtype(orig_dtype),
+            crc32=crc,
+            nbytes=int(keys.nbytes),
+        )
+        self._records.append(rec)
+        self._count += 1
+        return rec
+
+    def commit(self) -> "SpillGeneration":
+        """Finalize: register the generation with the store and return it."""
+        if self._done:
+            raise SpillError("spill generation already committed/aborted")
+        self._done = True
+        gen = SpillGeneration(self.store, self.index, self.path, tuple(self._records))
+        self.store._register(gen)
+        return gen
+
+    def abort(self) -> None:
+        """Drop every record written so far (idempotent) — the unwind path
+        when the pass feeding this generation raises mid-stream."""
+        if self._done:
+            return
+        self._done = True
+        shutil.rmtree(self.path, ignore_errors=True)
+
+
+class SpillGeneration:
+    """One committed generation: an ordered, replayable set of records.
+    ``as_source()`` is a valid chunk source for every streaming entry
+    point — each invocation re-reads (and re-validates) the records."""
+
+    def __init__(self, store, index: int, path: str, records: tuple):
+        self.store = store
+        self.index = index
+        self.path = path
+        self.records = records
+        self.dropped = False
+
+    @property
+    def nbytes(self) -> int:
+        """Total payload bytes (the bytes a pass reading this gen streams)."""
+        return sum(r.nbytes for r in self.records)
+
+    @property
+    def keys(self) -> int:
+        return sum(r.n_valid for r in self.records)
+
+    def iter_chunks(self):
+        """Yield every record as a :class:`SpillChunk`, validating headers,
+        sizes and checksums — any mismatch raises
+        :class:`~mpi_k_selection_tpu.errors.SpillRecordError`."""
+        if self.dropped:
+            raise SpillError(
+                f"spill generation {self.index} was dropped (or its store "
+                "closed); it can no longer serve as a chunk source"
+            )
+        for rec in self.records:
+            yield _read_record(rec)
+
+    def as_source(self):
+        """Zero-arg callable returning a fresh record iterator — the
+        replayable chunk-source form streaming/chunked.py consumes."""
+        return self.iter_chunks
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (
+            f"SpillGeneration(index={self.index}, records={len(self.records)}, "
+            f"keys={self.keys}, nbytes={self.nbytes})"
+        )
+
+
+def _read_record(rec: SpillRecord) -> SpillChunk:
+    try:
+        f = open(rec.path, "rb")
+    except OSError as e:
+        raise SpillRecordError(f"spill record {rec.path}: unreadable ({e})") from e
+    with f:
+        head = f.read(_HEADER.size)
+        if len(head) != _HEADER.size:
+            raise SpillRecordError(
+                f"spill record {rec.path}: truncated header "
+                f"({len(head)} of {_HEADER.size} bytes)"
+            )
+        (
+            magic, version, chunk_index, n_valid, bucket, slot,
+            key_dt_raw, orig_dt_raw, crc, nbytes,
+        ) = _HEADER.unpack(head)
+        if magic != _MAGIC or version != _VERSION:
+            raise SpillRecordError(
+                f"spill record {rec.path}: bad magic/version "
+                f"({magic!r}, {version})"
+            )
+        key_dt = _unpack_dtype(key_dt_raw, rec.path)
+        orig_dt = _unpack_dtype(orig_dt_raw, rec.path)
+        meta = (
+            chunk_index, n_valid, bucket,
+            None if slot < 0 else slot, key_dt, orig_dt, crc, nbytes,
+        )
+        want = (
+            rec.chunk_index, rec.n_valid, rec.bucket,
+            rec.device_slot, rec.key_dtype, rec.orig_dtype, rec.crc32, rec.nbytes,
+        )
+        if meta != want:
+            raise SpillRecordError(
+                f"spill record {rec.path}: header does not match the "
+                f"writer's metadata (header {meta}, expected {want})"
+            )
+        if nbytes != n_valid * key_dt.itemsize:
+            raise SpillRecordError(
+                f"spill record {rec.path}: payload size {nbytes} != "
+                f"{n_valid} x {key_dt.itemsize}-byte keys"
+            )
+        payload = f.read(nbytes)
+        if len(payload) != nbytes:
+            raise SpillRecordError(
+                f"spill record {rec.path}: truncated payload "
+                f"({len(payload)} of {nbytes} bytes)"
+            )
+        if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+            raise SpillRecordError(
+                f"spill record {rec.path}: checksum mismatch (corrupt payload)"
+            )
+    return SpillChunk(
+        keys=np.frombuffer(payload, dtype=key_dt),
+        orig_dtype=orig_dt,
+        device_slot=None if slot < 0 else int(slot),
+        chunk_index=int(chunk_index),
+        bucket=int(bucket),
+    )
+
+
+class SpillStore:
+    """A directory of spill generations plus the per-pass streaming log.
+
+    Create one explicitly to own the lifecycle (tee a sketch's single
+    stream pass, inspect ``pass_log`` after a descent, reuse gen 0 across
+    calls), or let ``streaming_kselect{,_many}`` create and clean one up
+    internally (``spill='force'``, or ``'auto'`` with a one-shot source).
+    Context-manager protocol closes (removes) the directory.
+    """
+
+    def __init__(self, spill_dir: str | None = None):
+        if spill_dir is not None:
+            os.makedirs(spill_dir, exist_ok=True)
+        self.root = tempfile.mkdtemp(prefix=SPILL_DIR_PREFIX, dir=spill_dir)
+        self.generations: dict[int, SpillGeneration] = {}
+        #: One dict per streamed pass of a spill-enabled descent:
+        #: ``{"pass", "read", "keys_read", "bytes_read"[, "keys_written",
+        #: "bytes_written"]}`` — the raw material of bench_streaming_oc's
+        #: ``_spill`` record (pass_shrink_ratio).
+        self.pass_log: list[dict] = []
+        self._counter = 0
+        self._closed = False
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def _check_open(self) -> None:
+        if self._closed:
+            raise SpillError("spill store is closed")
+
+    def new_generation(self) -> SpillWriter:
+        self._check_open()
+        idx = self._counter
+        self._counter += 1
+        return SpillWriter(self, idx, os.path.join(self.root, f"gen-{idx:04d}"))
+
+    def _register(self, gen: SpillGeneration) -> None:
+        self._check_open()
+        self.generations[gen.index] = gen
+
+    def latest_generation(self) -> SpillGeneration:
+        """The newest committed generation — what a store-as-source read
+        (``streaming_kselect(store, k)``, the certificate, ``refine``)
+        streams from."""
+        self._check_open()
+        if not self.generations:
+            raise SpillError(
+                "spill store holds no committed generation; run a teeing "
+                "pass first (streaming_kselect(..., spill=store) or "
+                "RadixSketch.update_stream(..., spill=store))"
+            )
+        return self.generations[max(self.generations)]
+
+    def drop_generation(self, gen: SpillGeneration) -> None:
+        """Delete one generation's records (the eager disk-bound trim:
+        at most two generations coexist during a descent)."""
+        gen.dropped = True
+        self.generations.pop(gen.index, None)
+        shutil.rmtree(gen.path, ignore_errors=True)
+
+    def close(self) -> None:
+        """Remove the whole store directory. Idempotent; every generation
+        becomes unreadable (``dropped``)."""
+        if self._closed:
+            return
+        self._closed = True
+        for gen in self.generations.values():
+            gen.dropped = True
+        self.generations.clear()
+        shutil.rmtree(self.root, ignore_errors=True)
+
+    def __enter__(self) -> "SpillStore":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        state = "closed" if self._closed else f"{len(self.generations)} gens"
+        return f"SpillStore({self.root!r}, {state})"

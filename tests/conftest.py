@@ -48,3 +48,23 @@ def _no_leaked_pipeline_threads():
         t.join(timeout=5.0)
     alive = [t.name for t in stragglers if t.is_alive()]
     assert not alive, f"leaked streaming-pipeline threads: {alive}"
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_spill_dirs():
+    """Every internally-created spill store (streaming/spill.py) must be
+    removed by the time its descent returns — on success AND on every
+    raise path (consumer, producer, corrupt record). A ``ksel-spill-*``
+    temp dir surviving a test is a cleanup bug in streaming/chunked.py's
+    spill lifecycle, not test noise. (Pre-existing dirs from an earlier
+    crashed process are tolerated: only NEW leaks fail the test.)"""
+    import glob
+    import tempfile
+
+    from mpi_k_selection_tpu.streaming.spill import SPILL_DIR_PREFIX
+
+    pattern = os.path.join(tempfile.gettempdir(), SPILL_DIR_PREFIX + "*")
+    before = set(glob.glob(pattern))
+    yield
+    leaked = sorted(set(glob.glob(pattern)) - before)
+    assert not leaked, f"leaked spill temp dirs: {leaked}"

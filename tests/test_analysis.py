@@ -379,6 +379,93 @@ def test_ksl007_noqa(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# KSL008 — raw file writes in streaming/ outside the spill store API
+
+
+KSL008_POSITIVE = """
+    import numpy as np
+
+    def cache_chunk(path, keys):
+        with open(path, "wb") as f:
+            f.write(keys.tobytes())
+
+    def cache_npy(path, keys):
+        np.save(path, keys)
+
+    def cache_tofile(path, keys):
+        keys.tofile(path)
+
+    def cache_pathlib(path, keys):
+        import pathlib
+
+        with pathlib.Path(path).open("wb") as f:
+            f.write(keys.tobytes())
+"""
+
+KSL008_NEGATIVE = """
+    import numpy as np
+
+    def load_chunk(path):
+        # reads are fine: the rule gates WRITES that dodge the record
+        # keying/checksum/cleanup discipline
+        with open(path, "rb") as f:
+            return np.frombuffer(f.read(), np.uint32)
+
+    def load_default_mode(path):
+        return open(path).read()
+
+    def load_pathlib(path):
+        import pathlib
+
+        with pathlib.Path(path).open("rb") as f:
+            return f.read()
+"""
+
+
+def test_ksl008_positive_in_streaming(tmp_path):
+    report = _lint_source(tmp_path, KSL008_POSITIVE, name="streaming/cache.py")
+    hits = [f for f in report.unsuppressed if f.rule == "KSL008"]
+    assert len(hits) == 4  # open/np.save/.tofile/Path(...).open
+    assert all("spill store" in f.message for f in hits)
+
+
+def test_ksl008_negative_reads_ok(tmp_path):
+    report = _lint_source(tmp_path, KSL008_NEGATIVE, name="streaming/cache.py")
+    assert "KSL008" not in _rules_hit(report)
+
+
+def test_ksl008_quiet_outside_streaming_and_in_spill(tmp_path):
+    # the rule scopes to streaming/ (bench/native/docs code writes files
+    # legitimately) and exempts the sanctioned writer itself
+    report = _lint_source(tmp_path, KSL008_POSITIVE, name="ops/cache.py")
+    assert "KSL008" not in _rules_hit(report)
+    report = _lint_source(tmp_path, KSL008_POSITIVE, name="streaming/spill.py")
+    assert "KSL008" not in _rules_hit(report)
+
+
+def test_ksl008_dynamic_open_mode_flagged(tmp_path):
+    # a non-constant mode cannot be proven read-only: flag it
+    src = """
+    def cache(path, mode):
+        return open(path, mode)
+    """
+    report = _lint_source(tmp_path, src, name="streaming/cache.py")
+    assert "KSL008" in _rules_hit(report)
+
+
+def test_ksl008_noqa(tmp_path):
+    src = KSL008_POSITIVE.replace(
+        "np.save(path, keys)",
+        "np.save(path, keys)  # ksel: noqa[KSL008] -- fixture justification",
+    )
+    report = _lint_source(tmp_path, src, name="streaming/cache.py")
+    hits = [f for f in report.unsuppressed if f.rule == "KSL008"]
+    assert len(hits) == 3  # the other three writes still fire
+    sup = [f for f in report.findings if f.rule == "KSL008" and f.suppressed]
+    assert sup and sup[0].justification == "fixture justification"
+
+
+# ---------------------------------------------------------------------------
 # jaxpr contract checks (KSC101-KSC103) self-tests
 
 

@@ -107,3 +107,44 @@ def test_topk_1d_methods_all_equal(method):
 def test_topk_1d_methods_reject_2d(method):
     with pytest.raises(ValueError, match="1-D"):
         topk(jnp.zeros((4, 1 << 18), jnp.float32), 2, method=method)
+
+
+def test_threshold_topk_f64_tpu_warns_once_per_path(monkeypatch):
+    """ADVICE r5 #1 regression: host float64 1-D top-k via
+    method='threshold' builds its own _Descent, bypassing the radix
+    shells' exact f64 host-key route — on the TPU backend it must emit
+    the one-time ~49-bit-approximation warning (exactly once), and the
+    kselect-path warning must still fire afterwards: the two paths carry
+    different advice, so neither may suppress the other."""
+    import warnings
+
+    import jax
+
+    from mpi_k_selection_tpu.ops import histogram as hist_mod
+    from mpi_k_selection_tpu.ops import radix as radix_mod
+    from mpi_k_selection_tpu.utils import compat
+
+    # fake the backend NAME only; force every histogram onto the XLA
+    # scatter path so no TPU Pallas kernel is built on the CPU test host
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(
+        hist_mod, "resolve_hist_method", lambda method, key_dtype=None: "scatter"
+    )
+    monkeypatch.setattr(radix_mod, "_f64_tpu_approx_warned", set())
+    x = np.random.default_rng(11).standard_normal(1 << 12)
+    with compat.enable_x64(True):
+        xd = jnp.asarray(x)
+        assert xd.dtype == jnp.float64
+        with pytest.warns(UserWarning, match="threshold top-k"):
+            topk(xd, 8, method="threshold")
+        # exactly once per process for this path: a second call is silent
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            topk(xd, 8, method="threshold")
+        # ...and the kselect advice is NOT suppressed by the top-k one
+        with pytest.warns(UserWarning, match="bit-exact f64"):
+            jax.jit(
+                lambda: radix_mod.radix_select(x, 500, hist_method="scatter")
+            )()
+        # both advice variants are now recorded independently
+        assert len(radix_mod._f64_tpu_approx_warned) == 2

@@ -1,0 +1,463 @@
+"""Survivor spill store (ISSUE 5): geometric pass shrinking for the
+out-of-core streaming descent.
+
+The acceptance contract: ``spill="off"`` is bit-identical to the pre-spill
+replay path, ``spill="force"`` is bit-identical to ``spill="off"`` for
+every devices x pipeline_depth combination (heterogeneous/ragged/empty
+chunks included), a one-shot generator completes exactly via the spill
+path (and still gets the actionable error under ``spill="off"``), a
+corrupt/truncated spill record raises a typed error before any key is
+counted, per-pass streamed bytes shrink geometrically, and no spill temp
+dir outlives its call on ANY exit path (the autouse conftest fixture
+backstops every test here).
+"""
+
+import glob
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from mpi_k_selection_tpu.backends import seq
+from mpi_k_selection_tpu.errors import SpillError, SpillRecordError
+from mpi_k_selection_tpu.streaming import (
+    RadixSketch,
+    SpillStore,
+    streaming_kselect,
+    streaming_kselect_many,
+    streaming_rank_certificate,
+)
+from mpi_k_selection_tpu.streaming.spill import SPILL_DIR_PREFIX, validate_spill_mode
+
+
+def _chunks(x, nchunks):
+    return [np.ascontiguousarray(c) for c in np.array_split(x, nchunks)]
+
+
+def _ints(rng, n, dtype=np.int32):
+    return rng.integers(-(2**31), 2**31, size=n, dtype=np.int64).astype(dtype)
+
+
+def _device_grid():
+    import jax
+
+    return sorted({1, 2, len(jax.devices())})
+
+
+def _spill_dirs():
+    return set(glob.glob(os.path.join(tempfile.gettempdir(), SPILL_DIR_PREFIX + "*")))
+
+
+# -- the determinism grid ----------------------------------------------------
+
+
+@pytest.mark.parametrize("depth", [0, 2])
+@pytest.mark.parametrize("spill", ["off", "force"])
+def test_grid_bit_identical(depth, spill, rng):
+    """devices {1,2,max} x depth {0,2} x spill {off,force} over
+    heterogeneous chunk sizes with an empty chunk mixed in, multiple
+    ranks, and a tiny collect budget (several prefix-filtered passes ->
+    several spill generations) — all bit-identical to the devices=1
+    depth=0 spill=off oracle."""
+    x = _ints(rng, (1 << 14) + 311)
+    chunks = _chunks(x, 7)
+    chunks.insert(3, np.empty(0, np.int32))  # empty chunk: a no-op
+    ks = [1, 137, x.size // 2, x.size]
+    oracle = streaming_kselect_many(
+        chunks, ks, pipeline_depth=0, devices=1, spill="off", collect_budget=64
+    )
+    assert oracle == [seq.kselect_sort(x, k) for k in ks]
+    for devices in _device_grid():
+        got = streaming_kselect_many(
+            chunks, ks, pipeline_depth=depth, devices=devices, spill=spill,
+            collect_budget=64,
+        )
+        assert got == oracle, (devices, depth, spill)
+
+
+def test_grid_ragged_staged_buckets(rng):
+    """A short final chunk lands in a different pow2 staging bucket; the
+    spill replay must re-stage every record into ITS bucket and keep the
+    answer bit-identical (hist_method='scatter' forces staging on CPU)."""
+    x = _ints(rng, 5 * 1000 + 537)
+    chunks = [x[i * 1000:(i + 1) * 1000] for i in range(5)] + [x[5000:]]
+    k = x.size // 2
+    want = seq.kselect_sort(x, k)
+    for devices in _device_grid():
+        got = streaming_kselect(
+            chunks, k, hist_method="scatter", pipeline_depth=2,
+            devices=devices, spill="force", collect_budget=64,
+        )
+        assert got == want, devices
+
+
+def test_spill_host_exact_64bit_route(rng):
+    """64-bit keys without x64 resolve to host counting: the spill filter
+    must run host-side there too, and stay bit-identical."""
+    import jax
+
+    assert not jax.config.jax_enable_x64
+    x = rng.integers(-(2**62), 2**62, size=1 << 13, dtype=np.int64)
+    k = x.size // 2
+    want = seq.kselect_sort(x, k)
+    got = streaming_kselect(
+        _chunks(x, 8), k, pipeline_depth=2, spill="force", collect_budget=64
+    )
+    assert got == want
+
+
+def test_spill_float32_and_quantile_ranks(rng):
+    """float32 keys (sign-flip encode/decode round-trips through the spill
+    records) across spill modes, multi-rank."""
+    x = rng.standard_normal(1 << 13).astype(np.float32)
+    ks = [3, x.size // 3, x.size - 5]
+    want = streaming_kselect_many(_chunks(x, 6), ks, spill="off")
+    got = streaming_kselect_many(
+        _chunks(x, 6), ks, spill="force", collect_budget=128
+    )
+    assert [g.tobytes() for g in got] == [w.tobytes() for w in want]
+
+
+# -- one-shot sources --------------------------------------------------------
+
+
+def test_one_shot_generator_end_to_end(rng):
+    """A consumed-once generator completes the exact descent via the spill
+    path (spill='auto' default) — passes >= 1 never touch the source."""
+    x = _ints(rng, 1 << 14)
+    chunks = _chunks(x, 9)
+    k = x.size // 2
+    want = seq.kselect_sort(x, k)
+    got = streaming_kselect(
+        (c for c in chunks), k, collect_budget=64, radix_bits=4
+    )
+    assert got == want
+    # multi-rank, pipelined, multi-device
+    ks = [5, k, x.size - 1]
+    want_many = streaming_kselect_many(chunks, ks, spill="off")
+    for devices in _device_grid():
+        got_many = streaming_kselect_many(
+            iter(chunks), ks, pipeline_depth=2, devices=devices,
+            collect_budget=64,
+        )
+        assert got_many == want_many, devices
+
+
+def test_one_shot_rejected_when_spill_off(rng):
+    x = _ints(rng, 4096)
+    with pytest.raises(TypeError, match="spill"):
+        streaming_kselect(iter(_chunks(x, 4)), 7, spill="off")
+
+
+def test_one_shot_source_never_reinvoked(rng):
+    """The source callable of a spill descent is consumed exactly once —
+    a drifting source cannot drift, because it is never replayed: the
+    answer is exact w.r.t. the pass-0 snapshot."""
+    x = _ints(rng, 1 << 13)
+    chunks = _chunks(x, 5)
+    calls = {"n": 0}
+
+    def src():
+        calls["n"] += 1
+        return iter(chunks)
+
+    k = x.size // 2
+    got = streaming_kselect(src, k, spill="force", collect_budget=64)
+    assert got == seq.kselect_sort(x, k)
+    assert calls["n"] == 1  # replay path would have called it per pass
+    # the replay path on the same budget reads it more than once
+    calls["n"] = 0
+    streaming_kselect(src, k, spill="off", collect_budget=64)
+    assert calls["n"] > 1
+
+
+def test_drifting_source_off_raises_force_answers_snapshot(rng):
+    """spill='off' keeps the replay-stability raise for drifting sources;
+    spill='force' reads the source once, so the same source answers
+    exactly for its FIRST materialization."""
+    calls = {"n": 0}
+
+    def drifting():
+        calls["n"] += 1
+        r = np.random.default_rng(calls["n"])
+        return iter([r.integers(-(2**31), 2**31, size=4096, dtype=np.int64)
+                     .astype(np.int32)])
+
+    with pytest.raises(RuntimeError, match="replay-stable"):
+        streaming_kselect(drifting, 2048, spill="off", collect_budget=64)
+    calls["n"] = 0
+    got = streaming_kselect(drifting, 2048, spill="force", collect_budget=64)
+    first = np.random.default_rng(1).integers(
+        -(2**31), 2**31, size=4096, dtype=np.int64
+    ).astype(np.int32)
+    assert calls["n"] == 1
+    assert got == seq.kselect_sort(first, 2048)
+
+
+# -- caller-owned stores: pass log, reuse, sketch flows ----------------------
+
+
+def test_pass_log_shrinks_geometrically(rng):
+    """The issue's acceptance bound: after pass 1 (which reads gen 0
+    whole), every spill-read histogram pass streams <= ~1/2^(radix_bits-1)
+    of its predecessor's bytes."""
+    rb = 4
+    x = _ints(rng, 1 << 15)
+    k = x.size // 2
+    with SpillStore() as store:
+        got = streaming_kselect(
+            _chunks(x, 7), k, radix_bits=rb, collect_budget=16, spill=store
+        )
+        assert got == seq.kselect_sort(x, k)
+        log = store.pass_log
+    assert log[0]["pass"] == 0 and log[0]["read"] == "source"
+    assert log[0]["keys_written"] == x.size  # the full tee
+    assert log[-1]["pass"] == "collect"
+    reads = [
+        e["bytes_read"] for e in log
+        if isinstance(e["pass"], int) and e["pass"] >= 1
+    ]
+    assert len(reads) >= 2
+    assert reads[0] == x.size * 4  # pass 1 reads gen 0 whole
+    for a, b in zip(reads, reads[1:]):
+        assert b <= a / (1 << (rb - 1)), (a, b)
+
+
+def test_caller_store_keeps_gen0_for_reuse(rng):
+    """A caller-owned store keeps its pass-0 generation: it serves the
+    rank certificate, a second descent, and store-as-source — without
+    re-reading the original stream — and descent-internal generations are
+    dropped (disk holds exactly one generation afterwards)."""
+    x = _ints(rng, 1 << 13)
+    k = x.size // 2
+    want = seq.kselect_sort(x, k)
+    with SpillStore() as store:
+        got = streaming_kselect(
+            _chunks(x, 6), k, spill=store, collect_budget=64
+        )
+        assert got == want
+        assert len(store.generations) == 1  # gen 0 only
+        gen0 = store.latest_generation()
+        assert gen0.keys == x.size
+        # certificate straight from the spilled keys
+        less, leq = streaming_rank_certificate(store, want)
+        assert less < k <= leq
+        # the store IS a source: a second, different-rank descent
+        got2 = streaming_kselect(store, 17, collect_budget=64)
+        assert got2 == seq.kselect_sort(x, 17)
+        # and gen 0 is still the only generation left on disk
+        assert len(store.generations) == 1
+        assert store.latest_generation() is gen0
+
+
+def test_sketch_update_stream_tee_then_refine(rng):
+    """The sketch-then-refine flow for one-shot streams: update_stream
+    tees the single pass, refine answers exactly from the store."""
+    x = _ints(rng, 1 << 13)
+    chunks = _chunks(x, 6)
+    k = x.size // 2
+    with SpillStore() as store:
+        sk = RadixSketch(np.int32).update_stream(iter(chunks), spill=store)
+        assert sk.n == x.size
+        got = sk.refine(store, k, collect_budget=64)
+        assert got == seq.kselect_sort(x, k)
+        # refine is repeatable: gen 0 survived the first refinement
+        assert sk.refine(store, 11, collect_budget=64) == seq.kselect_sort(x, 11)
+    with pytest.raises(TypeError, match="SpillStore"):
+        RadixSketch(np.int32).update_stream(chunks, spill="force")
+
+
+def test_streaming_quantiles_spill_flow(rng):
+    from mpi_k_selection_tpu.api import StreamingQuantiles, quantile_ranks
+
+    x = rng.standard_normal(1 << 13).astype(np.float32)
+    chunks = _chunks(x, 5)
+    qs = [0.1, 0.5, 0.99]
+    with SpillStore() as store:
+        t = StreamingQuantiles(np.float32).update_stream(
+            iter(chunks), spill=store
+        )
+        got = t.refine_quantiles(qs, store)
+    s = np.sort(x, kind="stable")
+    want = [s[k - 1] for k in quantile_ranks(qs, x.size)]
+    assert [g.tobytes() for g in got] == [w.tobytes() for w in want]
+
+
+def test_spill_records_device_slots(rng):
+    """With committed multi-device staging, gen-0 records carry the
+    round-robin slot each chunk was staged to — the (chunk_index, bucket,
+    dtype, device) key the replay re-stages by."""
+    x = _ints(rng, 6 * 2048)
+    chunks = _chunks(x, 6)
+    k = x.size // 2
+    with SpillStore() as store:
+        got = streaming_kselect(
+            chunks, k, spill=store, pipeline_depth=2, devices=2,
+            hist_method="scatter",
+        )
+        assert got == seq.kselect_sort(x, k)
+        slots = [r.device_slot for r in store.latest_generation().records]
+    assert slots == [0, 1, 0, 1, 0, 1]
+
+
+# -- corruption: typed errors, never wrong answers ---------------------------
+
+
+def _spilled_store(rng, tmp_path):
+    x = _ints(rng, 1 << 12)
+    store = SpillStore(str(tmp_path))
+    streaming_kselect(_chunks(x, 4), 7, spill=store, collect_budget=64)
+    return x, store
+
+
+def test_corrupt_record_raises_typed_error(rng, tmp_path):
+    x, store = _spilled_store(rng, tmp_path)
+    rec = store.latest_generation().records[2]
+    data = bytearray(open(rec.path, "rb").read())
+    data[-3] ^= 0xFF  # flip one payload byte
+    with open(rec.path, "wb") as f:
+        f.write(data)
+    with pytest.raises(SpillRecordError, match="checksum"):
+        streaming_kselect(store, 7, collect_budget=64)
+    store.close()
+
+
+def test_truncated_record_raises_typed_error(rng, tmp_path):
+    x, store = _spilled_store(rng, tmp_path)
+    rec = store.latest_generation().records[0]
+    data = open(rec.path, "rb").read()
+    with open(rec.path, "wb") as f:
+        f.write(data[:-7])
+    with pytest.raises(SpillRecordError, match="truncated"):
+        streaming_kselect(store, 7, collect_budget=64)
+    store.close()
+
+
+def test_missing_record_raises_typed_error(rng, tmp_path):
+    x, store = _spilled_store(rng, tmp_path)
+    os.unlink(store.latest_generation().records[1].path)
+    with pytest.raises(SpillRecordError, match="unreadable"):
+        streaming_rank_certificate(store, 0)
+    store.close()
+
+
+def test_corruption_error_types_are_distinguishable():
+    assert issubclass(SpillRecordError, SpillError)
+    assert issubclass(SpillError, RuntimeError)
+
+
+# -- cleanup on every exit path ----------------------------------------------
+
+
+def test_internal_store_cleanup_on_success(rng):
+    before = _spill_dirs()
+    x = _ints(rng, 1 << 13)
+    streaming_kselect(iter(_chunks(x, 5)), 9, collect_budget=64)
+    streaming_kselect(_chunks(x, 5), 9, spill="force", collect_budget=64)
+    assert _spill_dirs() == before
+
+
+def test_internal_store_cleanup_on_consumer_raise(rng):
+    """A mid-stream raise (dtype drift, with producer threads in flight)
+    must both propagate AND remove the internal store — plus leave no
+    pipeline thread behind (conftest fixtures backstop both)."""
+    before = _spill_dirs()
+    x = _ints(rng, 1 << 13)
+    bad = _chunks(x, 4) + [x[:64].astype(np.float32)]
+    with pytest.raises(TypeError, match="stream dtype"):
+        streaming_kselect(bad, 9, spill="force", pipeline_depth=2)
+    with pytest.raises(TypeError, match="stream dtype"):
+        streaming_kselect(iter(bad), 9, pipeline_depth=2)
+    assert _spill_dirs() == before
+
+
+def test_internal_store_cleanup_on_bad_k(rng):
+    before = _spill_dirs()
+    x = _ints(rng, 4096)
+    with pytest.raises(ValueError, match="out of range"):
+        streaming_kselect(iter(_chunks(x, 4)), x.size + 1)
+    assert _spill_dirs() == before
+
+
+def test_spill_dir_knob_roots_the_store(rng, tmp_path):
+    x = _ints(rng, 4096)
+    root = tmp_path / "spillroot"
+    streaming_kselect(
+        _chunks(x, 4), 7, spill="force", spill_dir=str(root), collect_budget=64
+    )
+    assert root.exists()  # created on demand...
+    assert list(root.iterdir()) == []  # ...and the store inside was removed
+
+
+# -- knob validation + store API ---------------------------------------------
+
+
+def test_validate_spill_mode():
+    with pytest.raises(ValueError, match="spill"):
+        validate_spill_mode("always")
+    with pytest.raises(ValueError, match="spill"):
+        streaming_kselect([np.arange(4, dtype=np.int32)], 1, spill=True)
+    s = SpillStore()
+    s.close()
+    with pytest.raises(SpillError, match="closed"):
+        validate_spill_mode(s)
+
+
+def test_store_api_lifecycle(tmp_path):
+    store = SpillStore(str(tmp_path))
+    with pytest.raises(SpillError, match="no committed generation"):
+        store.latest_generation()
+    w = store.new_generation()
+    w.append(np.arange(8, dtype=np.uint32), np.int32, device_slot=None)
+    gen = w.commit()
+    with pytest.raises(SpillError, match="committed/aborted"):
+        w.append(np.arange(8, dtype=np.uint32), np.int32)
+    assert store.latest_generation() is gen
+    assert gen.keys == 8 and gen.nbytes == 32
+    [chunk] = list(gen.iter_chunks())
+    assert chunk.device_slot is None and chunk.orig_dtype == np.dtype(np.int32)
+    np.testing.assert_array_equal(chunk.keys, np.arange(8, dtype=np.uint32))
+    store.drop_generation(gen)
+    with pytest.raises(SpillError, match="dropped"):
+        list(gen.iter_chunks())
+    store.close()
+    store.close()  # idempotent
+    with pytest.raises(SpillError, match="closed"):
+        store.new_generation()
+
+
+def test_writer_abort_removes_records(tmp_path):
+    store = SpillStore(str(tmp_path))
+    w = store.new_generation()
+    w.append(np.arange(8, dtype=np.uint32), np.int32)
+    path = w.path
+    assert os.listdir(path)
+    w.abort()
+    assert not os.path.exists(path)
+    w.abort()  # idempotent
+    store.close()
+
+
+# -- CLI ---------------------------------------------------------------------
+
+
+def test_cli_spill_flags(tmp_path, capsys):
+    from mpi_k_selection_tpu import cli
+
+    rc = cli.main([
+        "--streaming", "--backend", "seq", "--n", "40000",
+        "--chunk-elems", "8192", "--spill", "force",
+        "--spill-dir", str(tmp_path), "--check", "--verify", "--json",
+    ])
+    assert rc == 0
+    import json
+
+    rec = json.loads(capsys.readouterr().out)
+    assert rec["extra"]["spill"] == "force"
+    assert rec["extra"]["exact_match"] is True
+    assert rec["extra"]["certificate_ok"] is True
+    passes = rec["extra"]["spill_passes"]
+    assert passes[0]["pass"] == 0 and passes[0]["keys_written"] == 40000
+    # the store is gone afterwards (only the empty root dir may remain)
+    assert not glob.glob(os.path.join(str(tmp_path), SPILL_DIR_PREFIX + "*"))

@@ -148,8 +148,12 @@ def test_chunked_input_validation(rng):
         streaming_kselect([x], 0)
     with pytest.raises(ValueError, match="out of range"):
         streaming_kselect([x], 65)
+    # a one-shot iterator is first-class via the spill store (ISSUE 5);
+    # the replay-path rejection remains under spill="off", and now points
+    # at the spill knob
+    assert streaming_kselect(iter([x]), 1) == seq.kselect_sort(x, 1)
     with pytest.raises(TypeError, match="one-shot iterator"):
-        streaming_kselect(iter([x]), 1)
+        streaming_kselect(iter([x]), 1, spill="off")
     with pytest.raises(TypeError, match="one dtype"):
         streaming_kselect([x, x.astype(np.float32)], 1)
     with pytest.raises(ValueError, match="must divide"):

@@ -232,6 +232,45 @@ def main() -> int:
         less, leq = streaming_rank_certificate(rng_chunks, want_s, devices=ndev)
         check("streaming multi-device certificate", less < sk <= leq, True)
 
+    # --- survivor spill store (the bench_streaming_oc _spill config at
+    # smoke scale, ISSUE 5): spill=force bit-identical to spill=off at
+    # devices {1, all}, a one-shot generator served end-to-end, and the
+    # per-pass streamed bytes shrinking geometrically on real silicon ---
+    print("streaming survivor spill store:")
+    from mpi_k_selection_tpu.streaming import (
+        SpillStore,
+        streaming_kselect as _sp_ksel,
+    )
+
+    sp_chunks = [
+        np.random.default_rng(300 + i).integers(
+            -(2**31), 2**31 - 1, size=1 << 17, dtype=np.int32
+        )
+        for i in range(9)
+    ]
+    sp_n = sum(c.size for c in sp_chunks)
+    sp_k = sp_n // 2
+    sp_kw = dict(radix_bits=4, collect_budget=512)
+    want_sp = int(_sp_ksel(sp_chunks, sp_k, spill="off", **sp_kw))
+    sp_devgrid = (1, ndev) if ndev > 1 else (1,)
+    for dv in sp_devgrid:
+        got_sp = int(
+            _sp_ksel(sp_chunks, sp_k, spill="force", devices=dv, **sp_kw)
+        )
+        check(f"spill=force devices={dv} bit-identical", got_sp, want_sp)
+    got_os = int(_sp_ksel(iter(sp_chunks), sp_k, **sp_kw))  # spill=auto
+    check("spill one-shot generator", got_os, want_sp)
+    with SpillStore() as sp_store:
+        _sp_ksel(sp_chunks, sp_k, spill=sp_store, **sp_kw)
+        reads = [
+            p["bytes_read"] for p in sp_store.pass_log
+            if isinstance(p["pass"], int) and p["pass"] >= 1
+        ]
+        shrink_ok = len(reads) >= 2 and all(
+            b <= a / (1 << 3) for a, b in zip(reads, reads[1:])
+        )
+        check("spill passes shrink geometrically", shrink_ok, True)
+
     if failures:
         print(f"tpu_smoke: {len(failures)} FAILURES")
         return 1
