@@ -465,12 +465,42 @@ def bench_streaming_oc(on_tpu: bool):
     validation."""
     import numpy as np
 
+    from mpi_k_selection_tpu.obs import MetricsRegistry, Observability
     from mpi_k_selection_tpu.streaming.chunked import (
         streaming_kselect,
         streaming_rank_certificate,
     )
     from mpi_k_selection_tpu.streaming.pipeline import ingest_hidden_frac
     from mpi_k_selection_tpu.utils.profiling import PhaseTimer
+
+    from mpi_k_selection_tpu.streaming.pipeline import STAGING_POOL
+
+    def _obs_snapshot(o, pool_before):
+        """Compact embed of the run's metrics registry: occupancy,
+        StagingPool hit rate, stall seconds, chunks/bytes per device —
+        the numbers the TPU validation sweep needs alongside wall time.
+        The registry mirrors the MODULE pool's process-lifetime counters;
+        ``pool_before`` (hits, misses) rebases them to THIS run's deltas
+        so the record is per-run, not cumulative across warmups/records."""
+        snap = o.metrics.as_dict()
+        occ = snap.get("inflight.occupancy", {})
+        hits = snap.get("staging_pool.hits", {}).get("value", 0)
+        misses = snap.get("staging_pool.misses", {}).get("value", 0)
+        return {
+            "inflight_occupancy": {
+                k: occ.get(k) for k in ("count", "mean", "max")
+            },
+            "staging_pool_hits": hits - pool_before[0],
+            "staging_pool_misses": misses - pool_before[1],
+            "pipeline_stall_seconds": snap.get(
+                'phase.seconds{phase="pipeline.stall"}', {}
+            ).get("value"),
+            "chunks_per_device": {
+                dict(m.labels).get("device", "?"): m.value
+                for m in o.metrics.metrics()
+                if m.name == "ingest.chunks"
+            },
+        }
 
     n, chunk = (1 << 33, 1 << 27) if on_tpu else (1 << 22, 1 << 19)
     nchunks = n // chunk
@@ -500,8 +530,10 @@ def bench_streaming_oc(on_tpu: bool):
     sync_s = time.perf_counter() - t0
 
     timer = PhaseTimer()
+    obs = Observability(metrics=MetricsRegistry())
+    pool0 = (STAGING_POOL.hits, STAGING_POOL.misses)
     t0 = time.perf_counter()
-    ans = streaming_kselect(source, k, pipeline_depth=2, timer=timer)
+    ans = streaming_kselect(source, k, pipeline_depth=2, timer=timer, obs=obs)
     dt = time.perf_counter() - t0
     hidden = ingest_hidden_frac(timer)
 
@@ -525,6 +557,7 @@ def bench_streaming_oc(on_tpu: bool):
         "speedup": round(sync_s / dt, 3) if exact else 0.0,
         "ingest_hidden_frac": round(hidden, 4) if hidden is not None else 0.0,
         "rank_certificate": [less, leq],
+        "obs": _obs_snapshot(obs, pool0),
         "exact_match": bool(exact),
     }
     if on_tpu:
@@ -641,9 +674,12 @@ def bench_streaming_oc(on_tpu: bool):
         streaming_kselect(warm_md, chunk, pipeline_depth=2, devices=ndev,
                           collect_budget=64)
         timer_md = PhaseTimer()
+        obs_md = Observability(metrics=MetricsRegistry())
+        pool0_md = (STAGING_POOL.hits, STAGING_POOL.misses)
         t0 = time.perf_counter()
         ans_md = streaming_kselect(
-            source, k, pipeline_depth=2, devices=ndev, timer=timer_md
+            source, k, pipeline_depth=2, devices=ndev, timer=timer_md,
+            obs=obs_md,
         )
         md_s = time.perf_counter() - t0
         hidden_md = ingest_hidden_frac(timer_md)
@@ -670,6 +706,7 @@ def bench_streaming_oc(on_tpu: bool):
                 "ingest_hidden_frac": (
                     round(hidden_md, 4) if hidden_md is not None else 0.0
                 ),
+                "obs": _obs_snapshot(obs_md, pool0_md),
                 "exact_match": bool(exact_md),
             }
         )

@@ -24,8 +24,10 @@ Public API::
     ks.distributed_kselect(x, k)  # sharded over a jax.sharding.Mesh
     ks.kselect_streaming(src, k)  # out-of-core exact selection over chunks
     ks.StreamingQuantiles(dtype)  # mergeable online-quantile sketch + refine
+    ks.Observability.collecting() # descent telemetry bundle (obs= kwarg):
+                                  # events + metrics + trace, off by default
 
-Full reference: docs/API.md.
+Full reference: docs/API.md; telemetry: docs/OBSERVABILITY.md.
 """
 
 from mpi_k_selection_tpu.version import __version__
@@ -50,6 +52,7 @@ from mpi_k_selection_tpu.parallel import (
     distributed_sketch,
     distributed_topk,
 )
+from mpi_k_selection_tpu.obs import Observability
 from mpi_k_selection_tpu.streaming import RadixSketch
 
 __all__ = [
@@ -60,6 +63,7 @@ __all__ = [
     "kselect_streaming",
     "StreamingQuantiles",
     "RadixSketch",
+    "Observability",
     "quantiles",
     "median",
     "batched_kselect",

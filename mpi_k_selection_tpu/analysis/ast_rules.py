@@ -1,4 +1,4 @@
-"""AST lint rules (KSL001-KSL008) — each encodes a bug class a human
+"""AST lint rules (KSL001-KSL009) — each encodes a bug class a human
 reviewer caught in this repository at least once. docs/ANALYSIS.md holds
 the catalog with the historical incident behind every rule.
 
@@ -614,4 +614,77 @@ class StreamingRawFileWrite(Rule):
                     f"`.{node.func.attr}(...)` writes a file outside the "
                     "spill store API — route it through "
                     "SpillStore/SpillWriter (streaming/spill.py)"
+                )
+
+
+# ---------------------------------------------------------------------------
+# KSL009 — print/logging telemetry in library code
+
+
+@register
+class PrintLoggingTelemetry(Rule):
+    id = "KSL009"
+    title = "print/logging telemetry in library code — route through obs"
+    rationale = (
+        "Library telemetry that goes to stdout/stderr is invisible to "
+        "every structured consumer — the bench records, the CLI's JSON "
+        "mode (a stray print corrupts the `--json` stream callers parse), "
+        "the metrics registry, and the event sinks — and unconditional "
+        "`logging` calls pay string formatting on hot streaming paths "
+        "whether anyone listens or not. Library code under "
+        "mpi_k_selection_tpu/ reports through the obs subsystem "
+        "(obs/events.py sinks, obs/metrics.py registry) or raises/warns; "
+        "the CLI and the reporters (cli.py, __main__.py, "
+        "analysis/reporters.py, utils/timing.py's reference-style result "
+        "printer) are the sanctioned human-facing output surfaces."
+    )
+
+    # CLI and reporter surfaces: human-facing output is their JOB
+    _EXEMPT = (
+        "cli.py",
+        "__main__.py",
+        "analysis/reporters.py",
+        "utils/timing.py",
+    )
+    _LOG_METHODS = {
+        "debug", "info", "warning", "warn", "error", "critical",
+        "exception", "log",
+    }
+    _LOG_RECEIVERS = {"logging", "logger", "log"}
+
+    def check_module(self, mod: SourceModule):
+        p = pathlib.Path(mod.path).resolve().as_posix()
+        if "/mpi_k_selection_tpu/" not in p or _is_test_file(mod):
+            return
+        if _path_endswith(mod, *self._EXEMPT):
+            return
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if name == "print":
+                yield node.lineno, (
+                    "`print()` telemetry in library code — emit an obs "
+                    "event or metric (mpi_k_selection_tpu/obs/) so "
+                    "structured consumers see it, or raise/warn for "
+                    "error conditions (CLI and reporters are exempt)"
+                )
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in self._LOG_METHODS
+                and name.split(".")[0] in self._LOG_RECEIVERS
+            ):
+                yield node.lineno, (
+                    f"`{name}()` logging telemetry in library code — "
+                    "route it through the obs registry/sinks "
+                    "(mpi_k_selection_tpu/obs/) so bench records and "
+                    "JSON consumers can read it (CLI and reporters are "
+                    "exempt)"
+                )
+            elif name == "logging.getLogger":
+                yield node.lineno, (
+                    "`logging.getLogger()` in library code — the obs "
+                    "subsystem (events/metrics/trace) is this package's "
+                    "telemetry channel; loggers here end up emitting "
+                    "unstructured text no consumer reads"
                 )

@@ -99,9 +99,15 @@ def _count_query_leaves(ks) -> int:
     return int(np.prod(np.shape(ks), dtype=np.int64)) if np.shape(ks) else 1
 
 
-def kselect(x, k, *, algorithm: str = "auto", **kwargs):
+def kselect(x, k, *, algorithm: str = "auto", obs=None, **kwargs):
     """Exact k-th smallest element (1-indexed k, reference semantics:
-    ``kth-problem-seq.c:32-33``)."""
+    ``kth-problem-seq.c:32-33``).
+
+    ``obs`` (an :class:`~mpi_k_selection_tpu.obs.Observability`) records
+    the resolved dispatch as a ``resident.select`` event. The resident
+    pass loop is jit-traced, so per-pass events are a streaming-only
+    capability (:func:`kselect_streaming`); see docs/OBSERVABILITY.md.
+    """
     x = as_selection_array(x)
     if x.size == 0:
         raise ValueError("kselect requires a non-empty input")
@@ -110,6 +116,17 @@ def kselect(x, k, *, algorithm: str = "auto", **kwargs):
     if algorithm == "auto":
         # sort is competitive only for small inputs; radix is O(n) passes.
         algorithm = "sort" if x.size <= 1 << 14 else "radix"
+    if obs is not None:
+        from mpi_k_selection_tpu.obs.events import ResidentSelectEvent
+
+        obs.emit(
+            ResidentSelectEvent(
+                n=int(x.size),
+                queries=1,
+                algorithm=algorithm,
+                dtype=str(np.dtype(x.dtype)),
+            )
+        )
     if algorithm == "radix":
         return radix_select(x, k, **kwargs)
     if algorithm == "sort":
@@ -268,11 +285,17 @@ def kselect_streaming(source, k, **kwargs):
     default, spills exactly for those; ``"force"`` always; ``"off"``
     keeps today's replay path and rejects one-shot sources;
     ``spill_dir`` roots the temp store). Answers are bit-identical to
-    ``spill="off"`` in every mode. See
+    ``spill="off"`` in every mode.
+
+    ``obs`` (an :class:`~mpi_k_selection_tpu.obs.Observability`) turns on
+    the descent telemetry — typed per-pass/per-chunk events, a metrics
+    registry (occupancy, stall seconds, bytes per device), and
+    producer/consumer trace spans — with a bit-identical-answers
+    guarantee (docs/OBSERVABILITY.md). See
     streaming/chunked.py:streaming_kselect for the full option set
     (``radix_bits``, ``hist_method``, ``collect_budget``, ``sketch``,
-    ``pipeline_depth``, ``timer``, ``devices``, ``spill``,
-    ``spill_dir``)."""
+    ``pipeline_depth``, ``timer``, ``devices``, ``spill``, ``spill_dir``,
+    ``obs``)."""
     from mpi_k_selection_tpu.streaming.chunked import streaming_kselect
 
     return streaming_kselect(source, k, **kwargs)
@@ -303,6 +326,7 @@ class StreamingQuantiles:
         levels: int = 4,
         pipeline_depth: int | None = None,
         devices=None,
+        obs=None,
     ):
         from mpi_k_selection_tpu.streaming.pipeline import (
             resolve_stream_devices,
@@ -313,6 +337,9 @@ class StreamingQuantiles:
         self.pipeline_depth = validate_pipeline_depth(pipeline_depth)
         resolve_stream_devices(devices)  # validate eagerly, like depth
         self.devices = devices
+        #: optional Observability bundle threaded through update_stream
+        #: and refine_quantiles (off = None, the default)
+        self.obs = obs
         self.sketch = RadixSketch(dtype, radix_bits=radix_bits, levels=levels)
 
     @property
@@ -336,7 +363,7 @@ class StreamingQuantiles:
         entirely from the spilled generation."""
         self.sketch.update_stream(
             source, pipeline_depth=self.pipeline_depth, devices=self.devices,
-            spill=spill,
+            spill=spill, obs=self.obs,
         )
         return self
 
@@ -347,6 +374,7 @@ class StreamingQuantiles:
             levels=self.sketch.levels,
             pipeline_depth=self.pipeline_depth,
             devices=self.devices,
+            obs=self.obs,
         )
         out.sketch = self.sketch.merge(
             other.sketch if isinstance(other, StreamingQuantiles) else other
@@ -376,6 +404,7 @@ class StreamingQuantiles:
             sketch=self.sketch,
             pipeline_depth=self.pipeline_depth,
             devices=self.devices,
+            obs=self.obs,
         )
 
 

@@ -155,6 +155,28 @@ def build_parser() -> argparse.ArgumentParser:
         default=None,
         help="write a jax.profiler device trace (TensorBoard format) here",
     )
+    p.add_argument(
+        "--metrics-json",
+        default=None,
+        metavar="PATH",
+        help="write the run's obs metrics registry (StagingPool hits/"
+        "misses, pipeline stall seconds, in-flight window occupancy, "
+        "chunks/bytes per ingest device, spilled bytes, per-phase wall "
+        "time) as JSON to PATH; composes with --profile/--trace-dir. "
+        "Counters and phase totals accumulate over ALL --repeats (the "
+        "exported run.repeats gauge carries the divisor). See "
+        "docs/OBSERVABILITY.md for the metric catalog (the registry "
+        "also renders Prometheus text exposition programmatically)",
+    )
+    p.add_argument(
+        "--trace-events",
+        default=None,
+        metavar="PATH",
+        help="write host-thread spans (producer produce/encode/stage/"
+        "spill vs consumer stall/pass/collect) as Chrome trace-event "
+        "JSON to PATH — load in https://ui.perfetto.dev. Distinct from "
+        "--trace-dir (XLA device ops); the two compose",
+    )
     return p
 
 
@@ -302,7 +324,7 @@ def _chunk_source(args):
     return source
 
 
-def _run_streaming(args):
+def _run_streaming(args, obs=None):
     from mpi_k_selection_tpu.api import kselect_streaming
     from mpi_k_selection_tpu.streaming.chunked import streaming_rank_certificate
 
@@ -329,10 +351,18 @@ def _run_streaming(args):
     # --profile: a DEDICATED PhaseTimer for the pipeline's produce/encode/
     # stage/stall phases — they run CONCURRENTLY with the solve phase, so
     # folding them into the solve timer would inflate its total past wall
-    # time and skew every percentage in the report
+    # time and skew every percentage in the report. --trace-events needs
+    # the same timer (spans are timestamped by PhaseTimer; the recorder
+    # is attached by the descent via the obs bundle), and --metrics-json
+    # needs it too: the registry's phase.seconds{pipeline.stall} etc. are
+    # collected FROM this timer by the descent's collect_runtime
     from mpi_k_selection_tpu.utils import profiling
 
-    ptimer = profiling.PhaseTimer() if args.profile else None
+    ptimer = (
+        profiling.PhaseTimer()
+        if args.profile or args.trace_events or args.metrics_json
+        else None
+    )
     # --spill=force with a single run routes through a CLI-owned store so
     # the per-pass streamed-bytes log rides the result record (and the
     # --check certificate replays the spilled keys instead of regenerating
@@ -352,6 +382,7 @@ def _run_streaming(args):
         devices=devices,
         spill=spill_store if spill_store is not None else args.spill,
         spill_dir=args.spill_dir,
+        obs=obs,
     )
     try:
         seconds, answer = time_fn(fn, repeats=args.repeats, warmup=0)
@@ -408,9 +439,20 @@ def _run_streaming(args):
             # recorded after it would be silently dropped anyway. With a
             # spill store in hand, the certificate replays the spilled gen-0
             # keys — the one-shot-friendly path — instead of regenerating.
+            # the certificate pass shares only the TRACE channel: its spans
+            # belong on the same timeline, but letting it share the metrics
+            # registry would overwrite the SOLVE's phase gauges (its
+            # collect_runtime snapshots a fresh timer) and additively
+            # pollute the per-device chunk/byte counters --metrics-json
+            # documents as the solve's
+            cert_obs = None
+            if obs is not None and obs.trace is not None:
+                from mpi_k_selection_tpu import obs as obs_lib
+
+                cert_obs = obs_lib.Observability(trace=obs.trace)
             less, leq = streaming_rank_certificate(
                 spill_store if spill_store is not None else source,
-                answer, pipeline_depth=depth, devices=devices,
+                answer, pipeline_depth=depth, devices=devices, obs=cert_obs,
             )
             cert_ok = less < k <= leq
             record.extra["rank_certificate"] = [less, leq]
@@ -519,7 +561,20 @@ def main(argv=None) -> int:
 
     import contextlib
 
-    timer = profiling.PhaseTimer()
+    # the obs bundle behind --metrics-json / --trace-events (off = None,
+    # zero overhead): metrics collected by the descent + _finish, spans
+    # recorded through the PhaseTimers on whichever thread runs the phase
+    obs = None
+    if args.metrics_json or args.trace_events:
+        from mpi_k_selection_tpu import obs as obs_lib
+
+        obs = obs_lib.Observability(
+            metrics=obs_lib.MetricsRegistry() if args.metrics_json else None,
+            trace=obs_lib.TraceRecorder() if args.trace_events else None,
+        )
+    timer = profiling.PhaseTimer(
+        recorder=None if obs is None else obs.trace
+    )
     tracer = lambda: (
         profiling.trace(args.trace_dir)
         if args.trace_dir
@@ -531,8 +586,8 @@ def main(argv=None) -> int:
                 # chunks are generated INSIDE the solve (that is the point:
                 # the whole array never exists); --check streams too
                 with tracer(), timer.phase("solve"):
-                    record, ok = _run_streaming(args)
-                return _finish(args, record, ok, timer)
+                    record, ok = _run_streaming(args, obs)
+                return _finish(args, record, ok, timer, obs)
             with timer.phase("generate"):
                 batch = (args.batch,) if args.batch else ()
                 x = datagen.generate(
@@ -557,11 +612,27 @@ def main(argv=None) -> int:
                     ok = ok and cert_ok
     except (ValueError, RuntimeError) as e:
         raise SystemExit(f"error: {e}") from e
-    return _finish(args, record, ok, timer)
+    return _finish(args, record, ok, timer, obs)
 
 
-def _finish(args, record, ok, timer) -> int:
+def _finish(args, record, ok, timer, obs=None) -> int:
     """Shared result reporting (JSON or reference-style) + exit code."""
+    if obs is not None:
+        if obs.metrics is not None:
+            from mpi_k_selection_tpu.obs.metrics import collect_runtime
+
+            # fold the driver-level phases (generate/solve/check) in on
+            # top of whatever the descent already collected, and mark the
+            # repeat count: counters/phase totals span ALL repeats, so a
+            # per-run reading divides by this gauge
+            collect_runtime(obs.metrics, timer=timer)
+            obs.metrics.gauge("run.repeats").set(max(1, args.repeats))
+            with open(args.metrics_json, "w") as f:
+                f.write(obs.metrics.to_json(indent=2))
+            record.extra["metrics_json"] = args.metrics_json
+        if obs.trace is not None:
+            obs.trace.write(args.trace_events)
+            record.extra["trace_events"] = args.trace_events
     if args.profile:
         record.extra["phases"] = timer.as_dict()
     if args.json:
@@ -578,9 +649,11 @@ def _finish(args, record, ok, timer) -> int:
             print(timer.report())
             phases = record.extra.get("pipeline_phases")
             if phases:
-                # concurrent with solve — reported separately so the solve
-                # report's total stays wall-accurate
-                print("pipeline phases (concurrent with solve, per repeat):")
+                # reported separately so the solve report's total stays
+                # wall-accurate: pipeline.* phases run on the producer
+                # thread CONCURRENTLY with solve; descent.* phases are
+                # the consumer side of the same overlap
+                print("streaming phases (producer concurrent with solve, per repeat):")
                 for name, d in sorted(
                     phases.items(), key=lambda kv: -kv[1]["seconds"]
                 ):

@@ -61,7 +61,7 @@ def build(force: bool = False, quiet: bool = True) -> pathlib.Path:
         raise RuntimeError(f"native build failed:\n{res.stderr}")
     STAMP_PATH.write_text(want + "\n")
     if not quiet:
-        print(f"built {LIB_PATH}")
+        print(f"built {LIB_PATH}")  # ksel: noqa[KSL009] -- opt-in build-tool progress line (quiet=False only from the __main__ entry), not runtime telemetry
     return LIB_PATH
 
 

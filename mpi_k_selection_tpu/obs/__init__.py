@@ -1,0 +1,119 @@
+"""Descent telemetry: structured events, metrics, cross-thread tracing.
+
+The instrumentation substrate for the streaming vertical (and the future
+resident query server): one :class:`Observability` bundle carries up to
+three independent channels —
+
+- **events** (obs/events.py): typed per-pass / per-chunk observations of
+  the exact descent (active prefixes, survivor populations, bytes
+  streamed, chunk->device assignment, spill generation sizes);
+- **metrics** (obs/metrics.py): counters / gauges / histograms
+  (StagingPool hits/misses, ``pipeline.stall`` seconds, InflightWindow
+  occupancy, spilled bytes, chunks per device) with JSON and
+  Prometheus-text exposition;
+- **trace** (obs/trace.py): producer/consumer host spans exported as
+  perfetto-loadable Chrome trace-event JSON, layered on
+  :class:`~mpi_k_selection_tpu.utils.profiling.PhaseTimer`.
+
+Everything is OFF by default: the streaming entry points take
+``obs=None`` and guard every emission behind that check, and enabling any
+channel is guaranteed not to change a single answer bit
+(tests/test_obs.py enforces bit-equality over the devices x
+pipeline_depth x spill grid). Usage::
+
+    from mpi_k_selection_tpu import obs as obs_lib
+
+    o = obs_lib.Observability.collecting()
+    v = api.kselect_streaming(source, k, obs=o)
+    o.events.of_kind("stream.pass")        # typed event stream
+    o.metrics.render_prometheus()          # exposition text
+    o.trace.write("trace.json")            # open in perfetto
+
+CLI: ``--metrics-json`` / ``--trace-events`` (cli.py). Docs:
+docs/OBSERVABILITY.md (event schema, metric catalog, perfetto how-to).
+"""
+
+from __future__ import annotations
+
+from mpi_k_selection_tpu.obs.events import (
+    CallbackSink,
+    CertificateEvent,
+    ChunkEvent,
+    DistributedSelectEvent,
+    EventSink,
+    ListSink,
+    ObsEvent,
+    ResidentSelectEvent,
+    SketchPassEvent,
+    SpillGenerationEvent,
+    StreamPassEvent,
+    check_stream_invariants,
+)
+from mpi_k_selection_tpu.obs.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    collect_runtime,
+)
+from mpi_k_selection_tpu.obs.trace import Span, TraceRecorder
+
+__all__ = [
+    "CallbackSink",
+    "CertificateEvent",
+    "ChunkEvent",
+    "Counter",
+    "DistributedSelectEvent",
+    "EventSink",
+    "Gauge",
+    "Histogram",
+    "ListSink",
+    "MetricsRegistry",
+    "Observability",
+    "ObsEvent",
+    "ResidentSelectEvent",
+    "SketchPassEvent",
+    "Span",
+    "SpillGenerationEvent",
+    "StreamPassEvent",
+    "TraceRecorder",
+    "check_stream_invariants",
+    "collect_runtime",
+]
+
+
+class Observability:
+    """The pluggable telemetry bundle the descent entry points accept as
+    ``obs=``. Any subset of channels may be active; ``None`` channels
+    cost one attribute check at each emission site.
+
+    All three channels are thread-safe — the pipelined descent records
+    from the producer and consumer threads concurrently.
+    """
+
+    def __init__(self, *, events=None, metrics=None, trace=None):
+        self.events = events
+        self.metrics = metrics
+        self.trace = trace
+
+    @classmethod
+    def collecting(cls) -> "Observability":
+        """All three channels on, in-memory: a ListSink, a fresh
+        MetricsRegistry, and a TraceRecorder — the everything-enabled
+        form tests, the gauntlet and tpu_smoke use."""
+        return cls(
+            events=ListSink(), metrics=MetricsRegistry(), trace=TraceRecorder()
+        )
+
+    def emit(self, event: ObsEvent) -> None:
+        """Send one event to the sink (no-op without one)."""
+        if self.events is not None:
+            self.events.emit(event)
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        on = [
+            name
+            for name in ("events", "metrics", "trace")
+            if getattr(self, name) is not None
+        ]
+        return f"Observability({', '.join(on) or 'all channels off'})"

@@ -1,0 +1,298 @@
+"""Typed descent telemetry events and pluggable sinks.
+
+The reference's entire observability story is two wall-clock pairs
+(``clock()`` in ``kth-problem-seq.c:30,35``, ``MPI_Wtime()`` in
+``TODO-kth-problem-cgm.c:76,279``); the framework-grade replacement needs
+to answer *what the descent actually did* — which prefixes survived each
+pass, how many keys crossed the host->device boundary, which chip each
+chunk landed on, how fast the spill generations shrank — not only how
+long it took. This module is the event half of that story:
+
+- every radix pass of the streaming descent (replay, spill, and collect
+  paths) emits one :class:`StreamPassEvent`; every consumed chunk emits a
+  :class:`ChunkEvent` carrying its round-robin device slot; spill
+  generation commits emit :class:`SpillGenerationEvent`; the resident and
+  distributed entry shells emit :class:`ResidentSelectEvent` /
+  :class:`DistributedSelectEvent` (their pass loops are jit-traced, so
+  per-pass granularity is a streaming-only capability — see
+  docs/OBSERVABILITY.md).
+- events are *frozen dataclasses*: pure observations of host integers the
+  descent already computed. Emission can therefore never perturb an
+  answer — the bit-identical-with-sinks-on/off contract tests/test_obs.py
+  enforces over the devices x pipeline_depth x spill grid.
+- sinks are pluggable and OFF by default: with no
+  :class:`~mpi_k_selection_tpu.obs.Observability` passed, the descent
+  skips every emission behind one ``obs is None`` check.
+
+:func:`check_stream_invariants` encodes the event stream's structural
+contract (monotone pass indices, per-rank survivor populations
+non-increasing, bytes consistent with a spill store's ``pass_log``) —
+shared by the unit tests and ``__graft_entry__``'s gauntlet case 10.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import ClassVar
+
+
+@dataclasses.dataclass(frozen=True)
+class ObsEvent:
+    """Base telemetry event. ``kind`` names the event type; ``as_dict``
+    is the JSON-ready form every sink/exporter shares."""
+
+    kind: ClassVar[str] = "event"
+
+    def as_dict(self) -> dict:
+        d = {"event": self.kind}
+        d.update(dataclasses.asdict(self))
+        return d
+
+
+@dataclasses.dataclass(frozen=True)
+class StreamPassEvent(ObsEvent):
+    """One streamed radix pass of the exact descent (pass 0, every later
+    prefix-filtered pass, and the final collect as ``pass_index
+    "collect"``).
+
+    ``survivors`` is the per-rank population tuple AFTER this pass's
+    bucket walk, aligned with the descent's rank order and covering every
+    rank (parked ranks keep their last population) — so consecutive
+    events are elementwise non-increasing, the geometric-shrink contract
+    :func:`check_stream_invariants` checks.
+    """
+
+    kind: ClassVar[str] = "stream.pass"
+
+    pass_index: object  # int radix level, or "collect"
+    resolved_bits: int
+    prefixes: tuple  # active (being-histogrammed) prefixes this pass
+    chunks: int  # chunks consumed
+    keys_read: int
+    bytes_read: int
+    read_from: str  # "source" | "spill"
+    bucket_total: int  # total population counted across prefixes
+    bucket_max: int  # heaviest single bucket
+    bucket_nonzero: int  # buckets holding >= 1 key
+    survivors: tuple  # per-rank populations after the walk
+    keys_written: int | None = None  # spill survivors written (None = no tee)
+    bytes_written: int | None = None
+
+
+@dataclasses.dataclass(frozen=True)
+class ChunkEvent(ObsEvent):
+    """One chunk consumed by a streamed pass: size, staged bytes, and the
+    round-robin device slot it landed on (``None`` = host-resident or the
+    uncommitted default-device path) — the chunk->device assignment
+    record."""
+
+    kind: ClassVar[str] = "stream.chunk"
+
+    pass_index: object
+    chunk_index: int
+    n: int
+    nbytes: int
+    device_slot: int | None
+    staged: bool
+
+
+@dataclasses.dataclass(frozen=True)
+class SpillGenerationEvent(ObsEvent):
+    """One committed spill generation (pass-0 tee or a filtered survivor
+    write): its record count, key count and payload bytes."""
+
+    kind: ClassVar[str] = "spill.generation"
+
+    generation: int
+    records: int
+    keys: int
+    nbytes: int
+
+
+@dataclasses.dataclass(frozen=True)
+class SketchPassEvent(ObsEvent):
+    """One ``RadixSketch.update_stream`` accumulation pass."""
+
+    kind: ClassVar[str] = "sketch.pass"
+
+    chunks: int
+    keys_read: int
+    bytes_read: int
+    staged_chunks: int
+
+
+@dataclasses.dataclass(frozen=True)
+class CertificateEvent(ObsEvent):
+    """One streamed rank-certificate pass: the (less, leq) counts."""
+
+    kind: ClassVar[str] = "certificate.pass"
+
+    chunks: int
+    keys_read: int
+    less: int
+    leq: int
+
+
+@dataclasses.dataclass(frozen=True)
+class ResidentSelectEvent(ObsEvent):
+    """One resident (in-core) selection dispatch at the api shell. The
+    pass loop itself is jit-traced — per-pass events are streaming-only."""
+
+    kind: ClassVar[str] = "resident.select"
+
+    n: int
+    queries: int
+    algorithm: str
+    dtype: str
+
+
+@dataclasses.dataclass(frozen=True)
+class DistributedSelectEvent(ObsEvent):
+    """One distributed selection dispatch at the parallel/ entry shell."""
+
+    kind: ClassVar[str] = "distributed.select"
+
+    n: int
+    queries: int
+    n_devices: int
+    radix_bits: int
+    cutover_passes: int | None
+    dtype: str
+
+
+class EventSink:
+    """Sink protocol: ``emit`` receives every event. Implementations must
+    be thread-safe — the pipelined descent emits from both the producer
+    and the consumer thread."""
+
+    def emit(self, event: ObsEvent) -> None:  # pragma: no cover - protocol
+        raise NotImplementedError
+
+
+class ListSink(EventSink):
+    """Collects events in arrival order (thread-safe append). The default
+    sink for tests, the gauntlet, and post-run analysis."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.events: list[ObsEvent] = []
+
+    def emit(self, event: ObsEvent) -> None:
+        with self._lock:
+            self.events.append(event)
+
+    def of_kind(self, kind: str) -> list[ObsEvent]:
+        with self._lock:
+            return [e for e in self.events if e.kind == kind]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self.events)
+
+
+class CallbackSink(EventSink):
+    """Adapts a plain callable into a sink (the caller owns its thread
+    safety — it may be invoked from the producer thread)."""
+
+    def __init__(self, fn):
+        self._fn = fn
+
+    def emit(self, event: ObsEvent) -> None:
+        self._fn(event)
+
+
+def check_stream_invariants(events, spill_pass_log=None) -> None:
+    """Assert the structural contract of one descent's event stream;
+    raises ``AssertionError`` naming the first violation.
+
+    - at least one :class:`StreamPassEvent`, integer pass indices strictly
+      increasing, any ``"collect"`` event last;
+    - per-rank ``survivors`` tuples elementwise non-increasing pass over
+      pass (the descent only ever narrows), each bounded by that pass's
+      ``keys_read``;
+    - ``bucket_total`` accounting: pass 0 counts the whole stream
+      (``bucket_total == keys_read``); later passes count only the
+      surviving active-prefix populations, so ``bucket_total`` is bounded
+      by ``keys_read`` and non-increasing pass over pass;
+    - chunk events: per-pass chunk indices 0..chunks-1 in order, sizes
+      summing to ``keys_read``, staged slots well-formed;
+    - with ``spill_pass_log`` (a ``SpillStore.pass_log``): the events'
+      bytes_read/bytes_written match the store's log entry for entry.
+    """
+    passes = [e for e in events if isinstance(e, StreamPassEvent)]
+    assert passes, "no StreamPassEvent emitted"
+    int_idx = [e.pass_index for e in passes if isinstance(e.pass_index, int)]
+    assert int_idx == sorted(set(int_idx)), (
+        f"pass indices not strictly increasing: {int_idx}"
+    )
+    for e in passes[:-1]:
+        assert e.pass_index != "collect", "collect event is not last"
+    prev = None
+    for e in passes:
+        if e.pass_index == "collect":
+            continue
+        assert len(e.survivors) >= 1, f"pass {e.pass_index}: no survivors tuple"
+        assert all(0 <= s <= e.keys_read for s in e.survivors), (
+            f"pass {e.pass_index}: survivors {e.survivors} exceed "
+            f"keys_read {e.keys_read}"
+        )
+        assert e.bucket_max <= e.bucket_total, f"pass {e.pass_index}: bucket summary"
+        assert e.bucket_total <= e.keys_read, (
+            f"pass {e.pass_index}: bucket_total {e.bucket_total} exceeds "
+            f"keys_read {e.keys_read}"
+        )
+        if e.pass_index == 0 and not e.prefixes:
+            # the unfiltered length-scan pass counts EVERY key it read
+            assert e.bucket_total == e.keys_read, (
+                f"pass 0: bucket_total {e.bucket_total} != keys_read "
+                f"{e.keys_read} on the unfiltered pass"
+            )
+        if prev is not None:
+            assert e.bucket_total <= prev.bucket_total, (
+                f"pass {e.pass_index}: counted population {e.bucket_total} "
+                f"grew past the previous pass's {prev.bucket_total}"
+            )
+            assert len(e.survivors) == len(prev.survivors), (
+                "rank count changed mid-descent"
+            )
+            assert all(
+                s <= p for s, p in zip(e.survivors, prev.survivors)
+            ), (
+                f"pass {e.pass_index}: survivors {e.survivors} grew past "
+                f"{prev.survivors}"
+            )
+        prev = e
+    by_pass: dict = {}
+    for c in events:
+        if isinstance(c, ChunkEvent):
+            by_pass.setdefault(c.pass_index, []).append(c)
+    for e in passes:
+        chunks = by_pass.get(e.pass_index, [])
+        if not chunks:  # chunk events off, or a zero-chunk pass
+            continue
+        assert [c.chunk_index for c in chunks] == list(range(e.chunks)), (
+            f"pass {e.pass_index}: chunk indices out of order"
+        )
+        assert sum(c.n for c in chunks) == e.keys_read, (
+            f"pass {e.pass_index}: chunk sizes sum to "
+            f"{sum(c.n for c in chunks)}, keys_read {e.keys_read}"
+        )
+        for c in chunks:
+            assert c.device_slot is None or c.device_slot >= 0
+    if spill_pass_log is not None:
+        logged = {entry["pass"]: entry for entry in spill_pass_log}
+        for e in passes:
+            entry = logged.get(e.pass_index)
+            if entry is None:
+                continue
+            assert e.bytes_read == entry["bytes_read"], (
+                f"pass {e.pass_index}: event bytes_read {e.bytes_read} != "
+                f"pass_log {entry['bytes_read']}"
+            )
+            if e.bytes_written is not None:
+                assert e.bytes_written == entry.get("bytes_written"), (
+                    f"pass {e.pass_index}: event bytes_written "
+                    f"{e.bytes_written} != pass_log "
+                    f"{entry.get('bytes_written')}"
+                )

@@ -1,0 +1,327 @@
+"""Metrics registry — counters, gauges and histograms with JSON and
+Prometheus-text exposition.
+
+The registry is the *numbers* half of the descent telemetry (obs/events.py
+is the *shapes* half): StagingPool hit/miss totals, ``pipeline.stall``
+seconds, InflightWindow occupancy samples, spilled bytes per descent, and
+chunks-per-device counts — the quantities the TPU validation sweep and the
+async-executor work (ROADMAP) need to read off a run instead of inferring
+from wall clocks.
+
+Design constraints:
+
+- **Thread-safe**: the pipelined descent records from the producer thread
+  (staging, spill tee) and the consumer thread (stall, merges)
+  concurrently; every mutation takes the metric's registry lock.
+- **Exact**: counters and gauges are plain Python ints/floats (no
+  device round-trips, no float accumulation for counts), so a mirrored
+  metric can be asserted EQUAL to its source counter
+  (tests/test_multidevice_ingest.py, tests/test_spill.py).
+- **Off by default**: a registry exists only when the caller passes one
+  (via :class:`~mpi_k_selection_tpu.obs.Observability`); library code
+  guards every record behind ``obs is None`` checks.
+
+Exposition: :meth:`MetricsRegistry.as_dict` (JSON-ready),
+:meth:`MetricsRegistry.to_json`, and
+:meth:`MetricsRegistry.render_prometheus` (text format 0.0.4 — dots
+become underscores, every name is prefixed ``ksel_``).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import re
+import threading
+
+#: Default occupancy-style histogram buckets (small non-negative counts).
+DEFAULT_BUCKETS = (0.0, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0)
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+class _Metric:
+    """Shared plumbing: identity (name + sorted label pairs) and the
+    registry lock every mutation runs under."""
+
+    type_name = "untyped"
+
+    def __init__(self, name: str, labels: tuple, lock: threading.Lock):
+        self.name = name
+        self.labels = labels  # sorted tuple of (key, value) pairs
+        self._lock = lock
+
+    def label_str(self) -> str:
+        if not self.labels:
+            return ""
+        inner = ",".join(f'{k}="{v}"' for k, v in self.labels)
+        return "{" + inner + "}"
+
+
+class Counter(_Metric):
+    """Monotone event count. ``set`` exists for COLLECTED mirrors of
+    pre-existing counters (StagingPool.hits, a pass_log total) — the
+    snapshot overwrites so repeated collections stay idempotent."""
+
+    type_name = "counter"
+
+    def __init__(self, name, labels, lock):
+        super().__init__(name, labels, lock)
+        self.value = 0
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self.value += n
+
+    def set(self, value) -> None:
+        with self._lock:
+            self.value = value
+
+    def as_dict(self) -> dict:
+        return {"type": self.type_name, "value": self.value}
+
+
+class Gauge(_Metric):
+    """Point-in-time value (seconds, occupancy, fraction)."""
+
+    type_name = "gauge"
+
+    def __init__(self, name, labels, lock):
+        super().__init__(name, labels, lock)
+        self.value = 0
+
+    def set(self, value) -> None:
+        with self._lock:
+            self.value = value
+
+    def inc(self, n=1) -> None:
+        with self._lock:
+            self.value += n
+
+    def as_dict(self) -> dict:
+        return {"type": self.type_name, "value": self.value}
+
+
+class Histogram(_Metric):
+    """Cumulative-bucket histogram (Prometheus semantics: ``le`` bounds,
+    implicit ``+Inf``), plus exact count/sum/min/max."""
+
+    type_name = "histogram"
+
+    def __init__(self, name, labels, lock, buckets=DEFAULT_BUCKETS):
+        super().__init__(name, labels, lock)
+        self.bounds = tuple(sorted(float(b) for b in buckets))
+        self.bucket_counts = [0] * (len(self.bounds) + 1)  # last = +Inf
+        self.count = 0
+        self.sum = 0
+        self.min = None
+        self.max = None
+
+    def observe(self, value) -> None:
+        with self._lock:
+            self.count += 1
+            self.sum += value
+            if self.min is None or value < self.min:
+                self.min = value
+            if self.max is None or value > self.max:
+                self.max = value
+            for i, bound in enumerate(self.bounds):
+                if value <= bound:
+                    self.bucket_counts[i] += 1
+                    return
+            self.bucket_counts[-1] += 1
+
+    def cumulative(self) -> list[int]:
+        """Cumulative counts per ``le`` bound (+Inf last) — the
+        Prometheus wire shape."""
+        out, running = [], 0
+        for c in self.bucket_counts:
+            running += c
+            out.append(running)
+        return out
+
+    @property
+    def mean(self):
+        return self.sum / self.count if self.count else None
+
+    def as_dict(self) -> dict:
+        return {
+            "type": self.type_name,
+            "count": self.count,
+            "sum": self.sum,
+            "min": self.min,
+            "max": self.max,
+            "mean": self.mean,
+            "buckets": {
+                **{str(b): c for b, c in zip(self.bounds, self.cumulative())},
+                "+Inf": self.count,
+            },
+        }
+
+
+class MetricsRegistry:
+    """Get-or-create home for every metric of one run (or one process).
+
+    Metrics are keyed by ``(name, labels)``; asking for an existing key
+    returns the same object, so library code can fetch by name at record
+    time without plumbing metric handles around. One lock serializes all
+    mutation — metric cardinality here is tiny (tens), contention is not
+    a concern at chunk granularity.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict = {}
+
+    @staticmethod
+    def _key(name: str, labels):
+        lab = tuple(sorted((str(k), str(v)) for k, v in (labels or {}).items()))
+        return name, lab
+
+    def _get_or_create(self, cls, name, labels, **kwargs):
+        key = self._key(name, labels)
+        with self._lock:
+            m = self._metrics.get(key)
+            if m is None:
+                m = cls(name, key[1], self._lock, **kwargs)
+                self._metrics[key] = m
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as {m.type_name}"
+                )
+            return m
+
+    def counter(self, name: str, labels=None) -> Counter:
+        return self._get_or_create(Counter, name, labels)
+
+    def gauge(self, name: str, labels=None) -> Gauge:
+        return self._get_or_create(Gauge, name, labels)
+
+    def histogram(self, name: str, labels=None, buckets=DEFAULT_BUCKETS) -> Histogram:
+        return self._get_or_create(Histogram, name, labels, buckets=buckets)
+
+    def metrics(self) -> list[_Metric]:
+        with self._lock:
+            return list(self._metrics.values())
+
+    # -- exposition --------------------------------------------------------
+
+    def as_dict(self) -> dict:
+        """``{name or name{labels}: metric dict}`` — the JSON-ready
+        snapshot bench records and ``--metrics-json`` embed."""
+        out = {}
+        for m in self.metrics():
+            out[m.name + m.label_str()] = m.as_dict()
+        return out
+
+    def to_json(self, indent=None) -> str:
+        return json.dumps(self.as_dict(), indent=indent, sort_keys=True)
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition (format 0.0.4): names sanitized to
+        ``ksel_<name_with_underscores>``, histograms as
+        ``_bucket{le=...}``/``_sum``/``_count`` series."""
+        by_name: dict = {}
+        for m in self.metrics():
+            by_name.setdefault(m.name, []).append(m)
+        lines = []
+        for name in sorted(by_name):
+            group = by_name[name]
+            pname = "ksel_" + _NAME_RE.sub("_", name.replace(".", "_"))
+            lines.append(f"# TYPE {pname} {group[0].type_name}")
+            for m in sorted(group, key=lambda g: g.labels):
+                if isinstance(m, Histogram):
+                    for bound, c in zip(m.bounds, m.cumulative()):
+                        lab = dict(m.labels)
+                        lab["le"] = _format_float(bound)
+                        inner = ",".join(
+                            f'{k}="{v}"' for k, v in sorted(lab.items())
+                        )
+                        lines.append(f"{pname}_bucket{{{inner}}} {c}")
+                    inf_lab = dict(m.labels)
+                    inf_lab["le"] = "+Inf"
+                    inner = ",".join(
+                        f'{k}="{v}"' for k, v in sorted(inf_lab.items())
+                    )
+                    lines.append(f"{pname}_bucket{{{inner}}} {m.count}")
+                    lines.append(f"{pname}_sum{m.label_str()} {_format_float(m.sum)}")
+                    lines.append(f"{pname}_count{m.label_str()} {m.count}")
+                else:
+                    lines.append(
+                        f"{pname}{m.label_str()} {_format_float(m.value)}"
+                    )
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+def _format_float(v) -> str:
+    """Prometheus value formatting: ints stay integral, floats drop the
+    trailing noise, infinities spell +Inf/-Inf."""
+    if isinstance(v, bool):  # pragma: no cover - no bool metrics exist
+        return str(int(v))
+    if isinstance(v, int):
+        return str(v)
+    f = float(v)
+    if math.isinf(f):
+        return "+Inf" if f > 0 else "-Inf"
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def collect_runtime(
+    registry: MetricsRegistry,
+    *,
+    staging_pool=None,
+    spill_store=None,
+    timer=None,
+) -> MetricsRegistry:
+    """Snapshot the repo's pre-existing runtime counters into ``registry``
+    — the ONE mapping from internal state to exported metric names, so
+    the values are the originals by construction (asserted equal in
+    tests/test_multidevice_ingest.py and tests/test_spill.py):
+
+    - ``staging_pool.hits`` / ``staging_pool.misses`` (Counter) and
+      ``staging_pool.resident_bytes`` (Gauge) from a
+      :class:`~mpi_k_selection_tpu.streaming.pipeline.StagingPool`;
+    - ``spill.passes`` / ``spill.bytes_read`` / ``spill.bytes_written`` /
+      ``spill.keys_read`` / ``spill.keys_written`` (Counter) summed over a
+      :class:`~mpi_k_selection_tpu.streaming.spill.SpillStore`'s
+      ``pass_log``, plus ``spill.generations_live`` (Gauge);
+    - every :class:`~mpi_k_selection_tpu.utils.profiling.PhaseTimer`
+      phase as ``phase.seconds{phase=...}`` / ``phase.calls{phase=...}``
+      (the ``pipeline.stall`` seconds the ROADMAP items need ride here).
+
+    Snapshots overwrite (``Counter.set``), so collecting twice is
+    idempotent. Returns ``registry``.
+    """
+    if staging_pool is not None:
+        registry.counter("staging_pool.hits").set(int(staging_pool.hits))
+        registry.counter("staging_pool.misses").set(int(staging_pool.misses))
+        registry.gauge("staging_pool.resident_bytes").set(
+            int(staging_pool.resident_bytes)
+        )
+    if spill_store is not None:
+        log = list(spill_store.pass_log)
+        registry.counter("spill.passes").set(len(log))
+        registry.counter("spill.bytes_read").set(
+            sum(int(p.get("bytes_read", 0)) for p in log)
+        )
+        registry.counter("spill.keys_read").set(
+            sum(int(p.get("keys_read", 0)) for p in log)
+        )
+        registry.counter("spill.bytes_written").set(
+            sum(int(p.get("bytes_written", 0)) for p in log)
+        )
+        registry.counter("spill.keys_written").set(
+            sum(int(p.get("keys_written", 0)) for p in log)
+        )
+        registry.gauge("spill.generations_live").set(
+            len(getattr(spill_store, "generations", ()))
+        )
+    if timer is not None:
+        for name, d in timer.as_dict().items():
+            registry.gauge("phase.seconds", labels={"phase": name}).set(
+                d["seconds"]
+            )
+            registry.gauge("phase.calls", labels={"phase": name}).set(d["calls"])
+    return registry

@@ -1,0 +1,120 @@
+"""Cross-thread span tracing — Chrome trace-event export over PhaseTimer.
+
+``jax.profiler`` (utils/profiling.trace, the CLI's ``--trace-dir``) sees
+XLA ops but not the HOST threads the streaming vertical lives on: the
+pipelined descent is a producer thread (produce / encode / stage / spill)
+overlapping a consumer thread (stall / merge / collect), and questions
+like "did the eager survivor gather serialize the consumer?" (review r6)
+are questions about the GAPS between host spans on two tracks.
+
+This module records those spans and exports them as Chrome trace-event
+JSON (the ``traceEvents`` array format), loadable in Perfetto
+(https://ui.perfetto.dev) or ``chrome://tracing`` — one track per thread,
+thread ids and names preserved, so producer/consumer overlap is read
+directly off the timeline.
+
+Layering (KSL004: raw clocks live ONLY in utils/timing + utils/profiling):
+the recorder never reads a clock. :class:`~mpi_k_selection_tpu.utils.
+profiling.PhaseTimer` timestamps each phase as it always has and, when a
+recorder is attached (``PhaseTimer(recorder=...)``), hands the finished
+``(name, t0, t1)`` triple over on the thread that ran the phase — the
+recorder adds the thread identity and appends under its own lock. Every
+``timer.phase(...)`` in the code base (the pipeline's producer phases, the
+consumer's stall, the descent's per-pass phases) becomes a span for free.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+
+
+@dataclasses.dataclass(frozen=True)
+class Span:
+    """One completed phase on one thread (times are perf_counter seconds,
+    a shared monotonic base across threads of one process)."""
+
+    name: str
+    t0: float
+    t1: float
+    thread_id: int
+    thread_name: str
+
+    @property
+    def duration(self) -> float:
+        return self.t1 - self.t0
+
+
+class TraceRecorder:
+    """Thread-safe span collector + Chrome trace-event exporter.
+
+    Attach to any :class:`~mpi_k_selection_tpu.utils.profiling.PhaseTimer`
+    (``PhaseTimer(recorder=rec)``); one recorder may serve several timers
+    (e.g. the CLI's solve timer and the pipeline timer), interleaving
+    their spans on the shared timeline.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.spans: list[Span] = []
+
+    def record(self, name: str, t0: float, t1: float) -> None:
+        """Called by PhaseTimer on the thread that ran the phase."""
+        t = threading.current_thread()
+        span = Span(name, t0, t1, t.ident or 0, t.name)
+        with self._lock:
+            self.spans.append(span)
+
+    def snapshot(self) -> list[Span]:
+        with self._lock:
+            return list(self.spans)
+
+    def thread_ids(self) -> set[int]:
+        """Distinct thread tracks recorded — a pipelined streaming run
+        shows >= 2 (producer + consumer)."""
+        return {s.thread_id for s in self.snapshot()}
+
+    def to_chrome_trace(self, *, pid: int = 1) -> dict:
+        """The Chrome trace-event JSON object: complete (``"X"``) events
+        in microseconds rebased to the earliest span, plus
+        ``thread_name`` metadata events so Perfetto labels each track
+        (``ksel-pipeline-*`` = producer, ``MainThread`` = consumer)."""
+        spans = self.snapshot()
+        base = min((s.t0 for s in spans), default=0.0)
+        events = []
+        named: set[int] = set()
+        for s in spans:
+            if s.thread_id not in named:
+                named.add(s.thread_id)
+                events.append(
+                    {
+                        "name": "thread_name",
+                        "ph": "M",
+                        "pid": pid,
+                        "tid": s.thread_id,
+                        "args": {"name": s.thread_name},
+                    }
+                )
+            events.append(
+                {
+                    "name": s.name,
+                    "ph": "X",
+                    "pid": pid,
+                    "tid": s.thread_id,
+                    "ts": (s.t0 - base) * 1e6,
+                    "dur": s.duration * 1e6,
+                    "cat": s.name.split(".")[0],
+                    "args": {},
+                }
+            )
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def to_json(self, indent=None) -> str:
+        return json.dumps(self.to_chrome_trace(), indent=indent)
+
+    def write(self, path: str) -> None:
+        """Write the Chrome trace JSON to ``path`` (open it at
+        https://ui.perfetto.dev or chrome://tracing)."""
+        with open(path, "w") as f:
+            f.write(self.to_json())

@@ -1,0 +1,97 @@
+"""Shared obs plumbing for the streaming descent's consumers.
+
+The helpers every instrumented streaming loop needs — timer/recorder
+attachment, the per-chunk ingest observation, the window-occupancy
+histogram handle — live here (PUBLIC, in the obs package) rather than as
+privates of ``streaming/chunked.py``: ``chunked``, ``sketch`` and any
+future consumer (the resident query server) import one stable surface
+instead of reaching into a sibling module's underscores.
+
+Import direction: this module may import ``streaming/`` types lazily
+(function-level) — ``streaming/chunked.py`` imports obs modules at load
+time, so a module-level import back into ``streaming`` here would be a
+cycle.
+"""
+
+from __future__ import annotations
+
+from mpi_k_selection_tpu.obs.events import ChunkEvent
+
+
+def staged_slot(keys, devs):
+    """Round-robin slot index of a staged chunk's device within the
+    resolved device tuple (``None`` = host-resident, the uncommitted
+    default-device path, or a device outside the pass set) — the ONE
+    chunk->device mapping shared by the spill tee's record keying and
+    the obs chunk events."""
+    from mpi_k_selection_tpu.streaming.pipeline import StagedKeys
+
+    if isinstance(keys, StagedKeys) and keys.device is not None:
+        try:
+            return devs.index(keys.device)
+        except ValueError:  # pragma: no cover - device outside the pass set
+            return None
+    return None
+
+
+def window_occupancy(obs):
+    """The InflightWindow occupancy histogram when metrics are on."""
+    if obs is not None and obs.metrics is not None:
+        return obs.metrics.histogram("inflight.occupancy")
+    return None
+
+
+def attach_timer(obs, timer):
+    """Resolve the (timer, recorder) wiring: with span tracing on, every
+    phase needs a PhaseTimer to timestamp it — create one if the caller
+    passed none, attach the recorder if the caller's timer has none.
+
+    Returns ``(timer, restore)``. ``restore()`` detaches a recorder this
+    call attached to a CALLER-owned timer — run it on every exit path,
+    so a long-lived timer reused across later uninstrumented calls does
+    not keep feeding spans into (and growing) this run's TraceRecorder.
+    Timers created here, and timers whose recorder the caller set
+    themselves, need no restore (a no-op is returned)."""
+    if obs is None or obs.trace is None:
+        return timer, lambda: None
+    if timer is None:
+        from mpi_k_selection_tpu.utils.profiling import PhaseTimer
+
+        return PhaseTimer(recorder=obs.trace), lambda: None
+    if timer.recorder is None:
+        timer.recorder = obs.trace
+
+        def _restore(t=timer):
+            t.recorder = None
+
+        return timer, _restore
+    return timer, lambda: None
+
+
+def chunk_event(obs, pass_index, chunk_index, keys, kdt, devs):
+    """Emit one chunk's ingest observation (event + per-device counters).
+    Pure host-int observation — called only when ``obs`` is on."""
+    from mpi_k_selection_tpu.streaming.pipeline import StagedKeys
+
+    staged = isinstance(keys, StagedKeys)
+    slot = staged_slot(keys, devs)
+    n = int(keys.size)
+    nbytes = n * kdt.itemsize if kdt is not None else 0
+    obs.emit(
+        ChunkEvent(
+            pass_index=pass_index,
+            chunk_index=chunk_index,
+            n=n,
+            nbytes=nbytes,
+            device_slot=slot,
+            staged=staged,
+        )
+    )
+    if obs.metrics is not None:
+        # "default" = staged onto the uncommitted default device (the
+        # single-slot path); "host" = never staged (host-exact routes,
+        # depth-0 host chunks, device-resident chunks)
+        dev = str(slot) if slot is not None else ("default" if staged else "host")
+        lab = {"device": dev}
+        obs.metrics.counter("ingest.chunks", labels=lab).inc()
+        obs.metrics.counter("ingest.bytes", labels=lab).inc(nbytes)

@@ -230,6 +230,7 @@ def distributed_radix_select(
     cutover: int | str | None = "auto",
     cutover_budget: int = 8192,
     block_rows: int = 4096,
+    obs=None,
 ):
     """Exact k-th smallest (1-indexed) of sharded ``x``; replicated scalar out.
 
@@ -238,6 +239,12 @@ def distributed_radix_select(
     ops/radix.py:radix_select. Collected sentinel pads are value-safe: they
     carry the order-maximal key, so they sort after every real candidate
     (or tie it exactly, in which case the value is right either way).
+
+    ``obs`` (an :class:`~mpi_k_selection_tpu.obs.Observability`) records
+    the resolved dispatch (mesh size, radix_bits, cutover schedule) as a
+    ``distributed.select`` event at this host shell; the pass loop itself
+    is shard_map/jit-traced, so per-pass events are a streaming-only
+    capability (docs/OBSERVABILITY.md).
     """
     if mesh is None:
         mesh = mesh_lib.make_mesh()
@@ -261,6 +268,19 @@ def distributed_radix_select(
     ncut = resolve_cutover(
         cutover, x.shape[0], total_bits, radix_bits, cutover_budget
     )
+    if obs is not None:
+        from mpi_k_selection_tpu.obs.events import DistributedSelectEvent
+
+        obs.emit(
+            DistributedSelectEvent(
+                n=int(n),
+                queries=1,
+                n_devices=int(mesh.size),
+                radix_bits=int(radix_bits),
+                cutover_passes=None if ncut is None else int(ncut),
+                dtype=str(jnp.dtype(x.dtype)),
+            )
+        )
 
     fn = _jitted_select(
         mesh, n, total_bits, cdt, radix_bits, hist_method, chunk, ncut,

@@ -59,6 +59,9 @@ import contextlib
 
 import numpy as np
 
+from mpi_k_selection_tpu.obs import events as _ev
+from mpi_k_selection_tpu.obs import metrics as _om
+from mpi_k_selection_tpu.obs import wiring as _wr
 from mpi_k_selection_tpu.streaming import pipeline as _pl
 from mpi_k_selection_tpu.streaming import spill as _sp
 from mpi_k_selection_tpu.streaming.pipeline import DEFAULT_PIPELINE_DEPTH, StagedKeys
@@ -403,8 +406,8 @@ class _HistogramWindow(_pl.InflightWindow):
     window's fixed FIFO order is belt and braces, and keeps the
     replay-stability diagnostics reproducible)."""
 
-    def __init__(self, window: int):
-        super().__init__(window, _finish_chunk_histograms)
+    def __init__(self, window: int, occupancy=None):
+        super().__init__(window, _finish_chunk_histograms, occupancy=occupancy)
 
     def push(self, keys, shift, radix_bits, prefixes, method, kdt):
         return super().push(
@@ -440,9 +443,22 @@ def _prefix_mask(kv, resolved, prefix, kdt, total_bits):
     ) == kv.dtype.type(prefix)
 
 
+def _hist_summary(hists) -> tuple[int, int, int]:
+    """(total population, heaviest bucket, nonzero buckets) across one
+    pass's ``{prefix: int64 histogram}`` dict (or a single histogram)."""
+    if not isinstance(hists, dict):
+        hists = {None: hists}
+    total = bucket_max = nonzero = 0
+    for h in hists.values():
+        total += int(h.sum())
+        bucket_max = max(bucket_max, int(h.max()))
+        nonzero += int(np.count_nonzero(h))
+    return total, bucket_max, nonzero
+
+
 def _collect_survivors(
     src, dtype, specs, *, pipeline_depth=0, timer=None, devices=None,
-    hist_method=None,
+    hist_method=None, obs=None, read_from="source",
 ):
     """One streamed pass collecting survivors for EVERY ``(resolved_bits,
     prefix) -> expected population`` spec at once — the shared finish of
@@ -466,12 +482,17 @@ def _collect_survivors(
     devs = _pl.resolve_stream_devices(devices)
     multi = len(devs) > 1 and _pl.validate_pipeline_depth(pipeline_depth) > 0
     out = {s: [] for s in specs}
-    with _key_chunk_stream(
+    chunk_i = keys_read = 0
+    with _pl._phase(timer, "descent.collect"), _key_chunk_stream(
         src, dtype, pipeline_depth=pipeline_depth, timer=timer,
         hist_method=hist_method if multi else None,
         devices=devs if multi else None,
     ) as kc:
         for keys, _ in kc:
+            if obs is not None:
+                _wr.chunk_event(obs, "collect", chunk_i, keys, kdt, devs)
+            chunk_i += 1
+            keys_read += int(keys.size)
             staged = isinstance(keys, StagedKeys)
             kv = keys.valid() if staged else keys
             host = isinstance(kv, np.ndarray)
@@ -483,6 +504,22 @@ def _collect_survivors(
                     out[(resolved, prefix)].append(np.asarray(surv, kdt))
             if staged:
                 keys.release()
+    if obs is not None:
+        obs.emit(
+            _ev.StreamPassEvent(
+                pass_index="collect",
+                resolved_bits=0,
+                prefixes=tuple(int(p) for _, p in sorted(specs)),
+                chunks=chunk_i,
+                keys_read=keys_read,
+                bytes_read=keys_read * kdt.itemsize,
+                read_from=read_from,
+                bucket_total=0,
+                bucket_max=0,
+                bucket_nonzero=0,
+                survivors=(),
+            )
+        )
     collected = {}
     for spec, parts in out.items():
         c = np.concatenate(parts) if parts else np.empty((0,), kdt)
@@ -513,12 +550,7 @@ def _spill_tee_survivors(writer, keys, specs, dtype, kdt, total_bits, devs):
     the histogram window can ``release()`` the staged buffer."""
     staged = isinstance(keys, StagedKeys)
     kv = keys.valid() if staged else keys
-    slot = None
-    if staged and keys.device is not None:
-        try:
-            slot = devs.index(keys.device)
-        except ValueError:  # pragma: no cover - device outside the pass set
-            slot = None
+    slot = _wr.staged_slot(keys, devs)
     m = None
     for resolved, prefix in specs:
         mi = _prefix_mask(kv, resolved, prefix, kdt, total_bits)
@@ -576,6 +608,7 @@ def streaming_kselect(
     devices=None,
     spill=DEFAULT_SPILL,
     spill_dir=None,
+    obs=None,
 ):
     """Exact k-th smallest (1-indexed) over a chunked stream.
 
@@ -625,6 +658,13 @@ def streaming_kselect(
     ``spill_dir`` roots internally-created stores (default: the system
     temp dir). Answers are bit-identical to ``spill="off"`` in every mode,
     for every devices x pipeline_depth combination.
+
+    ``obs`` (an :class:`~mpi_k_selection_tpu.obs.Observability`) turns on
+    the descent telemetry: one typed event per streamed pass and per
+    consumed chunk, metrics (StagingPool hits/misses, stall seconds,
+    in-flight window occupancy, chunks/bytes per device, spilled bytes),
+    and producer/consumer trace spans. Off by default; enabling it never
+    changes an answer bit (see docs/OBSERVABILITY.md).
     """
     return streaming_kselect_many(
         source,
@@ -638,6 +678,7 @@ def streaming_kselect(
         devices=devices,
         spill=spill,
         spill_dir=spill_dir,
+        obs=obs,
     )[0]
 
 
@@ -654,6 +695,7 @@ def streaming_kselect_many(
     devices=None,
     spill=DEFAULT_SPILL,
     spill_dir=None,
+    obs=None,
 ):
     """Exact k-th smallest for EVERY (1-indexed) rank in ``ks``, sharing
     each streamed pass across ranks: the stream is replayed once per radix
@@ -662,8 +704,9 @@ def streaming_kselect_many(
     the same bucket share it). For out-of-core sources the replay is the
     dominant cost, so m quantiles over one stream cost roughly the passes
     of one. Per-rank semantics are exactly :func:`streaming_kselect`'s
-    (including its ``pipeline_depth``/``timer``/``devices`` and
-    ``spill``/``spill_dir`` knobs); returns a list in input order.
+    (including its ``pipeline_depth``/``timer``/``devices``,
+    ``spill``/``spill_dir`` and ``obs`` knobs); returns a list in input
+    order.
 
     With spill engaged the "replay" above is a generation read: pass 0
     tees the encoded keys to the spill store, every later pass filters the
@@ -675,6 +718,8 @@ def streaming_kselect_many(
     """
     pipeline_depth = _pl.validate_pipeline_depth(pipeline_depth)
     devs = _pl.resolve_stream_devices(devices)
+    timer, _restore_recorder = _wr.attach_timer(obs, timer)
+    occupancy = _wr.window_occupancy(obs)
     # one in-flight histogram slot per ingest device; the synchronous
     # (depth-0) oracle stays strictly serial regardless of the knob
     window = len(devs) if pipeline_depth > 0 else 1
@@ -756,14 +801,16 @@ def streaming_kselect_many(
             # pipelined), so no later pass touches the source again.
             dtype = None
             n = 0
+            chunk_i0 = 0
+            pass0_gen = read_gen  # what pass 0 actually read from
             writer = (
                 store.new_generation()
                 if store is not None and read_gen is None
                 else None
             )
-            win = _HistogramWindow(window)
+            win = _HistogramWindow(window, occupancy)
             try:
-                with _key_chunk_stream(
+                with _pl._phase(timer, "descent.pass"), _key_chunk_stream(
                     _gen_src(), hist_method=hist_method, spill=writer,
                     **stream_kw,
                 ) as kc:
@@ -780,6 +827,9 @@ def streaming_kselect_many(
                             method = resolve_stream_hist(hist_method, dtype)
                             shift0 = total_bits - radix_bits
                             hist = np.zeros((1 << radix_bits,), np.int64)
+                        if obs is not None:
+                            _wr.chunk_event(obs, 0, chunk_i0, keys, kdt, devs)
+                        chunk_i0 += 1
                         n += int(keys.size)
                         for h in win.push(
                             keys, shift0, radix_bits, [None], method, kdt
@@ -795,6 +845,7 @@ def streaming_kselect_many(
                 if writer is not None:
                     writer.abort()
                 raise
+            gen0 = None
             if writer is not None:
                 gen0 = writer.commit()
                 created.append(gen0)
@@ -809,6 +860,40 @@ def streaming_kselect_many(
             for k in ks:
                 prefix, kk, pop = _np_walk(hist, k, None, radix_bits)
                 states.append([prefix, kk, radix_bits, pop])
+            if obs is not None:
+                if gen0 is not None:
+                    obs.emit(
+                        _ev.SpillGenerationEvent(
+                            generation=gen0.index,
+                            records=len(gen0.records),
+                            keys=gen0.keys,
+                            nbytes=gen0.nbytes,
+                        )
+                    )
+                total0, max0, nz0 = _hist_summary(hist)
+                obs.emit(
+                    _ev.StreamPassEvent(
+                        pass_index=0,
+                        resolved_bits=0,
+                        prefixes=(),
+                        chunks=chunk_i0,
+                        keys_read=(
+                            int(pass0_gen.keys) if pass0_gen is not None else n
+                        ),
+                        bytes_read=(
+                            int(pass0_gen.nbytes)
+                            if pass0_gen is not None
+                            else n * kdt.itemsize
+                        ),
+                        read_from="spill" if pass0_gen is not None else "source",
+                        bucket_total=total0,
+                        bucket_max=max0,
+                        bucket_nonzero=nz0,
+                        survivors=tuple(int(st[3]) for st in states),
+                        keys_written=None if gen0 is None else int(gen0.keys),
+                        bytes_written=None if gen0 is None else int(gen0.nbytes),
+                    )
+                )
 
         def _active(st):
             return st[2] < total_bits and st[3] > collect_budget
@@ -837,12 +922,18 @@ def streaming_kselect_many(
                     }
                 )
                 writer = store.new_generation()
-            win = _HistogramWindow(window)
+            pass_label = resolved // radix_bits
+            pass_read_gen = read_gen  # what this pass reads from
+            chunk_i = 0
+            win = _HistogramWindow(window, occupancy)
             try:
-                with _key_chunk_stream(
+                with _pl._phase(timer, "descent.pass"), _key_chunk_stream(
                     _gen_src(), dtype, hist_method=method, **stream_kw
                 ) as kc:
                     for keys, _ in kc:
+                        if obs is not None:
+                            _wr.chunk_event(obs, pass_label, chunk_i, keys, kdt, devs)
+                        chunk_i += 1
                         if writer is not None:
                             # tee BEFORE the window can release the staged
                             # buffer; the filter runs on the chunk's own
@@ -880,9 +971,10 @@ def streaming_kselect_many(
                         "callable must yield identical data on every "
                         "invocation."
                     )
+            gen = None
             if writer is not None:
                 gen = writer.commit()
-                _log_pass(resolved // radix_bits, gen)
+                _log_pass(pass_label, gen)
                 _rotate(gen)
             for st in states:
                 if _active(st):
@@ -890,6 +982,44 @@ def streaming_kselect_many(
                         hists[st[0]], st[1], st[0], radix_bits
                     )
                     st[2] = resolved + radix_bits
+            if obs is not None:
+                if gen is not None:
+                    obs.emit(
+                        _ev.SpillGenerationEvent(
+                            generation=gen.index,
+                            records=len(gen.records),
+                            keys=gen.keys,
+                            nbytes=gen.nbytes,
+                        )
+                    )
+                totalp, maxp, nzp = _hist_summary(hists)
+                obs.emit(
+                    _ev.StreamPassEvent(
+                        pass_index=pass_label,
+                        resolved_bits=resolved,
+                        prefixes=tuple(int(p) for p in prefixes),
+                        chunks=chunk_i,
+                        keys_read=(
+                            int(pass_read_gen.keys)
+                            if pass_read_gen is not None
+                            else n
+                        ),
+                        bytes_read=(
+                            int(pass_read_gen.nbytes)
+                            if pass_read_gen is not None
+                            else n * kdt.itemsize
+                        ),
+                        read_from=(
+                            "spill" if pass_read_gen is not None else "source"
+                        ),
+                        bucket_total=totalp,
+                        bucket_max=maxp,
+                        bucket_nonzero=nzp,
+                        survivors=tuple(int(st[3]) for st in states),
+                        keys_written=None if gen is None else int(gen.keys),
+                        bytes_written=None if gen is None else int(gen.nbytes),
+                    )
+                )
 
         specs = {}
         for prefix, _kk, resolved, pop in states:
@@ -900,10 +1030,18 @@ def streaming_kselect_many(
             collected = _collect_survivors(
                 _gen_src(), dtype, specs, pipeline_depth=pipeline_depth,
                 timer=timer, devices=None if devices is None else devs,
-                hist_method=method,
+                hist_method=method, obs=obs,
+                read_from="spill" if read_gen is not None else "source",
             )
             _log_pass("collect")
 
+        if obs is not None and obs.metrics is not None:
+            # snapshot the run's counters while the store is still open
+            # (the finally below may remove an internal one)
+            _om.collect_runtime(
+                obs.metrics, staging_pool=_pl.STAGING_POOL,
+                spill_store=store, timer=timer,
+            )
         answers = []
         for prefix, kk, resolved, _pop in states:
             if resolved == total_bits:
@@ -919,6 +1057,7 @@ def streaming_kselect_many(
             )
         return answers
     finally:
+        _restore_recorder()
         if own_store:
             store.close()
         elif store is not None:
@@ -931,7 +1070,7 @@ def streaming_kselect_many(
 
 def streaming_rank_certificate(
     source, value, *, pipeline_depth: int = DEFAULT_PIPELINE_DEPTH, timer=None,
-    devices=None,
+    devices=None, obs=None,
 ):
     """``(#elements < value, #elements <= value)`` streamed — the O(n)
     exactness proof of utils/debug.py:rank_certificate without residency:
@@ -950,9 +1089,12 @@ def streaming_rank_certificate(
     one-shot source's answer without re-reading it)."""
     src = as_chunk_source(source)
     devs = _pl.resolve_stream_devices(devices)
+    timer, _restore_recorder = _wr.attach_timer(obs, timer)
     multi = len(devs) > 1 and _pl.validate_pipeline_depth(pipeline_depth) > 0
     less = leq = 0
     vkey = None
+    kdt = None
+    chunk_i = keys_read = 0
 
     def _finish_counts(handle):
         staged, lt, le = handle
@@ -961,37 +1103,58 @@ def streaming_rank_certificate(
             staged.release()
         return counts
 
-    win = _pl.InflightWindow(len(devs), _finish_counts)
-    with _key_chunk_stream(
-        src, pipeline_depth=pipeline_depth, timer=timer,
-        hist_method="auto" if multi else None, devices=devs if multi else None,
-    ) as kc:
-        for keys, chunk in kc:
-            if vkey is None:
-                # key the probe value from the first chunk's dtype — no
-                # chunk is produced just to learn it
-                vkey = _dt.np_to_sortable_bits(
-                    np.asarray([value], np.dtype(chunk.dtype))
-                )[0]
-            staged = isinstance(keys, StagedKeys)
-            kv = keys.valid() if staged else keys
-            if isinstance(kv, np.ndarray):
-                less += int(np.count_nonzero(kv < vkey))
-                leq += int(np.count_nonzero(kv <= vkey))
-            else:
-                import jax.numpy as jnp
+    win = _pl.InflightWindow(
+        len(devs), _finish_counts, occupancy=_wr.window_occupancy(obs)
+    )
+    try:
+        with _pl._phase(timer, "certificate.pass"), _key_chunk_stream(
+            src, pipeline_depth=pipeline_depth, timer=timer,
+            hist_method="auto" if multi else None,
+            devices=devs if multi else None,
+        ) as kc:
+            for keys, chunk in kc:
+                if vkey is None:
+                    # key the probe value from the first chunk's dtype — no
+                    # chunk is produced just to learn it
+                    vkey = _dt.np_to_sortable_bits(
+                        np.asarray([value], np.dtype(chunk.dtype))
+                    )[0]
+                    kdt = np.dtype(_dt.key_dtype(np.dtype(chunk.dtype)))
+                if obs is not None:
+                    _wr.chunk_event(obs, "certificate", chunk_i, keys, kdt, devs)
+                chunk_i += 1
+                keys_read += int(keys.size)
+                staged = isinstance(keys, StagedKeys)
+                kv = keys.valid() if staged else keys
+                if isinstance(kv, np.ndarray):
+                    less += int(np.count_nonzero(kv < vkey))
+                    leq += int(np.count_nonzero(kv <= vkey))
+                else:
+                    import jax.numpy as jnp
 
-                v = kv.dtype.type(vkey)
-                # dispatch both counts async on the chunk's own device;
-                # materialize FIFO once one count per device is in flight
-                for lt, le in win.push(
-                    (keys if staged else None, jnp.sum(kv < v), jnp.sum(kv <= v))
-                ):
-                    less += lt
-                    leq += le
-        for lt, le in win.drain():
-            less += lt
-            leq += le
+                    v = kv.dtype.type(vkey)
+                    # dispatch both counts async on the chunk's own device;
+                    # materialize FIFO once one count per device is in flight
+                    for lt, le in win.push(
+                        (keys if staged else None, jnp.sum(kv < v), jnp.sum(kv <= v))
+                    ):
+                        less += lt
+                        leq += le
+            for lt, le in win.drain():
+                less += lt
+                leq += le
+    finally:
+        _restore_recorder()
     if vkey is None:
         raise ValueError("streaming_rank_certificate requires a non-empty stream")
+    if obs is not None:
+        obs.emit(
+            _ev.CertificateEvent(
+                chunks=chunk_i, keys_read=keys_read, less=less, leq=leq
+            )
+        )
+        if obs.metrics is not None:
+            _om.collect_runtime(
+                obs.metrics, staging_pool=_pl.STAGING_POOL, timer=timer
+            )
     return less, leq

@@ -235,6 +235,13 @@ class StagingPool:
                     old.pop(0)
                     self._bytes -= nbytes
 
+    @property
+    def resident_bytes(self) -> int:
+        """Bytes currently held in the free lists (the pool's footprint) —
+        the public form the obs registry exports."""
+        with self._lock:
+            return self._bytes
+
     def clear(self) -> None:
         with self._lock:
             self._free.clear()
@@ -262,11 +269,18 @@ class InflightWindow:
     deterministic: results fold in chunk order no matter which device
     finishes first. With ``window=1`` every push finishes its own handle
     immediately — exactly the serial single-device behavior.
+
+    ``occupancy`` (optional, an obs/metrics.py Histogram) samples the
+    in-flight handle count at every push — the per-device window
+    utilization the ROADMAP's async-executor work needs: a p-wide window
+    that samples ~1 under load is the r6 serialization made measurable.
+    Pure observation of a host int; never touches the handles.
     """
 
-    def __init__(self, window: int, finish):
+    def __init__(self, window: int, finish, occupancy=None):
         self._window = max(1, int(window))
         self._finish = finish
+        self._occupancy = occupancy
         self._q: collections.deque = collections.deque()
 
     def push(self, handle) -> list:
@@ -274,6 +288,8 @@ class InflightWindow:
         finished results (a plain list, NOT a generator: the pop must
         happen at call time even if a caller drops the result)."""
         self._q.append(handle)
+        if self._occupancy is not None:
+            self._occupancy.observe(len(self._q))
         if len(self._q) >= self._window:
             return [self._finish(self._q.popleft())]
         return []

@@ -147,7 +147,7 @@ class RadixSketch:
 
     def update_stream(
         self, source, *, pipeline_depth=None, timer=None, devices=None,
-        spill=None,
+        spill=None, obs=None,
     ) -> "RadixSketch":
         """Fold EVERY chunk of a replayable/listed ``source`` in (one
         stream pass), drawing from the pipelined iterator: a background
@@ -174,9 +174,16 @@ class RadixSketch:
         ``sketch.refine(store, k)`` runs the exact descent entirely from
         disk, never re-reading the original stream.
 
+        ``obs`` (an :class:`~mpi_k_selection_tpu.obs.Observability`) emits
+        per-chunk ingest events, a ``sketch.pass`` summary event, window
+        occupancy samples and the StagingPool counters — off by default,
+        never changes a count bit.
+
         Bit-identical to sequential :meth:`update` calls over the same
         chunks, for every ``pipeline_depth`` x ``devices`` combination.
         Returns ``self``."""
+        from mpi_k_selection_tpu.obs import events as _ev
+        from mpi_k_selection_tpu.obs import wiring as _wr
         from mpi_k_selection_tpu.streaming import pipeline as _pl
         from mpi_k_selection_tpu.streaming import spill as _sp
         from mpi_k_selection_tpu.streaming.chunked import (
@@ -186,6 +193,7 @@ class RadixSketch:
 
         pipeline_depth = _pl.validate_pipeline_depth(pipeline_depth)
         devs = _pl.resolve_stream_devices(devices)
+        timer, _restore_recorder = _wr.attach_timer(obs, timer)
         multi = len(devs) > 1 and pipeline_depth > 0
         if spill is not None and not isinstance(spill, _sp.SpillStore):
             raise TypeError(
@@ -194,9 +202,13 @@ class RadixSketch:
             )
         src = as_chunk_source(source, one_shot_ok=spill is not None)
         writer = spill.new_generation() if spill is not None else None
-        win = _pl.InflightWindow(len(devs), self._fold_staged)
+        win = _pl.InflightWindow(
+            len(devs), self._fold_staged,
+            occupancy=_wr.window_occupancy(obs),
+        )
+        chunk_i = keys_read = staged_chunks = 0
         try:
-            with _key_chunk_stream(
+            with _pl._phase(timer, "sketch.pass"), _key_chunk_stream(
                 src, self.dtype, pipeline_depth=pipeline_depth, timer=timer,
                 # "scatter" handles the deepest level's 2**resolution_bits
                 # buckets (the same method distributed_sketch defaults to);
@@ -207,7 +219,14 @@ class RadixSketch:
                 spill=writer,
             ) as kc:
                 for keys, _ in kc:
+                    if obs is not None:
+                        _wr.chunk_event(
+                            obs, "sketch", chunk_i, keys, self.kdt, devs
+                        )
+                    chunk_i += 1
+                    keys_read += int(keys.size)
                     if isinstance(keys, _pl.StagedKeys):
+                        staged_chunks += 1
                         win.push(self._dispatch_staged(keys))
                         continue
                     # device chunks arrive as device keys (bitwise twins of
@@ -223,8 +242,28 @@ class RadixSketch:
             if writer is not None:
                 writer.abort()
             raise
+        finally:
+            # detach a recorder this call attached to a caller-owned timer
+            # (no phase records outside the stream context above)
+            _restore_recorder()
         if writer is not None:
             writer.commit()
+        if obs is not None:
+            obs.emit(
+                _ev.SketchPassEvent(
+                    chunks=chunk_i,
+                    keys_read=keys_read,
+                    bytes_read=keys_read * self.kdt.itemsize,
+                    staged_chunks=staged_chunks,
+                )
+            )
+            if obs.metrics is not None:
+                from mpi_k_selection_tpu.obs.metrics import collect_runtime
+
+                collect_runtime(
+                    obs.metrics, staging_pool=_pl.STAGING_POOL,
+                    spill_store=spill, timer=timer,
+                )
         return self
 
     def _dispatch_staged(self, staged) -> tuple:

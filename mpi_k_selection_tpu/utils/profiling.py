@@ -8,16 +8,24 @@ replacement:
 - :class:`PhaseTimer` — named per-phase wall timing (callers sync devices
   with ``block_until_ready``/``np.asarray`` where relevant), the
   "per-round timing" SURVEY.md §5 calls for; renders a report and a dict.
+  Thread-safe: the pipelined streaming descent accumulates producer-thread
+  phases (produce/encode/stage/spill) and consumer-thread phases
+  (stall, per-pass merges) into ONE timer concurrently. An optional
+  ``recorder`` (obs/trace.py:TraceRecorder) receives every finished
+  ``(name, t0, t1)`` phase on its own thread — the ONE bridge from this
+  module's clocks (KSL004: raw clocks live only here and in
+  utils/timing.py) to the cross-thread Chrome-trace export.
 - :func:`trace` — context manager around ``jax.profiler`` producing a
   TensorBoard-loadable device trace (XLA op/kernel level), when available.
 - :func:`device_memory_stats` — HBM usage snapshot per device.
 
-Used by the CLI via ``--profile`` / ``--trace-dir``.
+Used by the CLI via ``--profile`` / ``--trace-dir`` / ``--trace-events``.
 """
 
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 from dataclasses import dataclass, field
 
@@ -26,10 +34,20 @@ import jax
 
 @dataclass
 class PhaseTimer:
-    """Accumulates named phase durations: ``with timer.phase('sort'): ...``"""
+    """Accumulates named phase durations: ``with timer.phase('sort'): ...``
+
+    ``recorder`` (optional) gets ``record(name, t0, t1)`` for every
+    finished phase, called on the thread that ran it — so one timer
+    shared across the pipeline's producer and consumer yields correctly
+    thread-attributed spans.
+    """
 
     phases: dict = field(default_factory=dict)
     counts: dict = field(default_factory=dict)
+    recorder: object = None
+    _lock: threading.Lock = field(
+        default_factory=threading.Lock, repr=False, compare=False
+    )
 
     @contextlib.contextmanager
     def phase(self, name: str):
@@ -37,23 +55,29 @@ class PhaseTimer:
         try:
             yield
         finally:
-            dt = time.perf_counter() - t0
-            self.phases[name] = self.phases.get(name, 0.0) + dt
-            self.counts[name] = self.counts.get(name, 0) + 1
+            t1 = time.perf_counter()
+            with self._lock:
+                self.phases[name] = self.phases.get(name, 0.0) + (t1 - t0)
+                self.counts[name] = self.counts.get(name, 0) + 1
+            if self.recorder is not None:
+                self.recorder.record(name, t0, t1)
 
     def record(self, name: str, seconds: float) -> None:
-        self.phases[name] = self.phases.get(name, 0.0) + seconds
-        self.counts[name] = self.counts.get(name, 0) + 1
+        with self._lock:
+            self.phases[name] = self.phases.get(name, 0.0) + seconds
+            self.counts[name] = self.counts.get(name, 0) + 1
 
     @property
     def total(self) -> float:
-        return sum(self.phases.values())
+        with self._lock:
+            return sum(self.phases.values())
 
     def as_dict(self) -> dict:
-        return {
-            name: {"seconds": s, "calls": self.counts[name]}
-            for name, s in self.phases.items()
-        }
+        with self._lock:
+            return {
+                name: {"seconds": s, "calls": self.counts[name]}
+                for name, s in self.phases.items()
+            }
 
     def report(self) -> str:
         total = self.total or 1.0

@@ -164,3 +164,112 @@ def test_cli_quantiles_rejects_non_radix_algorithm():
 
     with pytest.raises(SystemExit, match="radix"):
         main(["--quantiles", "0.5", "--algorithm", "sort", "--n", "1000"])
+
+
+def test_cli_metrics_json_and_trace_events_streaming(tmp_path, capsys):
+    """--metrics-json / --trace-events (ISSUE 6): a streaming run writes a
+    parseable metrics registry snapshot and a perfetto-loadable Chrome
+    trace with producer AND consumer thread tracks, composing with
+    --profile, without changing the answer or exit code."""
+    mpath = tmp_path / "metrics.json"
+    tpath = tmp_path / "trace.json"
+    rc = main([
+        "--streaming", "--backend", "tpu", "--n", "40000",
+        "--chunk-elems", "8192", "--verify", "--profile", "--json",
+        "--metrics-json", str(mpath), "--trace-events", str(tpath),
+    ])
+    assert rc == 0
+    rec = json.loads(capsys.readouterr().out)
+    assert rec["extra"]["exact_match"] is True
+    assert rec["extra"]["metrics_json"] == str(mpath)
+    assert rec["extra"]["trace_events"] == str(tpath)
+    metrics = json.loads(mpath.read_text())
+    # the catalog's load-bearing entries are present with sane values
+    assert metrics["staging_pool.misses"]["type"] == "counter"
+    assert metrics["inflight.occupancy"]["type"] == "histogram"
+    stall = metrics['phase.seconds{phase="pipeline.stall"}']
+    assert stall["type"] == "gauge" and stall["value"] >= 0
+    solve = metrics['phase.seconds{phase="solve"}']
+    assert solve["value"] > 0  # the driver timer folded in at _finish
+    trace = json.loads(tpath.read_text())
+    xs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    assert xs and len({e["tid"] for e in xs}) >= 2  # producer + consumer
+    names = {e["name"] for e in xs}
+    assert "pipeline.produce" in names and "descent.pass" in names
+
+
+def test_cli_metrics_json_alone_carries_pipeline_phases(tmp_path, capsys):
+    """--metrics-json WITHOUT --profile/--trace-events must still export
+    the pipeline/descent phase gauges its help text promises (the timer
+    exists for the registry, not only for the report)."""
+    mpath = tmp_path / "m.json"
+    rc = main([
+        "--streaming", "--backend", "tpu", "--n", "40000",
+        "--chunk-elems", "8192", "--json", "--metrics-json", str(mpath),
+    ])
+    assert rc == 0
+    json.loads(capsys.readouterr().out)
+    metrics = json.loads(mpath.read_text())
+    assert metrics['phase.seconds{phase="pipeline.stall"}']["value"] >= 0
+    assert metrics['phase.seconds{phase="descent.pass"}']["value"] > 0
+
+
+def test_cli_metrics_json_resident_mode(tmp_path, capsys):
+    """The flags also work outside --streaming: the driver phases
+    (generate/solve) land in the registry and the trace."""
+    mpath = tmp_path / "m.json"
+    tpath = tmp_path / "t.json"
+    rc = main([
+        "--backend", "tpu", "--n", "30000", "--distribute", "never", "--json",
+        "--metrics-json", str(mpath), "--trace-events", str(tpath),
+    ])
+    assert rc == 0
+    json.loads(capsys.readouterr().out)
+    metrics = json.loads(mpath.read_text())
+    assert metrics['phase.seconds{phase="solve"}']["value"] > 0
+    trace = json.loads(tpath.read_text())
+    assert {e["name"] for e in trace["traceEvents"] if e["ph"] == "X"} >= {
+        "generate", "solve",
+    }
+
+
+def test_cli_check_does_not_clobber_solve_metrics(tmp_path, capsys):
+    """--check shares only the TRACE channel with the solve: the written
+    metrics registry must describe the solve's pipeline phases (not get
+    overwritten by the certificate pass's fresh timer), while the trace
+    still shows the certificate span on the shared timeline."""
+    mpath = tmp_path / "m.json"
+    tpath = tmp_path / "t.json"
+    rc = main([
+        "--streaming", "--backend", "tpu", "--n", "40000",
+        "--chunk-elems", "8192", "--check", "--profile", "--json",
+        "--metrics-json", str(mpath), "--trace-events", str(tpath),
+    ])
+    assert rc == 0
+    rec = json.loads(capsys.readouterr().out)
+    metrics = json.loads(mpath.read_text())
+    want_calls = rec["extra"]["pipeline_phases"]["pipeline.produce"]["calls"]
+    got_calls = metrics['phase.calls{phase="pipeline.produce"}']["value"]
+    assert got_calls == want_calls  # the SOLVE's counts, not the check's
+    trace = json.loads(tpath.read_text())
+    names = {e["name"] for e in trace["traceEvents"] if e["ph"] == "X"}
+    assert "certificate.pass" in names  # still traced, same timeline
+    # per-device chunk counters match an identical run WITHOUT --check:
+    # the certificate's chunks must not additively pollute the registry
+    mpath2 = tmp_path / "m2.json"
+    rc = main([
+        "--streaming", "--backend", "tpu", "--n", "40000",
+        "--chunk-elems", "8192", "--profile", "--json",
+        "--metrics-json", str(mpath2),
+    ])
+    assert rc == 0
+    capsys.readouterr()
+    metrics2 = json.loads(mpath2.read_text())
+
+    def _chunk_totals(m):
+        return {
+            name: v["value"] for name, v in m.items()
+            if name.startswith("ingest.chunks{")
+        }
+
+    assert _chunk_totals(metrics) == _chunk_totals(metrics2)

@@ -330,6 +330,53 @@ def test_unpadded_pow2_chunk_carries_no_pool_buffer():
     staged.release()
 
 
+def test_staging_pool_metrics_mirror_counters_exactly():
+    """ISSUE 6 satellite: the obs registry exposes the pool's hit/miss
+    counters VERBATIM — collect_runtime snapshots the very ints the pool
+    increments, so the two can never drift."""
+    from mpi_k_selection_tpu.obs import MetricsRegistry
+    from mpi_k_selection_tpu.obs.metrics import collect_runtime
+
+    pool = pl.StagingPool()
+    a = pl.stage_keys(np.arange(1000, dtype=np.uint32), None, pool)  # miss
+    a.release()
+    b = pl.stage_keys(np.arange(1000, dtype=np.uint32), None, pool)  # hit
+    c = pl.stage_keys(np.arange(2000, dtype=np.uint32), None, pool)  # miss
+    reg = MetricsRegistry()
+    collect_runtime(reg, staging_pool=pool)
+    assert reg.counter("staging_pool.hits").value == pool.hits == 1
+    assert reg.counter("staging_pool.misses").value == pool.misses == 2
+    b.release()
+    c.release()
+    # re-collection tracks the live counters, idempotently
+    d = pl.stage_keys(np.arange(1000, dtype=np.uint32), None, pool)
+    collect_runtime(reg, staging_pool=pool)
+    assert reg.counter("staging_pool.hits").value == pool.hits == 2
+    d.release()
+
+
+def test_descent_metrics_snapshot_matches_module_pool(rng):
+    """An instrumented multi-device descent snapshots the MODULE staging
+    pool's counters into its registry at descent end — the registry must
+    equal the pool's own (monotone) counters right after the call."""
+    from mpi_k_selection_tpu.obs import MetricsRegistry, Observability
+
+    chunks = [
+        rng.integers(0, 2**31 - 1, size=1500, dtype=np.int32) for _ in range(4)
+    ]
+    n = sum(c.size for c in chunks)
+    o = Observability(metrics=MetricsRegistry())
+    got = int(
+        streaming_kselect(chunks, n // 2, pipeline_depth=2, devices=2, obs=o)
+    )
+    assert got == seq.kselect_sort(np.concatenate(chunks), n // 2)
+    assert o.metrics.counter("staging_pool.hits").value == pl.STAGING_POOL.hits
+    assert (
+        o.metrics.counter("staging_pool.misses").value
+        == pl.STAGING_POOL.misses
+    )
+
+
 # -- CLI ----------------------------------------------------------------------
 
 

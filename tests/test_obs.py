@@ -1,0 +1,447 @@
+"""Descent telemetry (mpi_k_selection_tpu/obs/): the sinks-on == sinks-off
+bit-identity grid, event-stream invariants, the metrics registry, the
+cross-thread trace recorder, and PhaseTimer under concurrent
+producer/consumer threads."""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from mpi_k_selection_tpu import obs as obs_lib
+from mpi_k_selection_tpu.obs.metrics import collect_runtime
+from mpi_k_selection_tpu.streaming.chunked import (
+    streaming_kselect,
+    streaming_kselect_many,
+    streaming_rank_certificate,
+)
+from mpi_k_selection_tpu.streaming.pipeline import StagingPool
+from mpi_k_selection_tpu.streaming.sketch import RadixSketch
+from mpi_k_selection_tpu.streaming.spill import SpillStore
+from mpi_k_selection_tpu.utils.profiling import PhaseTimer
+
+
+def _chunks(rng, sizes=(5000, 4096, 2048, 4096, 1000), dtype=np.int32):
+    return [
+        rng.integers(-(2**31), 2**31 - 1, size=m, dtype=np.int64).astype(dtype)
+        for m in sizes
+    ]
+
+
+def _oracle(chunks, k):
+    return np.sort(np.concatenate([c.ravel() for c in chunks]), kind="stable")[
+        k - 1
+    ]
+
+
+# ---------------------------------------------------------------------------
+# bit-identity: sinks on vs off over the devices x depth x spill grid
+
+
+@pytest.mark.parametrize("devices", [None, 2, 8])
+@pytest.mark.parametrize("depth", [0, 2])
+@pytest.mark.parametrize("spill", ["off", "force"])
+def test_obs_bit_identical_grid(rng, devices, depth, spill):
+    chunks = _chunks(rng)
+    n = sum(c.size for c in chunks)
+    k = n // 2
+    want = int(_oracle(chunks, k))
+    kw = dict(
+        radix_bits=4, collect_budget=64, pipeline_depth=depth,
+        devices=devices, spill=spill,
+    )
+    plain = int(streaming_kselect(chunks, k, **kw))
+    o = obs_lib.Observability.collecting()
+    instrumented = int(streaming_kselect(chunks, k, obs=o, **kw))
+    assert plain == instrumented == want
+    # the stream observed something real and structurally sound
+    obs_lib.check_stream_invariants(o.events.events)
+    assert len(o.events.of_kind("stream.pass")) >= 2
+
+
+def test_obs_bit_identical_multirank_and_f64(rng):
+    chunks = _chunks(rng, sizes=(3000, 2048, 1000), dtype=np.float64)
+    n = sum(c.size for c in chunks)
+    ks = [1, n // 3, n // 2, n]
+    s = np.sort(np.concatenate(chunks), kind="stable")
+    want = [s[k - 1] for k in ks]
+    o = obs_lib.Observability.collecting()
+    got = streaming_kselect_many(chunks, ks, radix_bits=4, collect_budget=32, obs=o)
+    got_off = streaming_kselect_many(chunks, ks, radix_bits=4, collect_budget=32)
+    assert [float(g) for g in got] == [float(g) for g in got_off] == [
+        float(w) for w in want
+    ]
+    obs_lib.check_stream_invariants(o.events.events)
+    # multi-rank: every pass event carries one survivor population per rank
+    for e in o.events.of_kind("stream.pass"):
+        if e.pass_index != "collect":
+            assert len(e.survivors) == len(ks)
+
+
+def test_obs_sketch_bit_identical(rng):
+    chunks = _chunks(rng, sizes=(3000, 2000, 1024))
+    o = obs_lib.Observability.collecting()
+    sk = RadixSketch(np.int32).update_stream(chunks, devices=2, obs=o)
+    sk_off = RadixSketch(np.int32).update_stream(chunks, devices=2)
+    sk_seq = RadixSketch(np.int32)
+    for c in chunks:
+        sk_seq.update(c)
+    assert sk == sk_off == sk_seq
+    (ev,) = o.events.of_kind("sketch.pass")
+    assert ev.chunks == len(chunks)
+    assert ev.keys_read == sum(c.size for c in chunks)
+
+
+# ---------------------------------------------------------------------------
+# event-stream structure
+
+
+def test_event_stream_spill_matches_pass_log(rng):
+    chunks = _chunks(rng)
+    n = sum(c.size for c in chunks)
+    k = n // 2
+    o = obs_lib.Observability.collecting()
+    with SpillStore() as store:
+        got = int(
+            streaming_kselect(
+                chunks, k, radix_bits=4, collect_budget=64, spill=store,
+                pipeline_depth=2, devices=2, obs=o,
+            )
+        )
+        log = list(store.pass_log)
+    assert got == int(_oracle(chunks, k))
+    obs_lib.check_stream_invariants(o.events.events, spill_pass_log=log)
+    passes = o.events.of_kind("stream.pass")
+    # later passes read the shrinking spill generations, not the source
+    spill_reads = [e for e in passes if e.read_from == "spill"]
+    assert spill_reads, "no pass read from the spill store"
+    gens = o.events.of_kind("spill.generation")
+    assert gens and gens[0].keys == n  # the pass-0 tee holds the stream
+    # generation events mirror what the writer committed
+    for g in gens:
+        assert g.nbytes == g.keys * 4
+
+
+def test_event_chunk_device_assignment_round_robin(rng):
+    chunks = [
+        rng.integers(0, 2**31 - 1, size=2048, dtype=np.int32) for _ in range(6)
+    ]
+    n = sum(c.size for c in chunks)
+    o = obs_lib.Observability.collecting()
+    got = int(streaming_kselect(chunks, n // 2, pipeline_depth=2, devices=2, obs=o))
+    assert got == int(_oracle(chunks, n // 2))
+    pass0 = [
+        c for c in o.events.of_kind("stream.chunk") if c.pass_index == 0
+    ]
+    assert [c.chunk_index for c in pass0] == list(range(6))
+    assert [c.device_slot for c in pass0] == [0, 1, 0, 1, 0, 1]
+    assert all(c.staged for c in pass0)
+    assert sum(c.n for c in pass0) == n
+
+
+def test_certificate_event(rng):
+    chunks = _chunks(rng, sizes=(3000, 1024))
+    n = sum(c.size for c in chunks)
+    k = n // 2
+    v = _oracle(chunks, k)
+    o = obs_lib.Observability.collecting()
+    less, leq = streaming_rank_certificate(chunks, v, devices=2, obs=o)
+    assert less < k <= leq
+    (ev,) = o.events.of_kind("certificate.pass")
+    assert (ev.less, ev.leq) == (less, leq)
+    assert ev.keys_read == n
+
+
+def test_resident_and_streaming_quantiles_obs(rng):
+    from mpi_k_selection_tpu import api
+
+    o = obs_lib.Observability.collecting()
+    x = rng.integers(0, 1000, size=50000, dtype=np.int32)
+    got = int(api.kselect(x, 25000, obs=o))
+    assert got == int(np.sort(x)[24999])
+    (ev,) = o.events.of_kind("resident.select")
+    assert ev.algorithm == "radix" and ev.n == 50000
+
+    o2 = obs_lib.Observability.collecting()
+    chunks = _chunks(rng, sizes=(4096, 2048))
+    sq = api.StreamingQuantiles(np.int32, obs=o2)
+    sq.update_stream(chunks)
+    exact = sq.refine_quantiles([0.5], chunks)
+    s = np.sort(np.concatenate(chunks), kind="stable")
+    from mpi_k_selection_tpu.api import quantile_ranks
+
+    (k50,) = quantile_ranks([0.5], sq.n)
+    assert int(exact[0]) == int(s[k50 - 1])
+    assert o2.events.of_kind("sketch.pass")
+    assert o2.events.of_kind("stream.pass")
+
+
+def test_events_as_dict_json_ready(rng):
+    chunks = _chunks(rng, sizes=(2048, 1024))
+    o = obs_lib.Observability.collecting()
+    streaming_kselect(chunks, 17, obs=o)
+    payload = json.dumps([e.as_dict() for e in o.events.events])
+    kinds = {d["event"] for d in json.loads(payload)}
+    assert "stream.pass" in kinds and "stream.chunk" in kinds
+
+
+def test_invariant_checker_catches_violations():
+    ev = obs_lib.StreamPassEvent(
+        pass_index=0, resolved_bits=0, prefixes=(), chunks=1, keys_read=100,
+        bytes_read=400, read_from="source", bucket_total=100, bucket_max=50,
+        bucket_nonzero=3, survivors=(40,),
+    )
+    grown = obs_lib.StreamPassEvent(
+        pass_index=1, resolved_bits=4, prefixes=(3,), chunks=1, keys_read=100,
+        bytes_read=400, read_from="source", bucket_total=40, bucket_max=40,
+        bucket_nonzero=1, survivors=(99,),  # grew past 40: impossible
+    )
+    with pytest.raises(AssertionError, match="grew past"):
+        obs_lib.check_stream_invariants([ev, grown])
+    with pytest.raises(AssertionError, match="no StreamPassEvent"):
+        obs_lib.check_stream_invariants([])
+    reordered = obs_lib.StreamPassEvent(
+        pass_index=0, resolved_bits=8, prefixes=(1,), chunks=1, keys_read=40,
+        bytes_read=160, read_from="source", bucket_total=40, bucket_max=40,
+        bucket_nonzero=1, survivors=(10,),
+    )
+    with pytest.raises(AssertionError, match="strictly increasing"):
+        obs_lib.check_stream_invariants([ev, grown, reordered][::2] + [ev])
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+
+
+def test_metrics_counter_gauge_histogram_basics():
+    reg = obs_lib.MetricsRegistry()
+    c = reg.counter("hits")
+    c.inc()
+    c.inc(4)
+    assert c.value == 5
+    assert reg.counter("hits") is c  # get-or-create identity
+    g = reg.gauge("frac")
+    g.set(0.25)
+    assert g.value == 0.25
+    h = reg.histogram("occ", buckets=(1, 2, 4))
+    for v in (0, 1, 2, 3, 9):
+        h.observe(v)
+    assert h.count == 5 and h.sum == 15 and h.min == 0 and h.max == 9
+    assert h.cumulative() == [2, 3, 4, 5]
+    assert h.mean == 3.0
+    with pytest.raises(TypeError):
+        reg.gauge("hits")  # type conflict on one name
+
+
+def test_metrics_labels_and_prometheus_rendering():
+    reg = obs_lib.MetricsRegistry()
+    reg.counter("ingest.chunks", labels={"device": "0"}).inc(3)
+    reg.counter("ingest.chunks", labels={"device": "1"}).inc(2)
+    reg.gauge("stall.seconds").set(1.5)
+    reg.histogram("occ", buckets=(1, 2)).observe(2)
+    text = reg.render_prometheus()
+    assert '# TYPE ksel_ingest_chunks counter' in text
+    assert 'ksel_ingest_chunks{device="0"} 3' in text
+    assert 'ksel_ingest_chunks{device="1"} 2' in text
+    assert "ksel_stall_seconds 1.5" in text
+    assert 'ksel_occ_bucket{le="2"} 1' in text
+    assert 'ksel_occ_bucket{le="+Inf"} 1' in text
+    assert "ksel_occ_sum 2" in text and "ksel_occ_count 1" in text
+    # JSON exposition is valid and carries the same values
+    snap = json.loads(reg.to_json())
+    assert snap['ingest.chunks{device="0"}']["value"] == 3
+
+
+def test_metrics_thread_safety():
+    reg = obs_lib.MetricsRegistry()
+    c = reg.counter("n")
+    h = reg.histogram("h")
+
+    def work():
+        for _ in range(1000):
+            c.inc()
+            h.observe(1)
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == 8000
+    assert h.count == 8000 and h.sum == 8000
+
+
+def test_collect_runtime_mirrors_sources(rng):
+    pool = StagingPool()
+    a = pool.acquire(1024, np.uint32)
+    pool.release(a)
+    pool.acquire(1024, np.uint32)
+    pool.acquire(512, np.uint32)
+    timer = PhaseTimer()
+    timer.record("pipeline.stall", 0.125)
+    with SpillStore() as store:
+        chunks = _chunks(rng, sizes=(2048, 1024))
+        n = sum(c.size for c in chunks)
+        streaming_kselect(
+            chunks, n // 2, radix_bits=4, collect_budget=32, spill=store
+        )
+        reg = obs_lib.MetricsRegistry()
+        collect_runtime(reg, staging_pool=pool, spill_store=store, timer=timer)
+        log = list(store.pass_log)
+    assert reg.counter("staging_pool.hits").value == pool.hits == 1
+    assert reg.counter("staging_pool.misses").value == pool.misses == 2
+    assert reg.counter("spill.passes").value == len(log)
+    assert reg.counter("spill.bytes_read").value == sum(
+        p["bytes_read"] for p in log
+    )
+    assert reg.counter("spill.keys_written").value == sum(
+        p.get("keys_written", 0) for p in log
+    )
+    assert (
+        reg.gauge("phase.seconds", labels={"phase": "pipeline.stall"}).value
+        == 0.125
+    )
+    # idempotent: a second collection overwrites, not doubles
+    collect_runtime(reg, staging_pool=pool, spill_store=store, timer=timer)
+    assert reg.counter("staging_pool.misses").value == 2
+
+
+def test_occupancy_sampled_on_pipelined_run(rng):
+    chunks = [
+        rng.integers(0, 2**31 - 1, size=2048, dtype=np.int32) for _ in range(6)
+    ]
+    n = sum(c.size for c in chunks)
+    o = obs_lib.Observability.collecting()
+    streaming_kselect(chunks, n // 2, pipeline_depth=2, devices=2, obs=o)
+    occ = o.metrics.histogram("inflight.occupancy")
+    assert occ.count > 0
+    assert 1 <= occ.max <= 2  # window is one slot per ingest device
+
+
+# ---------------------------------------------------------------------------
+# trace recorder + PhaseTimer concurrency (the cross-thread contract)
+
+
+def test_trace_recorder_cross_thread_chrome_export():
+    rec = obs_lib.TraceRecorder()
+    timer = PhaseTimer(recorder=rec)
+
+    def producer():
+        for _ in range(3):
+            with timer.phase("pipeline.produce"):
+                pass
+
+    t = threading.Thread(target=producer, name="ksel-test-producer")
+    with timer.phase("pipeline.stall"):
+        t.start()
+        t.join()
+    assert len(rec.spans) == 4
+    assert len(rec.thread_ids()) == 2
+    trace = json.loads(rec.to_json())
+    xs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    metas = [e for e in trace["traceEvents"] if e["ph"] == "M"]
+    assert len(xs) == 4 and len({e["tid"] for e in xs}) == 2
+    names = {m["args"]["name"] for m in metas}
+    assert "ksel-test-producer" in names
+    assert all(e["ts"] >= 0 and e["dur"] >= 0 for e in xs)
+    # spans nest/overlap on a shared rebased timeline
+    assert min(e["ts"] for e in xs) == 0
+
+
+def test_streaming_trace_shows_producer_and_consumer_tracks(rng):
+    chunks = _chunks(rng, sizes=(4096, 2048, 2048))
+    n = sum(c.size for c in chunks)
+    o = obs_lib.Observability.collecting()
+    streaming_kselect(chunks, n // 2, pipeline_depth=2, obs=o)
+    trace = o.trace.to_chrome_trace()
+    xs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    by_tid = {}
+    for e in xs:
+        by_tid.setdefault(e["tid"], set()).add(e["name"])
+    assert len(by_tid) >= 2  # producer + consumer tracks
+    producer_names = set().union(
+        *(v for v in by_tid.values() if "pipeline.produce" in v)
+    )
+    consumer_names = set().union(
+        *(v for v in by_tid.values() if "descent.pass" in v)
+    )
+    assert "pipeline.encode" in producer_names
+    assert "pipeline.stall" in consumer_names
+
+
+def test_phase_timer_concurrent_accumulation():
+    timer = PhaseTimer()
+    iters, nthreads = 400, 8
+
+    def work():
+        for _ in range(iters):
+            with timer.phase("shared"):
+                pass
+            timer.record("recorded", 0.001)
+
+    threads = [threading.Thread(target=work) for _ in range(nthreads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # no lost updates: counts are exact under contention
+    assert timer.counts["shared"] == iters * nthreads
+    assert timer.counts["recorded"] == iters * nthreads
+    assert timer.phases["recorded"] == pytest.approx(0.001 * iters * nthreads)
+    d = timer.as_dict()
+    assert d["shared"]["calls"] == iters * nthreads
+
+
+def test_phase_timer_nested_phases_and_recorder_threads():
+    rec = obs_lib.TraceRecorder()
+    timer = PhaseTimer(recorder=rec)
+    with timer.phase("outer"):
+        with timer.phase("inner"):
+            pass
+        with timer.phase("inner"):
+            pass
+    assert timer.counts == {"inner": 2, "outer": 1}
+    # nested spans: inner intervals sit inside outer's
+    spans = {(" ".join([s.name]), s.t0, s.t1) for s in rec.spans}
+    outer = next(s for s in rec.spans if s.name == "outer")
+    for s in rec.spans:
+        if s.name == "inner":
+            assert outer.t0 <= s.t0 <= s.t1 <= outer.t1
+    assert len(spans) == 3
+
+
+def test_recorder_detached_from_caller_timer_after_run(rng):
+    """An instrumented call attaches obs.trace to a caller-owned timer
+    only for its own duration: later uninstrumented calls through the
+    same timer must not keep feeding (and growing) the run's recorder."""
+    chunks = _chunks(rng, sizes=(2048, 1024))
+    timer = PhaseTimer()
+    o = obs_lib.Observability.collecting()
+    streaming_kselect(chunks, 17, timer=timer, obs=o)
+    assert timer.recorder is None  # detached on exit
+    n_spans = len(o.trace.spans)
+    assert n_spans > 0
+    streaming_kselect(chunks, 17, timer=timer)  # uninstrumented reuse
+    with timer.phase("later"):
+        pass
+    assert len(o.trace.spans) == n_spans
+    # a recorder the CALLER attached stays put (their wiring, their scope)
+    rec = obs_lib.TraceRecorder()
+    timer2 = PhaseTimer(recorder=rec)
+    streaming_kselect(chunks, 17, timer=timer2, obs=o)
+    assert timer2.recorder is rec
+
+
+def test_observability_off_by_default_and_channels_independent(rng):
+    chunks = _chunks(rng, sizes=(2048,))
+    # metrics-only bundle: no sink, no recorder — nothing crashes
+    o = obs_lib.Observability(metrics=obs_lib.MetricsRegistry())
+    got = int(streaming_kselect(chunks, 17, obs=o))
+    assert got == int(_oracle(chunks, 17))
+    assert o.events is None and o.trace is None
+    assert o.metrics.as_dict()  # something was collected
+    # events-only bundle
+    o2 = obs_lib.Observability(events=obs_lib.ListSink())
+    int(streaming_kselect(chunks, 17, obs=o2))
+    assert len(o2.events) > 0

@@ -224,6 +224,52 @@ def test_pass_log_shrinks_geometrically(rng):
         assert b <= a / (1 << (rb - 1)), (a, b)
 
 
+def test_spill_metrics_and_events_mirror_pass_log(rng):
+    """ISSUE 6 satellite: the obs registry's spill.* counters are sums
+    over the store's OWN pass_log (collected from the same dicts, so
+    exactly equal), and the per-pass events carry identical byte
+    accounting entry for entry."""
+    from mpi_k_selection_tpu.obs import (
+        Observability,
+        check_stream_invariants,
+    )
+
+    x = _ints(rng, 1 << 14)
+    k = x.size // 2
+    o = Observability.collecting()
+    with SpillStore() as store:
+        got = streaming_kselect(
+            _chunks(x, 5), k, radix_bits=4, collect_budget=16, spill=store,
+            obs=o,
+        )
+        assert got == seq.kselect_sort(x, k)
+        log = [dict(e) for e in store.pass_log]
+    reg = o.metrics
+    assert reg.counter("spill.passes").value == len(log)
+    assert reg.counter("spill.bytes_read").value == sum(
+        e["bytes_read"] for e in log
+    )
+    assert reg.counter("spill.keys_read").value == sum(
+        e["keys_read"] for e in log
+    )
+    assert reg.counter("spill.bytes_written").value == sum(
+        e.get("bytes_written", 0) for e in log
+    )
+    assert reg.counter("spill.keys_written").value == sum(
+        e.get("keys_written", 0) for e in log
+    )
+    # entry-for-entry: the event stream's bytes match the pass_log
+    check_stream_invariants(o.events.events, spill_pass_log=log)
+    by_pass = {e["pass"]: e for e in log}
+    for ev in o.events.of_kind("stream.pass"):
+        entry = by_pass[ev.pass_index]
+        assert ev.bytes_read == entry["bytes_read"]
+        assert ev.keys_read == entry["keys_read"]
+        if "bytes_written" in entry:
+            assert ev.bytes_written == entry["bytes_written"]
+            assert ev.keys_written == entry["keys_written"]
+
+
 def test_caller_store_keeps_gen0_for_reuse(rng):
     """A caller-owned store keeps its pass-0 generation: it serves the
     rank certificate, a second descent, and store-as-source — without

@@ -271,6 +271,77 @@ def main() -> int:
         )
         check("spill passes shrink geometrically", shrink_ok, True)
 
+    # --- obs snapshot (ISSUE 6): one instrumented pipelined streaming run
+    # whose record carries the numbers the ROADMAP TPU-validation sweep
+    # needs — in-flight window occupancy, ingest_hidden_frac, per-pass
+    # bytes — so the next TPU run closes that item with data, not just
+    # wall clocks. Sinks on must not change the answer (checked here too).
+    print("streaming obs snapshot (occupancy / hidden-frac / per-pass bytes):")
+    import json as _json
+
+    from mpi_k_selection_tpu import obs as _obs_lib
+    from mpi_k_selection_tpu.streaming import (
+        SpillStore as _ObsSpillStore,
+        streaming_kselect as _obs_ksel,
+    )
+    from mpi_k_selection_tpu.streaming.pipeline import (
+        ingest_hidden_frac as _hidden_frac,
+    )
+    from mpi_k_selection_tpu.utils.profiling import PhaseTimer as _PhaseTimer
+
+    ob_chunks = [
+        np.random.default_rng(500 + i).integers(
+            -(2**31), 2**31 - 1, size=1 << 17, dtype=np.int32
+        )
+        for i in range(8)
+    ]
+    ob_n = sum(c.size for c in ob_chunks)
+    ob_k = ob_n // 2
+    ob_kw = dict(radix_bits=4, collect_budget=512)
+    want_ob = int(_obs_ksel(ob_chunks, ob_k, **ob_kw))
+    o = _obs_lib.Observability.collecting()
+    ob_timer = _PhaseTimer(recorder=o.trace)
+    with _ObsSpillStore() as ob_store:
+        got_ob = int(
+            _obs_ksel(
+                ob_chunks, ob_k, spill=ob_store, pipeline_depth=2,
+                devices=ndev if ndev > 1 else None, timer=ob_timer, obs=o,
+                **ob_kw,
+            )
+        )
+        ob_log = list(ob_store.pass_log)
+    check("obs sinks on bit-identical", got_ob, want_ob)
+    try:
+        _obs_lib.check_stream_invariants(o.events.events, spill_pass_log=ob_log)
+        inv_ok = True
+    except AssertionError as e:  # pragma: no cover - diagnosed via stdout
+        print(f"    invariant failure: {e}")
+        inv_ok = False
+    check("obs event invariants", inv_ok, True)
+    trace_json = o.trace.to_json()
+    parsed = _json.loads(trace_json)
+    check("obs chrome trace parses", bool(parsed["traceEvents"]), True)
+    occ = o.metrics.histogram("inflight.occupancy")
+    hidden_ob = _hidden_frac(ob_timer)
+    snapshot = {
+        "occupancy_mean": round(occ.mean, 3) if occ.count else None,
+        "occupancy_max": occ.max,
+        "ingest_hidden_frac": (
+            round(hidden_ob, 4) if hidden_ob is not None else None
+        ),
+        "bytes_per_pass": [
+            (e.pass_index, e.bytes_read)
+            for e in o.events.of_kind("stream.pass")
+        ],
+        "chunks_per_device": {
+            dict(m.labels).get("device", "?"): m.value
+            for m in o.metrics.metrics()
+            if m.name == "ingest.chunks"
+        },
+        "trace_threads": len(o.trace.thread_ids()),
+    }
+    print(f"  obs snapshot: {snapshot}")
+
     if failures:
         print(f"tpu_smoke: {len(failures)} FAILURES")
         return 1
