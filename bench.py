@@ -714,6 +714,108 @@ def bench_streaming_oc(on_tpu: bool):
     return ok
 
 
+def bench_serve(on_tpu: bool):
+    """Resident-dataset query server (serve/): queries/sec and p50/p99
+    request latency per tier at client concurrency {1, 8, 64}, plus the
+    batch-width histogram snapshot. ``exact_match`` REQUIRES bit-equality
+    between the server's batched/coalesced answers (exact and auto tiers,
+    every concurrency level) and one-at-a-time ``api.kselect`` over the
+    same resident bits; sketch-tier answers must bracket the true value
+    with their exact bounds. Latency here includes the coalescing window
+    (2 ms) — that is the serving trade the batcher makes: a bounded
+    latency add buys one shared-pass walk per concurrent burst."""
+    import threading
+
+    import numpy as np
+
+    from mpi_k_selection_tpu import api
+    from mpi_k_selection_tpu.obs import MetricsRegistry, Observability
+    from mpi_k_selection_tpu.serve import KSelectServer
+    from mpi_k_selection_tpu.utils import datagen
+
+    n = 1 << 24 if on_tpu else 1 << 20
+    x = datagen.generate(n, pattern="uniform", seed=11, dtype=np.int32)
+    queries_per_cell = 192 if on_tpu else 48
+    ks_pool = [1 + (i * 104729) % n for i in range(queries_per_cell)]
+    ref = {k: np.asarray(api.kselect(x, k)).item() for k in sorted(set(ks_pool))}
+    s_host = np.sort(x, kind="stable")
+
+    obs = Observability(metrics=MetricsRegistry())
+    exact = True
+    tiers_out = {}
+    with KSelectServer(window=0.002, obs=obs) as srv:
+        srv.add_dataset("bench", x)
+        srv.kselect("bench", 1, tier="exact")  # warm compile + cache
+        for tier in ("sketch", "exact", "auto"):
+            per_conc = {}
+            for conc in (1, 8, 64):
+                lat: list[float] = []
+                mismatches = []
+                lock = threading.Lock()
+                shards = [ks_pool[i::conc] for i in range(conc)]
+
+                def worker(shard):
+                    mine, bad = [], 0
+                    for k in shard:
+                        t0 = time.perf_counter()
+                        a = srv.kselect("bench", k, tier=tier)
+                        mine.append(time.perf_counter() - t0)
+                        if a.tier == "sketch":
+                            v_lo, v_hi = a.value_bounds
+                            if not v_lo <= s_host[k - 1] <= v_hi:
+                                bad += 1
+                        elif int(a.value) != ref[k]:
+                            bad += 1
+                    with lock:
+                        lat.extend(mine)
+                        if bad:
+                            mismatches.append(bad)
+
+                threads = [
+                    threading.Thread(target=worker, args=(sh,))
+                    for sh in shards
+                    if sh
+                ]
+                t0 = time.perf_counter()
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                wall = time.perf_counter() - t0
+                if mismatches:
+                    exact = False
+                lat.sort()
+                per_conc[str(conc)] = {
+                    "qps": round(len(lat) / max(wall, 1e-9), 1),
+                    "p50_ms": round(lat[len(lat) // 2] * 1e3, 3),
+                    "p99_ms": round(lat[min(len(lat) - 1, (99 * len(lat)) // 100)] * 1e3, 3),
+                }
+            tiers_out[tier] = per_conc
+        width = obs.metrics.histogram("serve.batch_width").as_dict()
+        cache = srv.collect_metrics().as_dict()
+    _emit(
+        {
+            "metric": "serve_kselect_qps",
+            # headline: exact-tier throughput under the widest burst
+            "value": tiers_out["exact"]["64"]["qps"] if exact else 0.0,
+            "unit": "queries/sec",
+            "n": n,
+            "window_s": 0.002,
+            "queries_per_cell": queries_per_cell,
+            "tiers": tiers_out,
+            "batch_width": {
+                key: width.get(key) for key in ("count", "mean", "max")
+            },
+            "program_cache": {
+                "hits": cache["serve.program_cache.hits"]["value"],
+                "misses": cache["serve.program_cache.misses"]["value"],
+            },
+            "exact_match": bool(exact),
+        }
+    )
+    return exact
+
+
 def bench_cgm_native():
     """BASELINE config: CGM/MPI parity backend, 4 ranks, N=16M, k=N/2.
 
@@ -801,6 +903,7 @@ def main() -> int:
         reps=(2, 8) if on_tpu else (1, 3),
     )
     ok &= bench_streaming_oc(on_tpu)
+    ok &= bench_serve(on_tpu)
     ok &= bench_cgm_native()
     ok &= bench_seq_oracle()
     return 0 if ok else 1

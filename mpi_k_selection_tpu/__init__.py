@@ -53,6 +53,7 @@ from mpi_k_selection_tpu.parallel import (
     distributed_topk,
 )
 from mpi_k_selection_tpu.obs import Observability
+from mpi_k_selection_tpu.serve import KSelectServer
 from mpi_k_selection_tpu.streaming import RadixSketch
 
 __all__ = [
@@ -63,6 +64,7 @@ __all__ = [
     "kselect_streaming",
     "StreamingQuantiles",
     "RadixSketch",
+    "KSelectServer",
     "Observability",
     "quantiles",
     "median",

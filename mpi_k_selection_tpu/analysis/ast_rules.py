@@ -1,4 +1,4 @@
-"""AST lint rules (KSL001-KSL009) — each encodes a bug class a human
+"""AST lint rules (KSL001-KSL010) — each encodes a bug class a human
 reviewer caught in this repository at least once. docs/ANALYSIS.md holds
 the catalog with the historical incident behind every rule.
 
@@ -688,3 +688,55 @@ class PrintLoggingTelemetry(Rule):
                     "telemetry channel; loggers here end up emitting "
                     "unstructured text no consumer reads"
                 )
+
+
+# ---------------------------------------------------------------------------
+# KSL010 — per-request compilation in serve/ handler paths
+
+
+@register
+class ServeHandlerCompile(Rule):
+    id = "KSL010"
+    title = "jit/compile-wrapping call in serve/ outside the registry's program cache"
+    rationale = (
+        "The query server answers many small requests over long-lived "
+        "resident datasets; a `jax.jit`/`pjit`/`shard_map` wrap (or a "
+        "`functools.partial(jax.jit, ...)` factory) sitting on a handler "
+        "path builds a FRESH wrapped callable per request, so every "
+        "request re-traces and the compile cache never hits — the classic "
+        "accidental-recompile latency cliff, invisible in tests that "
+        "issue one query. All compile-bearing callables under serve/ are "
+        "built ONCE in serve/registry.py and reused through its keyed "
+        "ProgramCache (hit/miss counters exported as "
+        "`serve.program_cache.*`); handler code (server, batcher, tiers, "
+        "http) dispatches through cached programs only."
+    )
+
+    _SANCTIONED = ("serve/registry.py",)
+
+    def check_module(self, mod: SourceModule):
+        p = pathlib.Path(mod.path).resolve().as_posix()
+        if "/serve/" not in p or _is_test_file(mod):
+            return
+        if _path_endswith(mod, *self._SANCTIONED):
+            return
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) and _is_trace_wrapper_call(node):
+                yield node.lineno, (
+                    f"`{dotted_name(node.func) or '<wrapper>'}` builds a "
+                    "compile-bearing callable on a serve/ handler path — "
+                    "every request re-traces; build it once in "
+                    "serve/registry.py and dispatch through the keyed "
+                    "ProgramCache"
+                )
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # bare `@jax.jit` decorators (the Call branch above
+                # already reports the `@partial(jax.jit, ...)` form)
+                for dec in node.decorator_list:
+                    if dotted_name(dec) in _TRACE_WRAPPERS:
+                        yield node.lineno, (
+                            f"`@{dotted_name(dec)}` on `{node.name}` in "
+                            "serve/ — compiled programs belong in "
+                            "serve/registry.py's ProgramCache, not on "
+                            "handler paths"
+                        )

@@ -142,7 +142,7 @@ def kselect(x, k, *, algorithm: str = "auto", obs=None, **kwargs):
     raise ValueError(f"unknown algorithm {algorithm!r}; choose from {ALGORITHMS}")
 
 
-def kselect_many(x, ks, **kwargs):
+def kselect_many(x, ks, *, obs=None, **kwargs):
     """Exact k-th smallest for every (1-indexed) k in ``ks`` over one array.
 
     Amortized multi-rank selection (the p50/p90/p99 telemetry shape): the
@@ -150,6 +150,12 @@ def kselect_many(x, ks, **kwargs):
     across all queries (ops/radix.py:radix_select_many); small inputs sort
     once and gather. Returns answers in ``ks`` order, with ``ks``'s shape
     (a scalar k returns a scalar, matching :func:`kselect`).
+
+    ``obs`` records the resolved dispatch (sort vs shared radix walk,
+    query count) as one ``resident.select`` event, exactly like
+    :func:`kselect`'s — the query server's batcher coalesces many client
+    requests into one call here, and the event stream is how a coalesced
+    walk stays attributable.
     """
     x = as_selection_array(x)
     if x.size == 0:
@@ -167,7 +173,19 @@ def kselect_many(x, ks, **kwargs):
     # lax.sort of the whole array costs ~c2*n*log n, so the crossover
     # grows with log2(n) — 82/110/134 queries measured at n=2^24/27/28.
     sort_at = many_sort_dispatch_queries(x.size)
-    if x.size <= 1 << 14 or n_queries >= sort_at:
+    use_sort = x.size <= 1 << 14 or n_queries >= sort_at
+    if obs is not None:
+        from mpi_k_selection_tpu.obs.events import ResidentSelectEvent
+
+        obs.emit(
+            ResidentSelectEvent(
+                n=int(x.size),
+                queries=n_queries,
+                algorithm="sort-many" if use_sort else "radix-many",
+                dtype=str(np.dtype(x.dtype)),
+            )
+        )
+    if use_sort:
         def warn_kwargs_ignored():
             # only the sort branches drop kwargs; the host-f64 traced-ks
             # branch below routes back to radix where they are honored

@@ -20,6 +20,10 @@ Examples::
 
     # top-k mode (MoE-router config from BASELINE.md)
     kselect --backend tpu --gen normal --dtype float32 --n 67108864 --topk 128
+
+    # resident-dataset query server: load once, answer many clients
+    # (POST /v1/query, GET /metrics; see docs/API.md "Serving")
+    kselect serve --n 100000000 --dtype int32 --port 8080
 """
 
 from __future__ import annotations
@@ -510,6 +514,136 @@ def _device_count(args) -> int:
     return min(n, args.devices) if args.devices else n
 
 
+def build_serve_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="kselect serve",
+        description=(
+            "resident-dataset query server: load/shard a dataset once, "
+            "answer kselect/quantile/top-k/rank-certificate queries from "
+            "many concurrent clients (POST /v1/query, GET /v1/datasets, "
+            "GET /metrics, GET /healthz)"
+        ),
+    )
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument(
+        "--port", type=int, default=8080,
+        help="listen port (0 = ephemeral; see --port-file)",
+    )
+    p.add_argument(
+        "--port-file", default=None, metavar="PATH",
+        help="write the bound port here after listen (for --port 0 callers)",
+    )
+    p.add_argument(
+        "--dataset-id", default="default",
+        help="id the generated dataset registers under",
+    )
+    p.add_argument("--n", type=int, default=1 << 20, help="dataset elements")
+    p.add_argument("--gen", choices=datagen.PATTERNS, default="uniform")
+    p.add_argument("--dtype", choices=DTYPES, default="int32")
+    p.add_argument("--seed", type=int, default=config.DEFAULT_SEED)
+    p.add_argument(
+        "--streaming", action="store_true",
+        help="register the dataset as an out-of-core stream (sketched "
+        "once at startup; exact-tier queries replay the generated chunk "
+        "source) instead of a resident array",
+    )
+    p.add_argument(
+        "--chunk-elems", type=int, default=1 << 22,
+        help="chunk size (elements) for --streaming",
+    )
+    p.add_argument(
+        "--no-sketch", action="store_true",
+        help="skip the resident sketch (disables the sketch/auto fast "
+        "tiers; every query runs exact)",
+    )
+    p.add_argument("--sketch-bits", type=int, default=4)
+    p.add_argument("--sketch-levels", type=int, default=4)
+    p.add_argument(
+        "--batch-window", type=float, default=0.002, metavar="SECONDS",
+        help="cross-request coalescing window: after a query arrives the "
+        "dispatch thread waits this long for more against the same "
+        "dataset and answers them with ONE shared-pass walk (0 = no "
+        "coalescing; answers bit-identical either way)",
+    )
+    p.add_argument(
+        "--max-batch", type=int, default=1024,
+        help="coalesced-request ceiling per dispatch",
+    )
+    p.add_argument(
+        "--quit-after", type=int, default=None, metavar="N",
+        help="serve N HTTP requests, then exit cleanly (smoke/testing; "
+        "default: serve until interrupted)",
+    )
+    return p
+
+
+def serve_main(argv=None) -> int:
+    """``kselect serve ...`` — build the server, register the generated
+    dataset, run the HTTP front on THIS thread until interrupted (or
+    ``--quit-after`` requests), then tear everything down: HTTP request
+    threads joined, dispatch thread joined, exit 0."""
+    args = build_serve_parser().parse_args(argv)
+    from mpi_k_selection_tpu import obs as obs_lib
+    from mpi_k_selection_tpu.serve import KSelectHTTPServer, KSelectServer
+
+    x64_needed = args.dtype in ("int64", "float64")
+    obs = obs_lib.Observability(metrics=obs_lib.MetricsRegistry())
+    with maybe_x64(x64_needed):
+        server = KSelectServer(
+            window=args.batch_window, max_batch=args.max_batch, obs=obs
+        )
+        try:
+            if args.streaming:
+                if args.chunk_elems < 1:
+                    raise SystemExit("error: --chunk-elems must be >= 1")
+                server.add_dataset(
+                    args.dataset_id,
+                    source=_chunk_source(args),
+                    sketch=not args.no_sketch,
+                    sketch_bits=args.sketch_bits,
+                    sketch_levels=args.sketch_levels,
+                )
+            else:
+                x = datagen.generate(
+                    args.n, pattern=args.gen, seed=args.seed, dtype=args.dtype
+                )
+                server.add_dataset(
+                    args.dataset_id,
+                    x,
+                    sketch=not args.no_sketch,
+                    sketch_bits=args.sketch_bits,
+                    sketch_levels=args.sketch_levels,
+                )
+            httpd = KSelectHTTPServer((args.host, args.port), server)
+            try:
+                if args.port_file:
+                    with open(args.port_file, "w") as f:
+                        f.write(str(httpd.port))
+                ds = server.list_datasets()[0]
+                print(
+                    f"serving dataset {args.dataset_id!r} "
+                    f"(n={ds['n']}, dtype={ds['dtype']}, "
+                    f"residency={ds['residency']}, sketch={ds['sketch']}) "
+                    f"on http://{args.host}:{httpd.port} — POST /v1/query, "
+                    "GET /v1/datasets, GET /metrics, GET /healthz",
+                    flush=True,
+                )
+                if args.quit_after is not None:
+                    for _ in range(args.quit_after):
+                        httpd.handle_request()
+                else:
+                    httpd.serve_forever(poll_interval=0.2)
+            except KeyboardInterrupt:
+                pass
+            finally:
+                httpd.server_close()
+        except (ValueError, RuntimeError) as e:
+            raise SystemExit(f"error: {e}") from e
+        finally:
+            server.close()
+    return 0
+
+
 def main(argv=None) -> int:
     # Honor JAX_PLATFORMS even on hosts whose site customization pins
     # jax_platforms at interpreter startup (config wins over the env var):
@@ -534,6 +668,11 @@ def main(argv=None) -> int:
                 file=sys.stderr,
             )
 
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "serve":
+        # subcommand: the long-lived query server (serve/), its own parser
+        return serve_main(argv[1:])
     args = build_parser().parse_args(argv)
     if args.batch and args.topk is None:
         raise SystemExit("error: --batch only applies to --topk mode")

@@ -44,6 +44,8 @@ from mpi_k_selection_tpu.obs.events import (
     ListSink,
     ObsEvent,
     ResidentSelectEvent,
+    ServeBatchEvent,
+    ServeQueryEvent,
     SketchPassEvent,
     SpillGenerationEvent,
     StreamPassEvent,
@@ -72,6 +74,8 @@ __all__ = [
     "Observability",
     "ObsEvent",
     "ResidentSelectEvent",
+    "ServeBatchEvent",
+    "ServeQueryEvent",
     "SketchPassEvent",
     "Span",
     "SpillGenerationEvent",

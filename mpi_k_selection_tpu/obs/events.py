@@ -161,6 +161,37 @@ class DistributedSelectEvent(ObsEvent):
     dtype: str
 
 
+@dataclasses.dataclass(frozen=True)
+class ServeQueryEvent(ObsEvent):
+    """One client request answered by the query server (serve/server.py):
+    which dataset and op, the tier requested vs the tier that answered
+    (``tier_requested`` is None for non-tiered ops), how many rank
+    queries the request carried, and whether auto escalated it from
+    sketch to exact."""
+
+    kind: ClassVar[str] = "serve.query"
+
+    dataset: str
+    op: str  # kselect | quantiles | topk | rank_certificate
+    tier_requested: str | None
+    tier_answered: str
+    queries: int
+    escalated: bool
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeBatchEvent(ObsEvent):
+    """One coalesced dispatch of the query server's batcher: how many
+    client requests rode the shared-pass walk and the total rank-query
+    width they coalesced into."""
+
+    kind: ClassVar[str] = "serve.batch"
+
+    dataset: str
+    requests: int
+    width: int
+
+
 class EventSink:
     """Sink protocol: ``emit`` receives every event. Implementations must
     be thread-safe — the pipelined descent emits from both the producer

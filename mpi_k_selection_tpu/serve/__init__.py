@@ -1,0 +1,68 @@
+"""Resident-dataset query server: shard once, answer many.
+
+The serving layer the north star adds on top of the reproduction
+(PAPER.md's L3 gap: the reference has no driver/service layer — every
+parameter is a compile-time constant). One long-lived process loads (or
+streams/sketches) each dataset once and answers kselect / quantile /
+top-k / rank-certificate queries from many concurrent clients:
+
+- **registry** (serve/registry.py) — immutable resident shards keyed by
+  dataset id + the ``StagingPool``-style keyed program cache (compiled
+  walk closures, cached sorts) so repeat query shapes never recompile;
+- **batcher** (serve/batcher.py) — one dispatch thread with a bounded
+  coalescing window turns concurrent rank queries into one shared-pass
+  ``kselect_many`` walk, bit-identical to serial execution;
+- **tiers** (serve/tiers.py) — ``sketch`` (instant, exact error bounds
+  attached), ``exact`` (the real descent), ``auto`` (sketch when it
+  already pins the answer, escalate otherwise);
+- **http** (serve/http.py) — stdlib JSON-over-HTTP front +
+  ``/metrics`` Prometheus exposition; CLI: ``python -m
+  mpi_k_selection_tpu serve ...``.
+
+Docs: docs/API.md "Serving"; metric catalog: docs/OBSERVABILITY.md.
+"""
+
+from __future__ import annotations
+
+from mpi_k_selection_tpu.serve.batcher import (
+    PendingQuery,
+    QueryBatcher,
+    SERVE_THREAD_PREFIX,
+)
+from mpi_k_selection_tpu.serve.errors import (
+    DatasetExistsError,
+    DatasetNotFoundError,
+    QueryError,
+    ServeError,
+    ServerClosedError,
+)
+from mpi_k_selection_tpu.serve.http import (
+    KSelectHTTPServer,
+    start_http_server,
+)
+from mpi_k_selection_tpu.serve.registry import (
+    DatasetRegistry,
+    ProgramCache,
+    ResidentDataset,
+)
+from mpi_k_selection_tpu.serve.server import KSelectServer
+from mpi_k_selection_tpu.serve.tiers import TIERS, RankAnswer
+
+__all__ = [
+    "DatasetExistsError",
+    "DatasetNotFoundError",
+    "DatasetRegistry",
+    "KSelectHTTPServer",
+    "KSelectServer",
+    "PendingQuery",
+    "ProgramCache",
+    "QueryBatcher",
+    "QueryError",
+    "RankAnswer",
+    "ResidentDataset",
+    "SERVE_THREAD_PREFIX",
+    "ServeError",
+    "ServerClosedError",
+    "TIERS",
+    "start_http_server",
+]

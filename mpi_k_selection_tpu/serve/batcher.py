@@ -1,0 +1,219 @@
+"""Cross-request batcher — one dispatch thread, bounded coalescing window.
+
+Many clients issue small rank queries against the same resident dataset;
+the backend's cheapest shape for that is ONE shared-pass
+``kselect_many`` walk (ops/radix.py shares the prepared key view and
+every histogram pass across all ranks, and
+``api.many_sort_dispatch_queries`` already says when a wide-enough batch
+should flip to one sort). This module turns concurrent arrivals into
+that shape:
+
+- **One dispatch thread** (``ksel-serve-dispatch-*``) owns ALL device
+  work. Requests enqueue and block on a per-request event; the thread
+  drains the queue, coalesces, executes, and wakes them. Serializing
+  device work on one thread is what makes concurrent answers
+  bit-identical to serial execution: there is no interleaving to vary.
+- **Bounded coalescing window**: when the first request of a batch
+  arrives the thread waits at most ``window`` seconds (a plain
+  ``Event.wait`` — KSL004: no raw clock reads here) for more to arrive,
+  then drains up to ``max_batch`` pending requests. ``window=0`` is the
+  no-coalescing extreme (every request dispatches alone — the latency
+  floor); a large window is the full-coalescing extreme (every
+  concurrent request rides one walk — the throughput ceiling). Answers
+  are bit-identical at every window because exact order statistics do
+  not depend on which batch computed them.
+- **Grouping**: drained requests coalesce only within (dataset, kind) —
+  rank queries (kselect/quantiles, already rank-converted by the
+  server) against the same dataset merge their ks into one
+  ``select_many`` call; non-rank ops (topk, rank certificates) execute
+  one at a time, still on the dispatch thread. Arrival order is
+  preserved within and across groups.
+
+The thread is joined on ``close()`` on every exit path — the conftest
+leaked-thread fixture enforces the same discipline as for
+``ksel-pipeline-*`` producers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import queue
+import threading
+
+from mpi_k_selection_tpu.serve.errors import ServerClosedError
+
+#: Every serving-layer thread (dispatch, HTTP serve loop, HTTP request
+#: handlers) carries this prefix; tests assert none outlives its server.
+SERVE_THREAD_PREFIX = "ksel-serve"
+
+#: Coalescing-window ceiling (seconds) — a minute-long window is a
+#: misconfiguration, not a batching strategy.
+MAX_WINDOW = 60.0
+
+#: Queue-drain ceiling per dispatch round.
+DEFAULT_MAX_BATCH = 1024
+
+
+@dataclasses.dataclass
+class PendingQuery:
+    """One enqueued request. ``kind`` is ``"rank"`` (ks carries the
+    1-indexed ranks) or an op name executed singly. ``ds`` is the
+    RESOLVED ResidentDataset the request validated against — carried by
+    object so a concurrent drop+re-add of the same id cannot swap the
+    data (and its n) out from under an in-flight request. ``run`` is the
+    server-provided executor for non-rank ops. The dispatch thread fills
+    exactly one of ``result``/``error`` and sets ``done``."""
+
+    dataset_id: str
+    kind: str
+    ks: tuple = ()
+    ds: object = None
+    run: object = None
+    done: threading.Event = dataclasses.field(default_factory=threading.Event)
+    result: object = None
+    error: BaseException | None = None
+
+    def wait(self):
+        """Block until dispatched; re-raise the dispatch error here (on
+        the REQUEST thread) or return the result."""
+        self.done.wait()
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+
+def validate_window(window) -> float:
+    w = float(window)
+    if not 0.0 <= w <= MAX_WINDOW:
+        raise ValueError(f"window={w} out of range [0, {MAX_WINDOW}] seconds")
+    return w
+
+
+class QueryBatcher:
+    """The dispatch thread + queue. ``execute_ranks(items)``
+    (server-provided) runs one coalesced rank group — all items share
+    one resolved dataset object — and must fill every item's
+    ``result``; ``observe`` hooks (queue depth at submit, batch width
+    at dispatch) are optional metrics callbacks."""
+
+    _ids = itertools.count()
+
+    def __init__(
+        self,
+        execute_ranks,
+        *,
+        window: float = 0.0,
+        max_batch: int = DEFAULT_MAX_BATCH,
+        observe_depth=None,
+        observe_width=None,
+    ):
+        self._execute_ranks = execute_ranks
+        self.window = validate_window(window)
+        self.max_batch = max(1, int(max_batch))
+        self._observe_depth = observe_depth
+        self._observe_width = observe_width
+        self._q: queue.Queue = queue.Queue()
+        # serializes submit's check+put against close's final drain, so a
+        # submit racing close() either raises or its item is seen by the
+        # drain — a queued request can never be left waiting forever
+        self._submit_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run,
+            name=f"{SERVE_THREAD_PREFIX}-dispatch-{next(self._ids)}",
+            daemon=True,
+        )
+        self._thread.start()
+
+    # -- request side ------------------------------------------------------
+
+    def submit(self, item: PendingQuery) -> PendingQuery:
+        with self._submit_lock:
+            if self._stop.is_set():
+                raise ServerClosedError("server is closed; query rejected")
+            if self._observe_depth is not None:
+                self._observe_depth(self._q.qsize())
+            self._q.put(item)
+        return item
+
+    # -- dispatch thread ---------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            try:
+                first = self._q.get(timeout=0.05)
+            except queue.Empty:
+                if self._stop.is_set():
+                    return
+                continue
+            batch = [first]
+            if self.window > 0.0:
+                # bounded coalescing: wait once for concurrent arrivals
+                # (Event.wait honors close() immediately), then drain
+                self._stop.wait(self.window)
+                while len(batch) < self.max_batch:
+                    try:
+                        batch.append(self._q.get_nowait())
+                    except queue.Empty:
+                        break
+            self._dispatch(batch)
+            if self._stop.is_set() and self._q.empty():
+                return
+
+    def _dispatch(self, batch) -> None:
+        """Group a drained batch by (dataset, kind) preserving arrival
+        order, execute each group, and wake every request exactly once."""
+        groups: dict = {}
+        order = []
+        for item in batch:
+            # identity includes the dataset OBJECT: two requests that
+            # resolved the same id across a drop+re-add must not share
+            # one walk over whichever dataset happens to be current
+            key = (item.dataset_id, item.kind, id(item.ds))
+            if key not in groups:
+                groups[key] = []
+                order.append(key)
+            groups[key].append(item)
+        for key in order:
+            kind = key[1]
+            items = groups[key]
+            try:
+                if kind == "rank":
+                    if self._observe_width is not None:
+                        self._observe_width(sum(len(i.ks) for i in items))
+                    self._execute_ranks(items)
+                else:
+                    for item in items:
+                        item.result = item.run()
+            except BaseException as e:
+                for item in items:
+                    if item.result is None:
+                        item.error = e
+            finally:
+                for item in items:
+                    item.done.set()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self) -> None:
+        """Stop accepting queries, let the dispatch thread finish what is
+        queued, join it, and fail anything still pending (a request that
+        raced the close) with :class:`ServerClosedError` so no client
+        thread blocks forever. Idempotent."""
+        self._stop.set()
+        self._thread.join(timeout=30.0)
+        # drain under the submit lock: any submit that won the race into
+        # the queue is failed here; any submit after sees the stop flag
+        with self._submit_lock:
+            while True:
+                try:
+                    item = self._q.get_nowait()
+                except queue.Empty:
+                    break
+                item.error = ServerClosedError("server closed before dispatch")
+                item.done.set()
+
+    @property
+    def closed(self) -> bool:
+        return self._stop.is_set()

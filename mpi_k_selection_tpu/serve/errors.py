@@ -1,0 +1,36 @@
+"""Typed errors of the resident-dataset query server (serve/).
+
+The serving layer fronts many concurrent clients, so its failures must be
+distinguishable without string matching: the HTTP front maps each class to
+a status code (registry misses are 404s, malformed queries 400s, a closed
+server 503) and the in-process API lets callers catch exactly the case
+they can handle. All inherit :class:`ServeError` so "anything the server
+raised" is one except clause.
+"""
+
+from __future__ import annotations
+
+
+class ServeError(RuntimeError):
+    """Base class for every serving-layer error."""
+
+
+class DatasetNotFoundError(ServeError):
+    """No dataset registered under the requested id (HTTP 404)."""
+
+
+class DatasetExistsError(ServeError):
+    """A dataset id was registered twice. Resident shards are immutable —
+    replacing data under a live id would race in-flight queries; drop the
+    id first, then add the new data."""
+
+
+class QueryError(ServeError, ValueError):
+    """A malformed or unanswerable query: unknown tier/op, out-of-range
+    rank or quantile, a sketch tier against a dataset with no resident
+    sketch, top-k against a stream-resident dataset (HTTP 400)."""
+
+
+class ServerClosedError(ServeError):
+    """The server (or its dispatch thread) has been closed; no further
+    queries are accepted and queued ones are failed with this (HTTP 503)."""

@@ -1,0 +1,286 @@
+"""HTTP front for the query server — stdlib only, JSON request/response.
+
+A thin shell over :class:`~mpi_k_selection_tpu.serve.server.
+KSelectServer`: the HTTP layer parses/serializes and maps typed errors
+to status codes; every answer comes from the same in-process API, so
+the determinism and bound contracts are identical over the wire.
+
+Endpoints:
+
+- ``POST /v1/query`` — body ``{"dataset": id, "op":
+  "kselect"|"quantiles"|"topk"|"rank_certificate", ...}`` with
+  ``k``/``ks`` (kselect), ``qs`` (quantiles), ``k``+``largest`` (topk),
+  ``value`` (rank_certificate), and optional ``tier``
+  (sketch|exact|auto, default auto). Response: ``{"answers": [...]}``
+  for rank ops (each answer per ``RankAnswer.as_dict`` — sketch-tier
+  entries always carry ``rank_bounds``/``value_bounds``/
+  ``rank_error_bound``), ``{"values": [...], "indices": [...]}`` for
+  topk, ``{"less": L, "leq": E}`` for certificates.
+- ``GET /v1/datasets`` — registered-dataset listing.
+- ``GET /metrics`` — Prometheus text exposition of the server metric
+  namespace (the ``--metrics-json`` registry, rendered live).
+- ``GET /healthz`` — liveness + dataset count.
+
+Threading: ``ThreadingHTTPServer`` with NAMED request threads
+(``ksel-serve-req-*``) tracked and joined on ``server_close()`` — the
+same no-thread-outlives-its-owner discipline as the pipeline producers
+(conftest-enforced). ``start_http_server`` runs the accept loop on a
+``ksel-serve-http-*`` thread and returns a handle whose ``close()``
+shuts down, closes, and joins everything; the CLI ``serve`` mode runs
+the loop on the main thread instead.
+
+Error mapping: :class:`DatasetNotFoundError` -> 404,
+:class:`QueryError`/``ValueError`` -> 400, :class:`ServerClosedError`
+-> 503, anything else -> 500 (message included — this is an internal
+service, not a hardened edge).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from mpi_k_selection_tpu.serve.batcher import SERVE_THREAD_PREFIX
+from mpi_k_selection_tpu.serve.errors import (
+    DatasetNotFoundError,
+    QueryError,
+    ServerClosedError,
+)
+
+#: Request-body ceiling: queries are tiny JSON; a megabyte is a client bug.
+MAX_BODY_BYTES = 1 << 20
+
+
+def _jsonable(v):
+    item = getattr(v, "item", None)
+    return item() if item is not None else v
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "ksel-serve"
+    protocol_version = "HTTP/1.1"
+
+    # silence the default stderr access log: the obs registry (queue
+    # depth, per-tier counters/latency) is this subsystem's telemetry
+    # channel, and stray writes would interleave with CLI output
+    def log_message(self, format, *args):  # noqa: A002 - stdlib signature
+        pass
+
+    @property
+    def kserver(self):
+        return self.server.kserver
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _send(self, code: int, payload, *, content_type="application/json"):
+        body = (
+            payload
+            if isinstance(payload, (bytes, bytearray))
+            else json.dumps(payload).encode()
+        )
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_error_json(self, code: int, message: str):
+        self._send(code, {"error": message})
+
+    def _read_json(self):
+        length = int(self.headers.get("Content-Length", 0) or 0)
+        if length > MAX_BODY_BYTES:
+            # the unread body would desync this HTTP/1.1 keep-alive
+            # connection (the next parse would read body bytes as a
+            # request line) — drop the connection after the error
+            self.close_connection = True
+            raise QueryError(f"request body exceeds {MAX_BODY_BYTES} bytes")
+        raw = self.rfile.read(length) if length else b""
+        if not raw:
+            raise QueryError("empty request body; send a JSON query")
+        try:
+            return json.loads(raw)
+        except json.JSONDecodeError as e:
+            raise QueryError(f"bad JSON body: {e}") from e
+
+    def _guarded(self, fn):
+        try:
+            fn()
+        except DatasetNotFoundError as e:
+            self._send_error_json(404, str(e))
+        except (QueryError, ValueError, TypeError) as e:
+            self._send_error_json(400, str(e))
+        except ServerClosedError as e:
+            self._send_error_json(503, str(e))
+        except Exception as e:  # internal service: surface, don't hide
+            self._send_error_json(500, f"{type(e).__name__}: {e}")
+
+    # -- routes ------------------------------------------------------------
+
+    def do_GET(self):
+        self._guarded(self._get)
+
+    def _get(self):
+        if self.path == "/healthz":
+            self._send(
+                200,
+                {"status": "ok", "datasets": len(self.kserver.registry)},
+            )
+        elif self.path == "/v1/datasets":
+            self._send(200, {"datasets": self.kserver.list_datasets()})
+        elif self.path == "/metrics":
+            self._send(
+                200,
+                self.kserver.render_prometheus().encode(),
+                content_type="text/plain; version=0.0.4; charset=utf-8",
+            )
+        else:
+            self._send_error_json(404, f"unknown path {self.path!r}")
+
+    def do_POST(self):
+        self._guarded(self._post)
+
+    def _post(self):
+        if self.path != "/v1/query":
+            self._send_error_json(404, f"unknown path {self.path!r}")
+            return
+        req = self._read_json()
+        dataset = req.get("dataset")
+        if not isinstance(dataset, str):
+            raise QueryError("query needs a string 'dataset' id")
+        op = req.get("op", "kselect")
+        tier = req.get("tier", "auto")
+        srv = self.kserver
+        if op == "kselect":
+            ks = req["ks"] if "ks" in req else [req["k"]] if "k" in req else None
+            if ks is None:
+                raise QueryError("kselect needs 'k' or 'ks'")
+            answers = srv.kselect_many(dataset, ks, tier=tier)
+            self._send(
+                200,
+                {
+                    "dataset": dataset,
+                    "op": op,
+                    "answers": [a.as_dict() for a in answers],
+                },
+            )
+        elif op == "quantiles":
+            if "qs" not in req:
+                raise QueryError("quantiles needs 'qs'")
+            answers = srv.quantiles(dataset, req["qs"], tier=tier)
+            self._send(
+                200,
+                {
+                    "dataset": dataset,
+                    "op": op,
+                    "answers": [a.as_dict() for a in answers],
+                },
+            )
+        elif op == "topk":
+            if "k" not in req:
+                raise QueryError("topk needs 'k'")
+            values, indices = srv.topk(
+                dataset, int(req["k"]), largest=bool(req.get("largest", True))
+            )
+            self._send(
+                200,
+                {
+                    "dataset": dataset,
+                    "op": op,
+                    "values": [_jsonable(v) for v in values],
+                    "indices": [int(i) for i in indices],
+                },
+            )
+        elif op == "rank_certificate":
+            if "value" not in req:
+                raise QueryError("rank_certificate needs 'value'")
+            less, leq = srv.rank_certificate(dataset, req["value"])
+            self._send(
+                200,
+                {"dataset": dataset, "op": op, "less": int(less), "leq": int(leq)},
+            )
+        else:
+            raise QueryError(
+                f"unknown op {op!r}; choose from "
+                "('kselect', 'quantiles', 'topk', 'rank_certificate')"
+            )
+
+
+class KSelectHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer with named, tracked, joined request threads."""
+
+    daemon_threads = False
+    allow_reuse_address = True
+
+    _ids = itertools.count()
+
+    def __init__(self, address, kserver):
+        super().__init__(address, _Handler)
+        self.kserver = kserver
+        self._req_lock = threading.Lock()
+        self._req_threads: list[threading.Thread] = []
+        self._serve_thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self.server_address[1]
+
+    def process_request(self, request, client_address):
+        """Per-request thread with the serve prefix, tracked for the
+        join in :meth:`server_close` (the stdlib mixin's anonymous
+        ``Thread-N`` workers would dodge the leaked-thread fixture)."""
+        t = threading.Thread(
+            target=self.process_request_thread,
+            args=(request, client_address),
+            name=f"{SERVE_THREAD_PREFIX}-req-{next(self._ids)}",
+            daemon=False,
+        )
+        with self._req_lock:
+            self._req_threads = [x for x in self._req_threads if x.is_alive()]
+            self._req_threads.append(t)
+        t.start()
+
+    def server_close(self):
+        super().server_close()
+        with self._req_lock:
+            threads, self._req_threads = self._req_threads, []
+        for t in threads:
+            t.join(timeout=10.0)
+
+    def close(self):
+        """Full shutdown: stop the accept loop, close the socket, join
+        request threads and the serve-loop thread (when
+        :func:`start_http_server` started one). Does NOT close the
+        underlying KSelectServer — the caller owns it."""
+        self.shutdown()
+        self.server_close()
+        if self._serve_thread is not None:
+            self._serve_thread.join(timeout=10.0)
+            self._serve_thread = None
+
+    def __enter__(self) -> "KSelectHTTPServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def start_http_server(
+    kserver, *, host: str = "127.0.0.1", port: int = 0
+) -> KSelectHTTPServer:
+    """Bind and serve in the background (accept loop on a
+    ``ksel-serve-http-*`` thread). ``port=0`` binds an ephemeral port —
+    read it off ``handle.port``. ``handle.close()`` tears everything
+    down; the caller still owns ``kserver.close()``."""
+    httpd = KSelectHTTPServer((host, port), kserver)
+    t = threading.Thread(
+        target=httpd.serve_forever,
+        kwargs={"poll_interval": 0.05},
+        name=f"{SERVE_THREAD_PREFIX}-http-{next(KSelectHTTPServer._ids)}",
+        daemon=True,
+    )
+    httpd._serve_thread = t
+    t.start()
+    return httpd

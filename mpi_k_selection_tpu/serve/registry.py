@@ -1,0 +1,456 @@
+"""Dataset registry + keyed program cache — the resident state of the
+query server.
+
+The reference has no driver layer at all (every parameter is a
+compile-time constant; PAPER.md's L3 gap), so "load the data once, answer
+many queries" is exactly the state this module owns:
+
+- :class:`ResidentDataset` — one registered dataset: an immutable
+  resident representation (device array, host array for the exact
+  f64-on-TPU route, or a replayable chunk source for out-of-core data)
+  plus an optional resident :class:`~mpi_k_selection_tpu.streaming.
+  sketch.RadixSketch` that serves the sketch/auto latency tiers.
+- :class:`DatasetRegistry` — the id -> dataset map (one lock, copy-on-read
+  listings) and the ONE selection dispatch the server's dispatch thread
+  calls (:meth:`DatasetRegistry.select_many`). Residency is decided here,
+  once, at registration: caller-typed 64-bit integers without x64 and
+  host float64 on TPU both take the host-exact routes the library already
+  guarantees — the server never silently truncates what the library
+  would not.
+- :class:`ProgramCache` — a ``StagingPool``-style keyed cache (hits /
+  misses counters, LRU eviction) for compiled selection programs and
+  descent state: the per-(dataset, query-count) shared-walk callables and
+  the dataset's cached full sort (the "descent state" the sort path
+  reuses — one ``jnp.sort`` serves every later sort-path batch as a pure
+  gather). KSL010 enforces the other direction: ``serve/`` handler code
+  must not wrap anything in ``jax.jit`` itself — every compile-bearing
+  callable is built here and cached by key, so repeat query shapes never
+  recompile.
+
+Concurrency discipline: datasets are immutable once registered (host
+arrays are defensively copied and marked read-only; device arrays are
+immutable by construction), the registry dict is guarded by one lock, and
+all device work runs on the server's single dispatch thread
+(serve/batcher.py) — the registry itself never starts a thread.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+
+import numpy as np
+
+from mpi_k_selection_tpu.serve.errors import (
+    DatasetExistsError,
+    DatasetNotFoundError,
+    QueryError,
+)
+
+#: Default resident-sketch geometry (matches RadixSketch defaults).
+DEFAULT_SKETCH_BITS = 4
+DEFAULT_SKETCH_LEVELS = 4
+
+
+class ProgramCache:
+    """Keyed LRU cache for compiled programs / descent state, with the
+    exact hit/miss counter discipline of
+    :class:`~mpi_k_selection_tpu.streaming.pipeline.StagingPool` (plain
+    ints under the lock, mirrored into the obs registry by the server so
+    tests can assert them EQUAL)."""
+
+    def __init__(self, *, max_entries: int = 64):
+        self._lock = threading.Lock()
+        self._entries: collections.OrderedDict = collections.OrderedDict()
+        self.max_entries = int(max_entries)
+        self.hits = 0
+        self.misses = 0
+
+    def get_or_build(self, key, builder):
+        """The cached value for ``key``, building (and caching) it on the
+        first request. The build runs OUTSIDE the lock — it may compile;
+        the server's single dispatch thread means no duplicate-build race
+        in practice, and a concurrent duplicate would only waste work,
+        never corrupt (last write wins on an identical value)."""
+        with self._lock:
+            if key in self._entries:
+                self.hits += 1
+                self._entries.move_to_end(key)
+                return self._entries[key]
+            self.misses += 1
+        value = builder()
+        with self._lock:
+            self._entries[key] = value
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.max_entries:
+                self._entries.popitem(last=False)
+        return value
+
+    def drop_dataset(self, dataset_id: str) -> None:
+        """Evict every entry of one dataset (keys are ``(kind, dataset_id,
+        ...)`` tuples) — called when the dataset is dropped so its cached
+        sort / walk closures release their device memory."""
+        with self._lock:
+            for key in [k for k in self._entries if k[1] == dataset_id]:
+                del self._entries[key]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
+@dataclasses.dataclass(frozen=True)
+class ResidentDataset:
+    """One registered dataset. ``residency`` is ``"device"`` (a committed
+    jax array), ``"host"`` (a read-only numpy array — the exact
+    f64-on-TPU route), or ``"stream"`` (a replayable chunk source; exact
+    queries run the sketch-seeded streaming descent). ``sketch`` is the
+    resident :class:`RadixSketch` (None = exact tier only)."""
+
+    dataset_id: str
+    residency: str
+    dtype: object  # np.dtype
+    n: int
+    data: object = None  # device or host array (None for "stream")
+    source: object = None  # replayable chunk source (None for resident)
+    sketch: object = None
+    stream_kwargs: dict = dataclasses.field(default_factory=dict)
+
+    def summary(self) -> dict:
+        """JSON-ready description (the /v1/datasets listing row)."""
+        out = {
+            "dataset": self.dataset_id,
+            "residency": self.residency,
+            "dtype": str(np.dtype(self.dtype)),
+            "n": self.n,
+            "sketch": self.sketch is not None,
+        }
+        if self.sketch is not None:
+            out["sketch_resolution_bits"] = self.sketch.resolution_bits
+            out["sketch_max_bucket"] = self.sketch.max_bucket_population()
+        return out
+
+
+def _host_keys(arr: np.ndarray) -> np.ndarray:
+    from mpi_k_selection_tpu.utils.dtypes import np_to_sortable_bits
+
+    return np_to_sortable_bits(np.ravel(arr))
+
+
+def _build_sketch(data_or_chunks, dtype, radix_bits, levels):
+    from mpi_k_selection_tpu.streaming.sketch import RadixSketch
+
+    sk = RadixSketch(dtype, radix_bits=radix_bits, levels=levels)
+    for chunk in data_or_chunks:
+        sk.update(chunk)
+    return sk
+
+
+class DatasetRegistry:
+    """Id-keyed home of resident datasets plus the program cache."""
+
+    def __init__(self, *, programs: ProgramCache | None = None):
+        self._lock = threading.Lock()
+        self._datasets: dict[str, ResidentDataset] = {}
+        self.programs = programs if programs is not None else ProgramCache()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _check_new_id(self, dataset_id: str) -> None:
+        """Fail-fast duplicate check BEFORE the expensive registration
+        work (defensive copy, device transfer, full sketch/stream pass);
+        :meth:`_register`'s locked check still closes the race."""
+        with self._lock:
+            if dataset_id in self._datasets:
+                raise DatasetExistsError(
+                    f"dataset {dataset_id!r} already registered; resident "
+                    "shards are immutable — drop() it first"
+                )
+
+    def _register(self, ds: ResidentDataset) -> ResidentDataset:
+        with self._lock:
+            if ds.dataset_id in self._datasets:
+                raise DatasetExistsError(
+                    f"dataset {ds.dataset_id!r} already registered; resident "
+                    "shards are immutable — drop() it first"
+                )
+            self._datasets[ds.dataset_id] = ds
+        return ds
+
+    def add_array(
+        self,
+        dataset_id: str,
+        data,
+        *,
+        sketch: bool = True,
+        sketch_bits: int = DEFAULT_SKETCH_BITS,
+        sketch_levels: int = DEFAULT_SKETCH_LEVELS,
+    ) -> ResidentDataset:
+        """Register an in-core dataset. ``data`` is converted ONCE through
+        :func:`~mpi_k_selection_tpu.api.as_selection_array` (so the exact
+        f64-on-TPU host route is reachable), EXCEPT caller-typed 64-bit
+        integer host data with x64 off, which becomes a single-chunk
+        STREAM dataset — the library's host-exact 64-bit route — instead
+        of raising at registration. The resident sketch is built from the
+        RESIDENT representation (post-conversion), so sketch answers and
+        exact answers always describe the same bits."""
+        import jax
+
+        from mpi_k_selection_tpu import api as _api
+
+        self._check_new_id(dataset_id)
+        if not isinstance(data, (jax.Array, jax.core.Tracer)):
+            arr = np.asarray(data)
+            if (
+                hasattr(data, "dtype")
+                and arr.dtype.kind in "iu"
+                and arr.dtype.itemsize == 8
+                and not jax.config.jax_enable_x64
+            ):
+                # jnp.asarray would silently truncate (KSL002); route the
+                # data through the streaming layer's host-exact counting
+                arr = np.ascontiguousarray(arr)
+                return self.add_stream(
+                    dataset_id,
+                    [arr],
+                    sketch=sketch,
+                    sketch_bits=sketch_bits,
+                    sketch_levels=sketch_levels,
+                )
+        x = _api.as_selection_array(data)
+        if x.size == 0:
+            raise QueryError("cannot register an empty dataset")
+        if isinstance(x, np.ndarray):
+            # host residency (exact f64-on-TPU): defensive copy, frozen —
+            # a caller mutating its array must not change served answers
+            x = np.ascontiguousarray(x).copy()
+            x.flags.writeable = False
+            residency = "host"
+        else:
+            residency = "device"
+        sk = None
+        if sketch:
+            host_view = x if isinstance(x, np.ndarray) else np.asarray(x)
+            sk = _build_sketch(
+                [host_view], np.dtype(x.dtype), sketch_bits, sketch_levels
+            )
+        return self._register(
+            ResidentDataset(
+                dataset_id=dataset_id,
+                residency=residency,
+                dtype=np.dtype(x.dtype),
+                n=int(x.size),
+                data=x,
+                sketch=sk,
+            )
+        )
+
+    def add_stream(
+        self,
+        dataset_id: str,
+        source,
+        *,
+        sketch: bool = True,
+        sketch_bits: int = DEFAULT_SKETCH_BITS,
+        sketch_levels: int = DEFAULT_SKETCH_LEVELS,
+        **stream_kwargs,
+    ) -> ResidentDataset:
+        """Register an out-of-core dataset from a REPLAYABLE chunk source
+        (list/tuple of chunks, a zero-arg callable returning a fresh
+        iterator, or a committed SpillStore). One accumulation pass runs
+        here to build the resident sketch (and establish n/dtype); exact
+        queries later replay the source through the sketch-seeded
+        streaming descent. ``stream_kwargs`` are held for those descents
+        (``pipeline_depth``, ``devices``, ``hist_method``, ...)."""
+        from mpi_k_selection_tpu.streaming.chunked import as_chunk_source
+        from mpi_k_selection_tpu.streaming.sketch import RadixSketch
+
+        self._check_new_id(dataset_id)
+        src = as_chunk_source(source)  # rejects one-shot sources loudly
+        dtype = None
+        sk = None
+        n = 0
+        for chunk in src():
+            c = np.ravel(np.asarray(chunk))
+            if c.size == 0:
+                continue
+            if dtype is None:
+                dtype = np.dtype(c.dtype)
+                sk = RadixSketch(
+                    dtype, radix_bits=sketch_bits, levels=sketch_levels
+                )
+            sk.update(c)
+            n += int(c.size)
+        if dtype is None or n == 0:
+            raise QueryError("cannot register an empty dataset")
+        return self._register(
+            ResidentDataset(
+                dataset_id=dataset_id,
+                residency="stream",
+                dtype=dtype,
+                n=n,
+                source=src,
+                # the accumulation pass is the sketch build; tier
+                # resolution needs it resident even with sketch=False
+                # for seeding, but honor the caller's visibility choice
+                sketch=sk if sketch else None,
+                stream_kwargs=dict(stream_kwargs),
+            )
+        )
+
+    def get(self, dataset_id: str) -> ResidentDataset:
+        with self._lock:
+            ds = self._datasets.get(dataset_id)
+        if ds is None:
+            raise DatasetNotFoundError(f"no dataset registered as {dataset_id!r}")
+        return ds
+
+    def drop(self, dataset_id: str) -> None:
+        with self._lock:
+            if dataset_id not in self._datasets:
+                raise DatasetNotFoundError(
+                    f"no dataset registered as {dataset_id!r}"
+                )
+            del self._datasets[dataset_id]
+        self.programs.drop_dataset(dataset_id)
+
+    def list_datasets(self) -> list[dict]:
+        with self._lock:
+            datasets = list(self._datasets.values())
+        return [ds.summary() for ds in sorted(datasets, key=lambda d: d.dataset_id)]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._datasets)
+
+    # -- selection dispatch (dispatch-thread only) -------------------------
+
+    def select_many(self, ds: ResidentDataset, ks) -> np.ndarray:
+        """Exact values at 1-indexed ranks ``ks`` (a list of ints), in
+        order — THE exact-tier entry the dispatch thread calls. Mirrors
+        :func:`~mpi_k_selection_tpu.api.kselect_many`'s n-aware dispatch
+        (same crossover rule, same clip-gather sort path, same radix
+        walk), with the compiled pieces drawn from :attr:`programs`:
+        answers are bit-identical to one ``api.kselect`` per rank because
+        both run the same exact order-statistic machinery over the same
+        resident bits."""
+        ks = [int(k) for k in ks]
+        for k in ks:
+            if not 1 <= k <= ds.n:
+                raise QueryError(f"k={k} out of range [1, {ds.n}]")
+        if ds.residency == "stream":
+            fn = self.programs.get_or_build(
+                ("stream_select", ds.dataset_id),
+                lambda: self._build_stream_select(ds),
+            )
+            return np.asarray(fn(ks))
+        from mpi_k_selection_tpu.api import many_sort_dispatch_queries
+
+        if ds.n <= 1 << 14 or len(ks) >= many_sort_dispatch_queries(ds.n):
+            s = self.programs.get_or_build(
+                ("sorted", ds.dataset_id), lambda: self._build_sorted(ds)
+            )
+            idx = np.clip(np.asarray(ks, np.int64) - 1, 0, ds.n - 1)
+            if isinstance(s, np.ndarray):
+                return s[idx]
+            return np.asarray(s[idx])
+        # keyed per DATASET, not per batch width: the closure serves any
+        # width (jit's own cache keys the compiled program by ks shape
+        # underneath), and width-fragmented entries could LRU-evict the
+        # genuinely expensive cached sort above
+        fn = self.programs.get_or_build(
+            ("walk", ds.dataset_id),
+            lambda: self._build_walk(ds),
+        )
+        return np.asarray(fn(ks))
+
+    @staticmethod
+    def _build_sorted(ds: ResidentDataset):
+        """Descent state for the sort path: the dataset sorted ONCE (host
+        stable sort for host residency — the f64-exact route — else one
+        device sort). Every later sort-path batch is a pure gather."""
+        if isinstance(ds.data, np.ndarray):
+            return np.sort(np.ravel(ds.data), kind="stable")
+        import jax.numpy as jnp
+
+        return jnp.sort(jnp.ravel(ds.data))
+
+    @staticmethod
+    def _build_walk(ds: ResidentDataset):
+        """The shared-pass multi-rank walk over the resident array —
+        compilation happens inside ops/radix.py on first call per batch
+        width and is reused for every later batch of that width."""
+        from mpi_k_selection_tpu.ops.radix import radix_select_many, select_count_dtype
+
+        def fn(ks):
+            import jax.numpy as jnp
+
+            ks_arr = jnp.asarray(ks, select_count_dtype(ds.n))
+            return radix_select_many(ds.data, ks_arr)
+
+        return fn
+
+    @staticmethod
+    def _build_stream_select(ds: ResidentDataset):
+        """Exact streamed multi-rank select — through the resident
+        sketch's ``refine_many`` entry when a sketch is resident (its
+        resolved prefix skips ``levels`` streamed passes), else the bare
+        shared-pass streaming descent."""
+        kwargs = dict(ds.stream_kwargs)
+        if ds.sketch is not None:
+            return lambda ks: ds.sketch.refine_many(ds.source, ks, **kwargs)
+        from mpi_k_selection_tpu.streaming.chunked import streaming_kselect_many
+
+        return lambda ks: streaming_kselect_many(ds.source, ks, **kwargs)
+
+    # -- non-rank ops (dispatch-thread only) -------------------------------
+
+    def topk(self, ds: ResidentDataset, k: int, *, largest: bool = True):
+        """Top-k (values, indices) over a RESIDENT dataset. Stream
+        datasets raise: a streamed top-k pass is a different workload
+        (ROADMAP) and silently re-streaming the source per query would
+        wreck the latency contract."""
+        if not 1 <= int(k) <= ds.n:
+            raise QueryError(f"topk k={k} out of range [1, {ds.n}]")
+        k = int(k)
+        if ds.residency == "stream":
+            raise QueryError(
+                "topk requires a resident (array) dataset; "
+                f"{ds.dataset_id!r} is stream-resident"
+            )
+        if isinstance(ds.data, np.ndarray):
+            # host residency: exact top-k in key space, earliest-position
+            # tie break (lax.top_k's rule) via stable argsort
+            keys = _host_keys(ds.data)
+            order_keys = ~keys if largest else keys
+            idx = np.argsort(order_keys, kind="stable")[:k]
+            return np.ravel(ds.data)[idx], idx
+        from mpi_k_selection_tpu.ops.topk import topk as _topk
+
+        v, i = _topk(ds.data, k, largest=largest)
+        return np.asarray(v), np.asarray(i)
+
+    def rank_certificate(self, ds: ResidentDataset, value):
+        """Exact ``(#<, #<=)`` counts of ``value`` in the dataset — the
+        O(n) proof that a served answer is the true order statistic."""
+        if ds.residency == "stream":
+            from mpi_k_selection_tpu.streaming.chunked import (
+                streaming_rank_certificate,
+            )
+
+            kwargs = {
+                key: ds.stream_kwargs[key]
+                for key in ("pipeline_depth", "devices")
+                if key in ds.stream_kwargs
+            }
+            less, leq = streaming_rank_certificate(ds.source, value, **kwargs)
+            return int(less), int(leq)
+        if isinstance(ds.data, np.ndarray):
+            keys = _host_keys(ds.data)
+            kv = _host_keys(np.asarray([value], ds.dtype))[0]
+            return int((keys < kv).sum()), int((keys <= kv).sum())
+        from mpi_k_selection_tpu.utils import debug
+
+        less, leq = debug.rank_certificate(ds.data, value)
+        return int(less), int(leq)

@@ -1,0 +1,334 @@
+"""KSelectServer — the in-process resident-dataset query server.
+
+Composes the subsystem: a :class:`~mpi_k_selection_tpu.serve.registry.
+DatasetRegistry` (resident shards + keyed program cache), a
+:class:`~mpi_k_selection_tpu.serve.batcher.QueryBatcher` (one dispatch
+thread, bounded coalescing window), and the latency tiers
+(serve/tiers.py). The HTTP front (serve/http.py) and the CLI ``serve``
+mode are thin shells over this class; embedding callers use it directly::
+
+    from mpi_k_selection_tpu.serve import KSelectServer
+
+    with KSelectServer(window=0.002) as srv:
+        srv.add_dataset("logits", x)             # shard/convert ONCE
+        a = srv.kselect("logits", k, tier="auto")
+        qs = srv.quantiles("logits", [0.5, 0.99], tier="sketch")
+        qs[0].rank_error_bound                   # bounds always attached
+
+Guarantees (tested in tests/test_serve.py):
+
+- **Determinism**: answers are bit-identical to serial one-at-a-time
+  ``api.kselect``/``api.quantiles`` calls, for every tier, dataset
+  residency, coalescing window, and client concurrency — all device
+  work runs on the single dispatch thread, resident shards are
+  immutable, and exact order statistics are batch-invariant.
+- **No recompiles on repeat shapes**: compiled walk closures and the
+  sort path's descent state live in the registry's keyed program cache
+  (``serve.program_cache.{hits,misses}`` mirror its counters exactly).
+- **Observability**: pass an :class:`~mpi_k_selection_tpu.obs.
+  Observability` — per-request ``serve.query`` events, per-group
+  ``serve.batch`` events, and the server metric namespace
+  (queue depth, batch width, per-tier query counts and latency
+  histograms, tier escalations; docs/OBSERVABILITY.md). Off by
+  default; enabling it never changes an answer bit.
+- **Clean shutdown**: ``close()`` joins the dispatch thread and fails
+  queued stragglers with :class:`ServerClosedError`; no ``ksel-serve-*``
+  thread outlives the server (conftest-enforced).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from mpi_k_selection_tpu.serve import tiers as _tiers
+from mpi_k_selection_tpu.serve.batcher import (
+    DEFAULT_MAX_BATCH,
+    PendingQuery,
+    QueryBatcher,
+)
+from mpi_k_selection_tpu.serve.errors import QueryError, ServerClosedError
+from mpi_k_selection_tpu.serve.registry import DatasetRegistry
+from mpi_k_selection_tpu.serve.tiers import RankAnswer
+
+#: Latency-histogram bucket bounds (seconds) — sub-ms sketch reads up to
+#: multi-second out-of-core descents.
+LATENCY_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 10.0,
+)
+
+OPS = ("kselect", "quantiles", "topk", "rank_certificate")
+
+
+class _LatencyRecorder:
+    """PhaseTimer recorder bridging request phases to the obs channels:
+    observes each finished ``serve.request.<tier>`` duration into the
+    per-tier latency histogram and forwards every span to the trace
+    recorder. Receives finished ``(name, t0, t1)`` triples only — no
+    clock is read here (KSL004)."""
+
+    def __init__(self, metrics, trace):
+        self._metrics = metrics
+        self._trace = trace
+
+    def record(self, name: str, t0: float, t1: float) -> None:
+        if self._metrics is not None and name.startswith("serve.request."):
+            tier = name.rsplit(".", 1)[-1]
+            self._metrics.histogram(
+                "serve.latency_seconds",
+                labels={"tier": tier},
+                buckets=LATENCY_BUCKETS,
+            ).observe(t1 - t0)
+        if self._trace is not None:
+            self._trace.record(name, t0, t1)
+
+
+class KSelectServer:
+    """Long-lived serving facade: register datasets once, answer
+    kselect / quantile / top-k / rank-certificate queries from many
+    concurrent clients. ``window`` is the batcher's coalescing window in
+    seconds (0 = dispatch every request alone)."""
+
+    def __init__(
+        self,
+        *,
+        window: float = 0.0,
+        max_batch: int = DEFAULT_MAX_BATCH,
+        obs=None,
+        registry: DatasetRegistry | None = None,
+    ):
+        from mpi_k_selection_tpu.utils.profiling import PhaseTimer
+
+        self.obs = obs
+        self.metrics = None if obs is None else obs.metrics
+        self.registry = registry if registry is not None else DatasetRegistry()
+        self.timer = PhaseTimer(
+            recorder=_LatencyRecorder(
+                self.metrics, None if obs is None else obs.trace
+            )
+        )
+        self.batcher = QueryBatcher(
+            self._execute_ranks,
+            window=window,
+            max_batch=max_batch,
+            observe_depth=self._observe_depth,
+            observe_width=self._observe_width,
+        )
+
+    # -- dataset lifecycle -------------------------------------------------
+
+    def add_dataset(
+        self, dataset_id: str, data=None, *, source=None, **kwargs
+    ):
+        """Register a dataset: ``data`` (an array — converted/sharded
+        once) or ``source`` (a replayable chunk source — sketched once,
+        exact queries re-stream). Keyword options per
+        :meth:`DatasetRegistry.add_array` / :meth:`add_stream`."""
+        if (data is None) == (source is None):
+            raise QueryError("pass exactly one of data= or source=")
+        if data is not None:
+            ds = self.registry.add_array(dataset_id, data, **kwargs)
+        else:
+            ds = self.registry.add_stream(dataset_id, source, **kwargs)
+        if self.metrics is not None:
+            self.metrics.gauge("serve.datasets").set(len(self.registry))
+        return ds
+
+    def drop_dataset(self, dataset_id: str) -> None:
+        self.registry.drop(dataset_id)
+        if self.metrics is not None:
+            self.metrics.gauge("serve.datasets").set(len(self.registry))
+
+    def list_datasets(self) -> list[dict]:
+        return self.registry.list_datasets()
+
+    # -- queries (request threads) -----------------------------------------
+
+    def kselect(self, dataset_id: str, k, *, tier: str = "auto") -> RankAnswer:
+        """Exact-or-bounded k-th smallest (1-indexed). Returns one
+        :class:`RankAnswer`; ``tier`` per serve/tiers.py."""
+        ds = self.registry.get(dataset_id)
+        return self._rank_query(ds, [k], tier, "kselect")[0]
+
+    def kselect_many(self, dataset_id: str, ks, *, tier: str = "auto"):
+        """One :class:`RankAnswer` per rank in ``ks``, in order — the
+        whole request rides one dispatch (and one shared walk)."""
+        ds = self.registry.get(dataset_id)
+        return self._rank_query(ds, list(ks), tier, "kselect")
+
+    def quantiles(self, dataset_id: str, qs, *, tier: str = "auto"):
+        """Nearest-rank quantile answers (``api.quantile_ranks``
+        conversion, so exact-tier values are bit-identical to
+        ``api.quantiles`` over the same resident bits)."""
+        from mpi_k_selection_tpu.api import quantile_ranks
+
+        ds = self.registry.get(dataset_id)
+        try:
+            ks = quantile_ranks(qs, ds.n)
+        except ValueError as e:
+            raise QueryError(str(e)) from e
+        return self._rank_query(ds, ks, tier, "quantiles")
+
+    def topk(self, dataset_id: str, k: int, *, largest: bool = True):
+        """Exact top-k ``(values, indices)`` over a resident dataset
+        (earliest-position tie break, matching ``lax.top_k``)."""
+        ds = self.registry.get(dataset_id)
+        result = self._run_single(
+            ds, "topk",
+            lambda: self.registry.topk(ds, k, largest=largest),
+        )
+        self._account(ds, "topk", None, "exact", 1, False)
+        return result
+
+    def rank_certificate(self, dataset_id: str, value):
+        """Exact ``(#<, #<=)`` counts for ``value`` — the O(n) proof a
+        served answer is the true order statistic."""
+        ds = self.registry.get(dataset_id)
+        result = self._run_single(
+            ds, "rank_certificate",
+            lambda: self.registry.rank_certificate(ds, value),
+        )
+        self._account(ds, "rank_certificate", None, "exact", 1, False)
+        return result
+
+    # -- internals ---------------------------------------------------------
+
+    def _check_open(self) -> None:
+        if self.batcher.closed:
+            raise ServerClosedError("server is closed")
+
+    def _rank_query(self, ds, ks, tier, op) -> list[RankAnswer]:
+        """``ds`` is the RESOLVED dataset (not an id): validation and
+        execution must describe the same object even if the id is
+        dropped and re-registered mid-request."""
+        self._check_open()
+        tier = _tiers.validate_tier(tier)
+        ks = [int(k) for k in ks]
+        for k in ks:
+            if not 1 <= k <= ds.n:
+                raise QueryError(f"k={k} out of range [1, {ds.n}]")
+        if tier == "sketch" or (tier == "auto" and _tiers.auto_pins(ds, ks)):
+            _tiers.require_sketch(ds)
+            with self.timer.phase("serve.request.sketch"):
+                answers = _tiers.sketch_answers(ds, ks)
+            self._account(ds, op, tier, "sketch", len(ks), False)
+            return answers
+        escalated = tier == "auto"
+        with self.timer.phase("serve.request.exact"):
+            pending = self.batcher.submit(
+                PendingQuery(ds.dataset_id, "rank", ks=tuple(ks), ds=ds)
+            )
+            values = pending.wait()
+        answers = [
+            RankAnswer(
+                k=k, value=values[i], tier="exact", exact=True,
+                escalated=escalated,
+            )
+            for i, k in enumerate(ks)
+        ]
+        self._account(ds, op, tier, "exact", len(ks), escalated)
+        return answers
+
+    def _run_single(self, ds, kind, run):
+        """Route one non-rank op through the dispatch thread (all device
+        work stays serialized there)."""
+        self._check_open()
+        with self.timer.phase("serve.request.exact"):
+            return self.batcher.submit(
+                PendingQuery(ds.dataset_id, kind, ds=ds, run=run)
+            ).wait()
+
+    def _execute_ranks(self, items) -> None:
+        """Dispatch-thread executor: ONE shared-pass select over the
+        coalesced ranks of every request in the group (all items carry
+        the same resolved dataset object), split back in submission
+        order."""
+        ds = items[0].ds
+        all_ks = [k for item in items for k in item.ks]
+        values = np.asarray(self.registry.select_many(ds, all_ks))
+        pos = 0
+        for item in items:
+            item.result = values[pos : pos + len(item.ks)]
+            pos += len(item.ks)
+        if self.obs is not None:
+            from mpi_k_selection_tpu.obs.events import ServeBatchEvent
+
+            self.obs.emit(
+                ServeBatchEvent(
+                    dataset=ds.dataset_id,
+                    requests=len(items),
+                    width=len(all_ks),
+                )
+            )
+
+    def _observe_depth(self, depth: int) -> None:
+        if self.metrics is not None:
+            self.metrics.histogram("serve.queue_depth").observe(depth)
+
+    def _observe_width(self, width: int) -> None:
+        if self.metrics is not None:
+            self.metrics.histogram("serve.batch_width").observe(width)
+
+    def _account(self, ds, op, tier_requested, tier_answered, queries, escalated):
+        """Per-request accounting: one ``serve.query`` event plus the
+        tier/op counters. Pure host-int observation."""
+        if self.obs is None:
+            return
+        from mpi_k_selection_tpu.obs.events import ServeQueryEvent
+
+        self.obs.emit(
+            ServeQueryEvent(
+                dataset=ds.dataset_id,
+                op=op,
+                tier_requested=tier_requested,
+                tier_answered=tier_answered,
+                queries=queries,
+                escalated=escalated,
+            )
+        )
+        if self.metrics is not None:
+            self.metrics.counter(
+                "serve.queries", labels={"tier": tier_answered, "op": op}
+            ).inc()
+            if escalated:
+                self.metrics.counter("serve.tier_escalations").inc()
+
+    def collect_metrics(self):
+        """Fold the registry/program-cache/phase state into the metrics
+        registry (idempotent snapshot — the same overwrite discipline as
+        ``obs.metrics.collect_runtime``) and return it. The /metrics
+        endpoint and ``render_prometheus`` call this before exposition."""
+        if self.metrics is None:
+            return None
+        from mpi_k_selection_tpu.obs.metrics import collect_runtime
+
+        self.metrics.counter("serve.program_cache.hits").set(
+            int(self.registry.programs.hits)
+        )
+        self.metrics.counter("serve.program_cache.misses").set(
+            int(self.registry.programs.misses)
+        )
+        self.metrics.gauge("serve.program_cache.entries").set(
+            len(self.registry.programs)
+        )
+        self.metrics.gauge("serve.datasets").set(len(self.registry))
+        collect_runtime(self.metrics, timer=self.timer)
+        return self.metrics
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition of the server metrics (empty when
+        the server runs without a metrics registry)."""
+        metrics = self.collect_metrics()
+        return "" if metrics is None else metrics.render_prometheus()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self) -> None:
+        """Join the dispatch thread; fail queued stragglers. Idempotent."""
+        self.batcher.close()
+
+    def __enter__(self) -> "KSelectServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

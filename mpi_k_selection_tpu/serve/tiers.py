@@ -1,0 +1,122 @@
+"""Latency tiers of the query server: sketch / exact / auto.
+
+The tier contract (docs/API.md "Serving"):
+
+- ``"sketch"`` answers instantly from the dataset's resident
+  :class:`~mpi_k_selection_tpu.streaming.sketch.RadixSketch` — a point
+  estimate that ALWAYS carries its exact error bounds (``rank_bounds``
+  with true ranks ``lo < k <= hi``, ``value_bounds`` bracketing the true
+  order statistic, and ``rank_error_bound = hi - lo``). Requires a
+  resident sketch; raises :class:`QueryError` otherwise.
+- ``"exact"`` runs the real selection (the batcher's shared-pass walk for
+  resident arrays, the sketch-seeded streaming descent for stream
+  datasets) — bit-identical to calling ``api.kselect`` yourself.
+- ``"auto"`` answers from the sketch when the sketch already PINS the
+  answer (its resolved key interval, clamped to the observed extremes,
+  is a single key — ``RadixSketch.pin``), and escalates the whole
+  request to the exact tier otherwise. Pinned answers are exact by
+  construction (the true value lies in a one-key interval), so auto
+  answers are ALWAYS bit-identical to exact ones; a multi-rank request
+  escalates as a unit if any of its ranks is unpinned, keeping one
+  request = one tier = one latency class.
+
+Pure host logic — no device work and no compilation happens here
+(KSL010); sketch reads are numpy over the resident pyramid.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from mpi_k_selection_tpu.serve.errors import QueryError
+
+TIERS = ("sketch", "exact", "auto")
+
+
+@dataclasses.dataclass(frozen=True)
+class RankAnswer:
+    """One rank query's answer. ``tier`` is the tier that ANSWERED
+    (``"sketch"`` or ``"exact"``); ``exact`` is True when the value is
+    the true order statistic bit-for-bit (always for the exact tier, and
+    for sketch answers the sketch pinned). Sketch-tier answers always
+    carry the three bound fields; exact-tier answers carry None (the
+    value itself is the proof)."""
+
+    k: int
+    value: object
+    tier: str
+    exact: bool
+    rank_bounds: tuple | None = None
+    value_bounds: tuple | None = None
+    rank_error_bound: int | None = None
+    escalated: bool = False
+
+    def as_dict(self) -> dict:
+        """JSON-ready form (numpy scalars -> Python numbers)."""
+        out = {
+            "k": int(self.k),
+            "value": _jsonable(self.value),
+            "tier": self.tier,
+            "exact": bool(self.exact),
+            "escalated": bool(self.escalated),
+        }
+        if self.rank_bounds is not None:
+            out["rank_bounds"] = [int(b) for b in self.rank_bounds]
+        if self.value_bounds is not None:
+            out["value_bounds"] = [_jsonable(v) for v in self.value_bounds]
+        if self.rank_error_bound is not None:
+            out["rank_error_bound"] = int(self.rank_error_bound)
+        return out
+
+
+def _jsonable(v):
+    item = getattr(v, "item", None)
+    return item() if item is not None else v
+
+
+def validate_tier(tier: str) -> str:
+    if tier not in TIERS:
+        raise QueryError(f"unknown tier {tier!r}; choose from {TIERS}")
+    return tier
+
+
+def sketch_answers(ds, ks) -> list[RankAnswer]:
+    """Sketch-tier answers for every rank in ``ks`` — point estimates
+    with their exact bounds attached (the sketch-tier response contract:
+    bounds are never omitted)."""
+    sk = require_sketch(ds)
+    out = []
+    for k in ks:
+        k = int(k)
+        lo, hi = sk.rank_bounds(k)
+        v_lo, v_hi = sk.value_bounds(k)
+        pinned = sk.pin(k)
+        out.append(
+            RankAnswer(
+                k=k,
+                value=pinned if pinned is not None else sk.query(k),
+                tier="sketch",
+                exact=pinned is not None,
+                rank_bounds=(lo, hi),
+                value_bounds=(v_lo, v_hi),
+                rank_error_bound=hi - lo,
+            )
+        )
+    return out
+
+
+def auto_pins(ds, ks) -> bool:
+    """True when the resident sketch pins EVERY rank in ``ks`` — the
+    auto tier's stay-on-sketch predicate (no sketch = never pins)."""
+    if ds.sketch is None:
+        return False
+    return all(ds.sketch.pin(int(k)) is not None for k in ks)
+
+
+def require_sketch(ds):
+    if ds.sketch is None:
+        raise QueryError(
+            f"dataset {ds.dataset_id!r} has no resident sketch; register "
+            "with sketch=True or query tier='exact'"
+        )
+    return ds.sketch

@@ -423,6 +423,25 @@ class RadixSketch:
         bounded by :meth:`rank_error_bound`; use :meth:`refine` for exact."""
         return self.value_bounds(k)[0]
 
+    def pin(self, k: int):
+        """The EXACT k-th smallest when the sketch already pins it — the
+        answering key interval, clamped to the observed extremes, is a
+        single key, so the true order statistic can only be that value —
+        else ``None``. The query server's auto tier answers from the
+        sketch exactly when every requested rank pins
+        (serve/tiers.py); a pinned value is bit-identical to the exact
+        descent's answer by construction. Pinning happens when the
+        resolution covers the full key width (e.g. 16-bit dtypes at
+        4x4), when the data concentrates (min == max inside the
+        answering bucket), or at the clamped extremes."""
+        b, _, _ = self._bucket(k)
+        lo_key, hi_key = self._interval_keys(b)
+        if lo_key != hi_key:
+            return None
+        return _dt.np_from_sortable_bits(
+            np.asarray([lo_key], self.kdt), self.dtype
+        )[0]
+
     def quantile(self, q: float):
         """Approximate quantile (nearest-rank convention, matching
         api.quantile_ranks)."""
@@ -472,6 +491,21 @@ class RadixSketch:
 
         kwargs.setdefault("radix_bits", self.radix_bits)
         return streaming_kselect(source, k, sketch=self, **kwargs)
+
+    def refine_many(self, source, ks, **kwargs):
+        """Exact k-th smallest for EVERY rank in ``ks`` over ``source``
+        (which must replay the very stream this sketch accumulated) —
+        the multi-rank twin of :meth:`refine`, and the resident-sketch
+        exact entry the query server's stream datasets dispatch through
+        (serve/registry.py): one sketch-seeded descent shares every
+        streamed pass across all requested ranks, so a coalesced batch
+        costs roughly the stream replays of one rank. ``source`` may be
+        a committed :class:`~mpi_k_selection_tpu.streaming.spill.
+        SpillStore`. Returns answers in ``ks`` order."""
+        from mpi_k_selection_tpu.streaming.chunked import streaming_kselect_many
+
+        kwargs.setdefault("radix_bits", self.radix_bits)
+        return streaming_kselect_many(source, ks, sketch=self, **kwargs)
 
     def __repr__(self) -> str:  # pragma: no cover - debugging aid
         return (

@@ -33,21 +33,25 @@ def rng():
 
 @pytest.fixture(autouse=True)
 def _no_leaked_pipeline_threads():
-    """Every streaming-pipeline producer thread must be joined by the time
-    its descent pass returns — normally AND when the consumer raises
-    (drifting source, dtype mismatch). A thread surviving a test is a
-    shutdown bug in streaming/pipeline.py, not test noise."""
+    """Every streaming-pipeline producer thread (``ksel-pipeline-*``) AND
+    every query-server thread (``ksel-serve-*``: the batcher's dispatch
+    thread, the HTTP serve loop, per-request handler threads) must be
+    joined by the time its owner returns/closes — normally AND on every
+    raise path. A thread surviving a test is a shutdown bug in
+    streaming/pipeline.py or serve/, not test noise."""
     yield
+    from mpi_k_selection_tpu.serve.batcher import SERVE_THREAD_PREFIX
     from mpi_k_selection_tpu.streaming.pipeline import THREAD_NAME_PREFIX
 
+    prefixes = (THREAD_NAME_PREFIX, SERVE_THREAD_PREFIX)
     stragglers = [
         t for t in threading.enumerate()
-        if t.name.startswith(THREAD_NAME_PREFIX)
+        if t.name.startswith(prefixes)
     ]
     for t in stragglers:  # grace for a close() racing the fixture
         t.join(timeout=5.0)
     alive = [t.name for t in stragglers if t.is_alive()]
-    assert not alive, f"leaked streaming-pipeline threads: {alive}"
+    assert not alive, f"leaked streaming-pipeline/serve threads: {alive}"
 
 
 @pytest.fixture(autouse=True)

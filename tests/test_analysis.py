@@ -549,6 +549,85 @@ def test_ksl009_noqa(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# KSL010 — per-request compilation in serve/ handler paths
+
+
+KSL010_POSITIVE = """
+    import functools
+
+    import jax
+
+    def handle_query(x, ks):
+        fn = jax.jit(lambda v: v[ks])          # fresh wrap per request
+        factory = functools.partial(jax.jit, static_argnums=0)
+        return fn(x)
+
+    @jax.jit
+    def handler_kernel(x):
+        return x + 1
+"""
+
+KSL010_NEGATIVE = """
+    def handle_query(registry, ds, ks):
+        # dispatch through the keyed program cache: no compile wrap here
+        fn = registry.programs.get_or_build(
+            ("walk", ds.dataset_id, len(ks)),
+            lambda: registry.build_walk(ds),
+        )
+        return fn(ks)
+"""
+
+
+def test_ksl010_positive_in_serve(tmp_path):
+    report = _lint_source(
+        tmp_path, KSL010_POSITIVE, name="mpi_k_selection_tpu/serve/handlers.py"
+    )
+    hits = [f for f in report.unsuppressed if f.rule == "KSL010"]
+    # jax.jit call + partial(jax.jit, ...) factory + @jax.jit decorator
+    assert len(hits) == 3
+    assert any("ProgramCache" in f.message for f in hits)
+
+
+def test_ksl010_negative_cached_dispatch_ok(tmp_path):
+    report = _lint_source(
+        tmp_path, KSL010_NEGATIVE, name="mpi_k_selection_tpu/serve/server.py"
+    )
+    assert "KSL010" not in _rules_hit(report)
+
+
+def test_ksl010_quiet_in_registry_outside_serve_and_tests(tmp_path):
+    # the registry IS the sanctioned compilation surface
+    report = _lint_source(
+        tmp_path, KSL010_POSITIVE, name="mpi_k_selection_tpu/serve/registry.py"
+    )
+    assert "KSL010" not in _rules_hit(report)
+    # jit anywhere else in the package is KSL010-quiet (other rules own it)
+    report = _lint_source(
+        tmp_path, KSL010_POSITIVE, name="mpi_k_selection_tpu/ops/mod.py"
+    )
+    assert "KSL010" not in _rules_hit(report)
+    # test files poke jit freely
+    report = _lint_source(
+        tmp_path, KSL010_POSITIVE, name="mpi_k_selection_tpu/serve/test_mod.py"
+    )
+    assert "KSL010" not in _rules_hit(report)
+
+
+def test_ksl010_noqa(tmp_path):
+    src = KSL010_POSITIVE.replace(
+        "fn = jax.jit(lambda v: v[ks])          # fresh wrap per request",
+        "fn = jax.jit(lambda v: v[ks])  # ksel: noqa[KSL010] -- fixture justification",
+    )
+    report = _lint_source(
+        tmp_path, src, name="mpi_k_selection_tpu/serve/handlers.py"
+    )
+    hits = [f for f in report.unsuppressed if f.rule == "KSL010"]
+    assert len(hits) == 2  # the factory + the decorator still fire
+    sup = [f for f in report.findings if f.rule == "KSL010" and f.suppressed]
+    assert sup and sup[0].justification == "fixture justification"
+
+
+# ---------------------------------------------------------------------------
 # jaxpr contract checks (KSC101-KSC103) self-tests
 
 
@@ -708,6 +787,7 @@ def test_cli_exit_codes(tmp_path, capsys):
         ("KSL004", KSL004_POSITIVE, "mod.py"),
         ("KSL006", KSL006_POSITIVE, "mod.py"),
         ("KSL007", KSL007_POSITIVE, "streaming/mod.py"),
+        ("KSL010", KSL010_POSITIVE, "serve/mod.py"),
     ],
 )
 def test_cli_exits_nonzero_on_each_positive_fixture(tmp_path, capsys, rule, src, name):

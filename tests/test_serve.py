@@ -1,0 +1,718 @@
+"""Resident-dataset query server (serve/): registry lifecycle, tier
+semantics, cross-request determinism under concurrency, batcher window
+extremes, program-cache hit accounting, the HTTP front, and the CLI
+``serve`` mode.
+
+The load-bearing contract (ISSUE 7 acceptance): batched/coalesced
+answers are BIT-IDENTICAL to individual ``api.kselect``/``quantiles``
+calls for every tier, dataset residency (incl. the 64-bit-no-x64
+host-exact route), coalescing window, and concurrency level; sketch-tier
+responses always carry their exact bounds; server start/stop leaks no
+threads (the conftest autouse fixture enforces that after every test
+here); repeat query shapes hit the registry's program cache.
+"""
+
+import http.client
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from mpi_k_selection_tpu import api
+from mpi_k_selection_tpu import obs as obs_lib
+from mpi_k_selection_tpu.serve import (
+    DatasetExistsError,
+    DatasetNotFoundError,
+    KSelectHTTPServer,
+    KSelectServer,
+    ProgramCache,
+    QueryError,
+    ServerClosedError,
+    start_http_server,
+)
+
+# > 2^14 so single exact rank queries take the shared radix walk (the
+# same dispatch api.kselect resolves to at this n)
+N_BIG = 40_000
+
+
+@pytest.fixture
+def x_int32(rng):
+    return rng.integers(-(2**31), 2**31 - 1, size=N_BIG, dtype=np.int32)
+
+
+def _bits(values, dtype):
+    """Bit pattern of ``values`` in ``dtype`` — the comparison every
+    bit-identity assertion here uses (float payload-safe)."""
+    return np.asarray(values, dtype=dtype).tobytes()
+
+
+def _serial_reference(x, ks):
+    """One api.kselect call per rank — the serial oracle the batched
+    server answers must match bit for bit."""
+    return [np.asarray(api.kselect(x, int(k))).item() for k in ks]
+
+
+# ---------------------------------------------------------------------------
+# registry lifecycle + program cache
+
+
+def test_registry_lifecycle(x_int32):
+    with KSelectServer() as srv:
+        srv.add_dataset("a", x_int32)
+        with pytest.raises(DatasetExistsError):
+            srv.add_dataset("a", x_int32)
+        with pytest.raises(DatasetNotFoundError):
+            srv.kselect("missing", 1)
+        with pytest.raises(QueryError):
+            srv.add_dataset("empty", np.empty(0, np.int32))
+        with pytest.raises(QueryError):
+            srv.add_dataset("both", x_int32, source=[x_int32])
+        rows = srv.list_datasets()
+        assert [r["dataset"] for r in rows] == ["a"]
+        assert rows[0]["n"] == N_BIG
+        assert rows[0]["residency"] == "device"
+        assert rows[0]["sketch"] is True
+        assert rows[0]["sketch_resolution_bits"] == 16
+        srv.drop_dataset("a")
+        with pytest.raises(DatasetNotFoundError):
+            srv.drop_dataset("a")
+        assert srv.list_datasets() == []
+
+
+def test_rank_and_tier_validation(x_int32):
+    with KSelectServer() as srv:
+        srv.add_dataset("a", x_int32)
+        with pytest.raises(QueryError):
+            srv.kselect("a", 0)
+        with pytest.raises(QueryError):
+            srv.kselect("a", N_BIG + 1)
+        with pytest.raises(QueryError):
+            srv.kselect("a", 1, tier="warp")
+        with pytest.raises(QueryError):
+            srv.quantiles("a", [1.5])
+        srv.add_dataset("nosketch", x_int32, sketch=False)
+        with pytest.raises(QueryError):
+            srv.kselect("nosketch", 1, tier="sketch")
+        # auto without a sketch never pins: it must fall through to exact
+        a = srv.kselect("nosketch", 7, tier="auto")
+        assert a.tier == "exact" and a.exact
+
+
+def test_program_cache_hit_miss_counters(x_int32):
+    obs = obs_lib.Observability(metrics=obs_lib.MetricsRegistry())
+    with KSelectServer(obs=obs) as srv:
+        srv.add_dataset("a", x_int32)
+        assert srv.registry.programs.misses == 0
+        srv.kselect("a", 100, tier="exact")
+        miss0, hit0 = srv.registry.programs.misses, srv.registry.programs.hits
+        assert (miss0, hit0) == (1, 0)
+        # the SAME query shape (width-1 rank batch) must hit, not rebuild
+        srv.kselect("a", 31_337, tier="exact")
+        srv.kselect("a", 7, tier="exact")
+        assert srv.registry.programs.misses == miss0
+        assert srv.registry.programs.hits == hit0 + 2
+        # the walk closure is width-independent (keyed per dataset, so
+        # varying coalesced widths can't fragment the LRU): width-2
+        # batches HIT the same entry
+        srv.kselect_many("a", [5, 6], tier="exact")
+        srv.kselect_many("a", [9, 12], tier="exact")
+        assert srv.registry.programs.misses == miss0
+        assert srv.registry.programs.hits == hit0 + 4
+        # the sort path caches the dataset's sorted descent state once
+        wide = list(range(1, api.many_sort_dispatch_queries(N_BIG) + 2))
+        srv.kselect_many("a", wide, tier="exact")
+        srv.kselect_many("a", wide, tier="exact")
+        assert srv.registry.programs.misses == miss0 + 1
+        # the exported mirror equals the source counters EXACTLY
+        snap = srv.collect_metrics().as_dict()
+        assert snap["serve.program_cache.hits"]["value"] == srv.registry.programs.hits
+        assert (
+            snap["serve.program_cache.misses"]["value"]
+            == srv.registry.programs.misses
+        )
+        # dropping the dataset evicts its cached programs
+        srv.drop_dataset("a")
+        assert len(srv.registry.programs) == 0
+
+
+def test_program_cache_lru_eviction():
+    cache = ProgramCache(max_entries=2)
+    assert cache.get_or_build(("a", "d1"), lambda: 1) == 1
+    assert cache.get_or_build(("b", "d1"), lambda: 2) == 2
+    assert cache.get_or_build(("a", "d1"), lambda: 99) == 1  # hit keeps 1
+    cache.get_or_build(("c", "d1"), lambda: 3)  # evicts ("b", ...) (LRU)
+    assert cache.get_or_build(("b", "d1"), lambda: 4) == 4  # rebuilt
+    assert cache.hits == 1 and cache.misses == 4
+
+
+# ---------------------------------------------------------------------------
+# tier semantics
+
+
+def test_sketch_tier_always_carries_exact_bounds(x_int32):
+    with KSelectServer() as srv:
+        srv.add_dataset("a", x_int32)
+        s = np.sort(x_int32, kind="stable")
+        for k in (1, 17, N_BIG // 2, N_BIG):
+            a = srv.kselect("a", k, tier="sketch")
+            assert a.tier == "sketch"
+            assert a.rank_bounds is not None
+            assert a.value_bounds is not None
+            assert a.rank_error_bound == a.rank_bounds[1] - a.rank_bounds[0]
+            lo, hi = a.rank_bounds
+            assert lo < k <= hi  # exact rank bracket, any stream
+            v_lo, v_hi = a.value_bounds
+            assert v_lo <= s[k - 1] <= v_hi  # exact value bracket
+            d = a.as_dict()
+            assert {"rank_bounds", "value_bounds", "rank_error_bound"} <= set(d)
+
+
+def test_auto_tier_pins_and_escalates(x_int32):
+    obs = obs_lib.Observability(
+        events=obs_lib.ListSink(), metrics=obs_lib.MetricsRegistry()
+    )
+    with KSelectServer(obs=obs) as srv:
+        # constant data: every resolved interval clamps to one key -> auto
+        # answers from the sketch, exactly, with zero escalations
+        srv.add_dataset("flat", np.full(5000, 42, np.int32))
+        for k in (1, 2500, 5000):
+            a = srv.kselect("flat", k, tier="auto")
+            assert (a.tier, a.exact, a.escalated) == ("sketch", True, False)
+            assert a.value == 42
+        assert obs.metrics.counter("serve.tier_escalations").value == 0
+        # int16 at 4x4 resolves ALL 16 key bits: every rank pins, and the
+        # pinned sketch answers are bit-identical to the exact tier
+        x16 = np.random.default_rng(7).integers(
+            -(2**15), 2**15, size=4096, dtype=np.int16
+        )
+        srv.add_dataset("i16", x16)
+        s16 = np.sort(x16, kind="stable")
+        for k in (1, 9, 2048, 4096):
+            a = srv.kselect("i16", k, tier="auto")
+            assert (a.tier, a.exact) == ("sketch", True)
+            assert _bits(a.value, np.int16) == _bits(s16[k - 1], np.int16)
+        # spread int32: unpinned -> auto escalates to exact, bit-identical
+        # to the direct api call, and the escalation counter says so
+        srv.add_dataset("spread", x_int32)
+        a = srv.kselect("spread", 1234, tier="auto")
+        assert (a.tier, a.exact, a.escalated) == ("exact", True, True)
+        assert _bits(a.value, np.int32) == _bits(
+            _serial_reference(x_int32, [1234]), np.int32
+        )
+        assert obs.metrics.counter("serve.tier_escalations").value == 1
+        kinds = {e.kind for e in obs.events.events}
+        assert {"serve.query", "serve.batch"} <= kinds
+
+
+def test_sketch_pin_contract(x_int32):
+    """RadixSketch.pin: None exactly when the clamped interval holds more
+    than one key; the pinned value is the true order statistic."""
+    from mpi_k_selection_tpu.streaming.sketch import RadixSketch
+
+    sk = RadixSketch(np.int32).update(x_int32)
+    assert sk.pin(N_BIG // 2) is None  # spread data, 16 of 32 bits resolved
+    flat = RadixSketch(np.int32).update(np.full(100, -7, np.int32))
+    pinned = flat.pin(50)
+    assert pinned is not None and pinned == -7
+
+
+# ---------------------------------------------------------------------------
+# cross-request determinism (the acceptance grid)
+
+
+@pytest.mark.parametrize("window", [0.0, 0.25])
+@pytest.mark.parametrize("tier", ["sketch", "exact", "auto"])
+def test_concurrent_queries_bit_identical_to_serial(x_int32, tier, window):
+    """N threads issuing overlapping kselect/quantile queries produce
+    answers bit-identical to serial execution, across every tier and
+    with the batcher window at both extremes (0 = no coalescing, large
+    = full coalescing)."""
+    n_threads = 8
+    ks_per_thread = [
+        [1 + (i * 977 + j * 131) % N_BIG for j in range(3)]
+        for i in range(n_threads)
+    ]
+    qs = [0.25, 0.9]
+    with KSelectServer(window=window) as srv:
+        srv.add_dataset("a", x_int32)
+        # serial references, one query at a time, BEFORE any concurrency
+        serial_ranks = {
+            k: srv.kselect("a", k, tier=tier).value
+            for row in ks_per_thread
+            for k in row
+        }
+        serial_q = [a.value for a in srv.quantiles("a", qs, tier=tier)]
+        if tier != "sketch":  # exact/auto answers match the direct api
+            for k, v in serial_ranks.items():
+                assert _bits(v, np.int32) == _bits(
+                    _serial_reference(x_int32, [k]), np.int32
+                )
+        results = [None] * n_threads
+        errors = []
+        barrier = threading.Barrier(n_threads)
+
+        def client(i):
+            try:
+                barrier.wait(timeout=30)
+                out = {}
+                for k in ks_per_thread[i]:
+                    out[k] = srv.kselect("a", k, tier=tier).value
+                out["q"] = [a.value for a in srv.quantiles("a", qs, tier=tier)]
+                results[i] = out
+            except BaseException as e:  # surfaced below, not swallowed
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=client, args=(i,), name=f"client-{i}")
+            for i in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, errors
+        for i in range(n_threads):
+            assert results[i] is not None
+            for k in ks_per_thread[i]:
+                assert _bits(results[i][k], np.int32) == _bits(
+                    serial_ranks[k], np.int32
+                ), (tier, window, k)
+            assert _bits(results[i]["q"], np.int32) == _bits(serial_q, np.int32)
+
+
+def test_batcher_window_extremes(x_int32):
+    n_threads = 8
+    ks = [1 + 613 * i for i in range(n_threads)]
+    want = _serial_reference(x_int32, ks)
+    # window=0: every request dispatches alone — batch width is always 1
+    obs0 = obs_lib.Observability(
+        events=obs_lib.ListSink(), metrics=obs_lib.MetricsRegistry()
+    )
+    with KSelectServer(window=0.0, obs=obs0) as srv:
+        srv.add_dataset("a", x_int32)
+        for i, k in enumerate(ks):
+            a = srv.kselect("a", k, tier="exact")
+            assert _bits(a.value, np.int32) == _bits(want[i], np.int32)
+        widths = [e.width for e in obs0.events.of_kind("serve.batch")]
+        assert widths and max(widths) == 1
+        assert obs0.metrics.histogram("serve.batch_width").max == 1
+    # large window: concurrent arrivals coalesce into one shared walk
+    obs1 = obs_lib.Observability(
+        events=obs_lib.ListSink(), metrics=obs_lib.MetricsRegistry()
+    )
+    with KSelectServer(window=0.5, obs=obs1) as srv:
+        srv.add_dataset("a", x_int32)
+        results = [None] * n_threads
+        barrier = threading.Barrier(n_threads)
+
+        def client(i):
+            barrier.wait(timeout=30)
+            results[i] = srv.kselect("a", ks[i], tier="exact").value
+
+        threads = [threading.Thread(target=client, args=(i,)) for i in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        for i in range(n_threads):
+            assert _bits(results[i], np.int32) == _bits(want[i], np.int32)
+        batches = obs1.events.of_kind("serve.batch")
+        assert max(e.width for e in batches) >= 2  # coalescing happened
+        assert sum(e.width for e in batches) == n_threads  # nothing lost
+        assert max(e.requests for e in batches) >= 2
+
+
+def test_batch_flips_to_sort_path_bit_identically(x_int32):
+    """A coalesced batch past many_sort_dispatch_queries flips to the
+    one-sort-K-gathers path (through the cached sort) — answers must
+    stay bit-identical to one-at-a-time kselect."""
+    sort_at = api.many_sort_dispatch_queries(N_BIG)
+    ks = [1 + (i * 409) % N_BIG for i in range(sort_at + 5)]
+    with KSelectServer() as srv:
+        srv.add_dataset("a", x_int32)
+        answers = srv.kselect_many("a", ks, tier="exact")
+        assert ("sorted", "a") in srv.registry.programs._entries
+        want = np.sort(x_int32, kind="stable")[np.asarray(ks) - 1]
+        assert _bits([a.value for a in answers], np.int32) == _bits(
+            want, np.int32
+        )
+        # spot-check against the serial api oracle too
+        assert _bits(answers[0].value, np.int32) == _bits(
+            _serial_reference(x_int32, [ks[0]]), np.int32
+        )
+
+
+# ---------------------------------------------------------------------------
+# residency routes
+
+
+def test_int64_without_x64_takes_host_exact_stream_route(rng):
+    """Caller-typed 64-bit host data with x64 off must not truncate: the
+    registry routes it through the streaming layer's host-exact
+    counting (KSL002's bug class, closed at the serving layer)."""
+    assert not jax.config.jax_enable_x64
+    x = rng.integers(-(2**62), 2**62, size=3000, dtype=np.int64)
+    s = np.sort(x, kind="stable")
+    with KSelectServer() as srv:
+        srv.add_dataset("wide", x)
+        assert srv.registry.get("wide").residency == "stream"
+        for k in (1, 1500, 3000):
+            a = srv.kselect("wide", k, tier="exact")
+            assert _bits(a.value, np.int64) == _bits(s[k - 1], np.int64)
+        # sketch/auto tiers ride the same resident sketch
+        b = srv.kselect("wide", 1500, tier="sketch")
+        assert b.value_bounds[0] <= s[1499] <= b.value_bounds[1]
+        u = rng.integers(0, 2**63, size=1000, dtype=np.uint64)
+        srv.add_dataset("u64", u)
+        assert srv.registry.get("u64").residency == "stream"
+        a = srv.kselect("u64", 500, tier="exact")
+        assert _bits(a.value, np.uint64) == _bits(
+            np.sort(u, kind="stable")[499], np.uint64
+        )
+
+
+def test_stream_dataset_from_chunked_source(rng):
+    chunks = [
+        rng.integers(-(2**31), 2**31 - 1, size=1 << 12, dtype=np.int32)
+        for _ in range(5)
+    ]
+    x = np.concatenate(chunks)
+    s = np.sort(x, kind="stable")
+    with KSelectServer(window=0.2) as srv:
+        srv.add_dataset("st", source=chunks, pipeline_depth=0)
+        ds = srv.registry.get("st")
+        assert ds.residency == "stream" and ds.n == x.size
+        qs = [0.1, 0.5, 0.99]
+        want = [a for a in np.asarray(api.quantiles(x, qs))]
+        got = srv.quantiles("st", qs, tier="exact")
+        assert _bits([a.value for a in got], np.int32) == _bits(want, np.int32)
+        # repeat shape hits the cached stream-select program
+        hits0 = srv.registry.programs.hits
+        srv.quantiles("st", qs, tier="exact")
+        assert srv.registry.programs.hits == hits0 + 1
+        # concurrent clients against the stream dataset stay bit-identical
+        results = [None] * 4
+        barrier = threading.Barrier(4)
+
+        def client(i):
+            barrier.wait(timeout=30)
+            results[i] = [a.value for a in srv.quantiles("st", qs, tier="exact")]
+
+        ts = [threading.Thread(target=client, args=(i,)) for i in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=60)
+        for r in results:
+            assert _bits(r, np.int32) == _bits(want, np.int32)
+        # top-k needs a resident array; streams refuse loudly
+        with pytest.raises(QueryError):
+            srv.topk("st", 4)
+        # ... but the streamed rank certificate works
+        less, leq = srv.rank_certificate("st", s[100 - 1])
+        assert less < 100 <= leq
+
+
+def test_float32_and_float64_datasets(rng):
+    xf = rng.standard_normal(N_BIG).astype(np.float32)
+    with KSelectServer() as srv:
+        srv.add_dataset("f32", xf)
+        want = _serial_reference(xf, [77, N_BIG // 2])
+        got = srv.kselect_many("f32", [77, N_BIG // 2], tier="exact")
+        assert _bits([a.value for a in got], np.float32) == _bits(
+            want, np.float32
+        )
+        qa = srv.quantiles("f32", [0.5], tier="auto")[0]
+        assert _bits(qa.value, np.float32) == _bits(
+            np.asarray(api.quantiles(xf, [0.5])), np.float32
+        )
+        # float64 on CPU follows as_selection_array's documented
+        # conversion; the registered residency serves exactly w.r.t. the
+        # resident bits (sketch and exact describe the SAME array)
+        xd = rng.standard_normal(2000)
+        srv.add_dataset("f64", xd)
+        ds = srv.registry.get("f64")
+        resident = np.asarray(ds.data)
+        a = srv.kselect("f64", 1000, tier="exact")
+        assert _bits(a.value, resident.dtype) == _bits(
+            np.sort(resident, kind="stable")[999], resident.dtype
+        )
+
+
+def test_topk_and_certificate_match_direct_ops(x_int32):
+    from mpi_k_selection_tpu.ops.topk import topk as ops_topk
+
+    with KSelectServer() as srv:
+        srv.add_dataset("a", x_int32)
+        v, i = srv.topk("a", 8)
+        wv, wi = ops_topk(np.asarray(x_int32), 8)
+        assert np.array_equal(v, np.asarray(wv))
+        assert np.array_equal(i, np.asarray(wi))
+        v, i = srv.topk("a", 5, largest=False)
+        order = np.argsort(x_int32, kind="stable")[:5]
+        assert np.array_equal(i, order)
+        ref = _serial_reference(x_int32, [123])[0]
+        less, leq = srv.rank_certificate("a", ref)
+        assert less < 123 <= leq
+
+
+# ---------------------------------------------------------------------------
+# obs integration
+
+
+def test_serve_query_events_and_metrics(x_int32):
+    obs = obs_lib.Observability.collecting()
+    with KSelectServer(obs=obs) as srv:
+        srv.add_dataset("a", x_int32)
+        srv.kselect("a", 5, tier="exact")
+        srv.kselect("a", 5, tier="sketch")
+        srv.quantiles("a", [0.5, 0.9], tier="auto")
+        srv.topk("a", 3)
+        srv.rank_certificate("a", 0)
+        events = obs.events.of_kind("serve.query")
+        assert [e.op for e in events] == [
+            "kselect", "kselect", "quantiles", "topk", "rank_certificate",
+        ]
+        by_op = {e.op: e for e in events}
+        assert by_op["quantiles"].queries == 2
+        assert events[1].tier_answered == "sketch"
+        snap = srv.collect_metrics().as_dict()
+        assert snap['serve.queries{op="kselect",tier="exact"}']["value"] == 1
+        assert snap['serve.queries{op="kselect",tier="sketch"}']["value"] == 1
+        lat = snap['serve.latency_seconds{tier="exact"}']
+        assert lat["count"] >= 3  # exact kselect + quantiles + topk + cert
+        assert snap["serve.datasets"]["value"] == 1
+        # prometheus exposition renders the namespace
+        text = srv.render_prometheus()
+        assert "ksel_serve_queries" in text
+        assert "ksel_serve_latency_seconds_bucket" in text
+        assert "ksel_serve_program_cache_hits" in text
+
+
+def test_kselect_many_emits_resident_select_event(x_int32):
+    sink = obs_lib.ListSink()
+    obs = obs_lib.Observability(events=sink)
+    api.kselect_many(x_int32, [1, 2, 3], obs=obs)
+    small = np.arange(100, dtype=np.int32)
+    api.kselect_many(small, [1, 2], obs=obs)
+    evs = sink.of_kind("resident.select")
+    assert [e.algorithm for e in evs] == ["radix-many", "sort-many"]
+    assert [e.queries for e in evs] == [3, 2]
+
+
+def test_obs_never_changes_answers(x_int32):
+    ks = [3, 777, N_BIG]
+    with KSelectServer() as srv:
+        srv.add_dataset("a", x_int32)
+        plain = [a.value for a in srv.kselect_many("a", ks, tier="exact")]
+    obs = obs_lib.Observability.collecting()
+    with KSelectServer(obs=obs, window=0.05) as srv:
+        srv.add_dataset("a", x_int32)
+        wired = [a.value for a in srv.kselect_many("a", ks, tier="exact")]
+    assert _bits(plain, np.int32) == _bits(wired, np.int32)
+
+
+# ---------------------------------------------------------------------------
+# lifecycle / shutdown
+
+
+def test_close_is_idempotent_and_rejects_queries(x_int32):
+    srv = KSelectServer()
+    srv.add_dataset("a", x_int32)
+    assert srv.kselect("a", 1, tier="exact").value == int(np.min(x_int32))
+    srv.close()
+    srv.close()
+    with pytest.raises(ServerClosedError):
+        srv.kselect("a", 1, tier="exact")
+    with pytest.raises(ServerClosedError):
+        srv.kselect("a", 1, tier="sketch")
+
+
+def test_dispatch_errors_surface_on_request_thread(x_int32):
+    with KSelectServer() as srv:
+        srv.add_dataset("a", x_int32)
+        # registry raises INSIDE the dispatch thread for stream-only ops;
+        # the error must re-raise on the caller, not kill the dispatcher
+        with pytest.raises(QueryError):
+            srv.topk("a", 0)
+        # the dispatch thread survived: later queries still answer
+        assert srv.kselect("a", 1, tier="exact").value == int(np.min(x_int32))
+
+
+# ---------------------------------------------------------------------------
+# HTTP front
+
+
+def _http(port, method, path, body=None):
+    c = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    try:
+        c.request(
+            method,
+            path,
+            None if body is None else json.dumps(body),
+            {"Content-Type": "application/json"},
+        )
+        r = c.getresponse()
+        return r.status, r.read()
+    finally:
+        c.close()
+
+
+def test_http_front_roundtrip(x_int32):
+    obs = obs_lib.Observability(metrics=obs_lib.MetricsRegistry())
+    with KSelectServer(window=0.01, obs=obs) as srv:
+        srv.add_dataset("a", x_int32)
+        with start_http_server(srv) as h:
+            status, body = _http(h.port, "GET", "/healthz")
+            assert status == 200 and json.loads(body)["datasets"] == 1
+            status, body = _http(h.port, "GET", "/v1/datasets")
+            assert status == 200
+            assert json.loads(body)["datasets"][0]["dataset"] == "a"
+            # exact kselect over the wire == the direct api answer
+            want = _serial_reference(x_int32, [1234])[0]
+            status, body = _http(
+                h.port, "POST", "/v1/query",
+                {"dataset": "a", "op": "kselect", "k": 1234, "tier": "exact"},
+            )
+            assert status == 200
+            ans = json.loads(body)["answers"][0]
+            assert ans["value"] == int(want)
+            assert ans["tier"] == "exact" and ans["exact"] is True
+            # sketch tier always ships its bounds
+            status, body = _http(
+                h.port, "POST", "/v1/query",
+                {"dataset": "a", "op": "quantiles", "qs": [0.5], "tier": "sketch"},
+            )
+            assert status == 200
+            ans = json.loads(body)["answers"][0]
+            assert {"rank_bounds", "value_bounds", "rank_error_bound"} <= set(ans)
+            # topk + certificate ops
+            status, body = _http(
+                h.port, "POST", "/v1/query",
+                {"dataset": "a", "op": "topk", "k": 3},
+            )
+            assert status == 200
+            assert json.loads(body)["values"] == [
+                int(v) for v in np.sort(x_int32)[::-1][:3]
+            ]
+            status, body = _http(
+                h.port, "POST", "/v1/query",
+                {"dataset": "a", "op": "rank_certificate", "value": int(want)},
+            )
+            assert status == 200
+            cert = json.loads(body)
+            assert cert["less"] < 1234 <= cert["leq"]
+            # error mapping: 404 unknown dataset, 400 malformed
+            status, _ = _http(
+                h.port, "POST", "/v1/query",
+                {"dataset": "ghost", "op": "kselect", "k": 1},
+            )
+            assert status == 404
+            for bad in (
+                {"dataset": "a", "op": "warp"},
+                {"dataset": "a", "op": "kselect"},
+                {"dataset": "a", "op": "kselect", "k": 0},
+                {"op": "kselect", "k": 1},
+            ):
+                status, _ = _http(h.port, "POST", "/v1/query", bad)
+                assert status == 400, bad
+            status, _ = _http(h.port, "GET", "/nope")
+            assert status == 404
+            # /metrics: live Prometheus text of the server namespace
+            status, body = _http(h.port, "GET", "/metrics")
+            assert status == 200
+            text = body.decode()
+            assert "ksel_serve_queries" in text
+            assert "ksel_serve_latency_seconds_bucket" in text
+    # context exits joined the HTTP serve loop, request threads, and the
+    # dispatch thread — the conftest fixture verifies nothing leaked
+
+
+def test_http_concurrent_clients_bit_identical(x_int32):
+    ks = [1 + 313 * i for i in range(8)]
+    want = _serial_reference(x_int32, ks)
+    with KSelectServer(window=0.2) as srv:
+        srv.add_dataset("a", x_int32)
+        with start_http_server(srv) as h:
+            results = [None] * len(ks)
+            barrier = threading.Barrier(len(ks))
+
+            def client(i):
+                barrier.wait(timeout=30)
+                status, body = _http(
+                    h.port, "POST", "/v1/query",
+                    {"dataset": "a", "op": "kselect", "k": ks[i], "tier": "exact"},
+                )
+                assert status == 200
+                results[i] = json.loads(body)["answers"][0]["value"]
+
+            ts = [threading.Thread(target=client, args=(i,)) for i in range(len(ks))]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=60)
+            assert results == [int(v) for v in want]
+
+
+# ---------------------------------------------------------------------------
+# CLI serve mode
+
+
+def test_cli_serve_mode(tmp_path):
+    from mpi_k_selection_tpu.cli import main
+
+    port_file = tmp_path / "port"
+    rc = []
+    t = threading.Thread(
+        target=lambda: rc.append(
+            main(
+                [
+                    "serve",
+                    "--n", "4096",
+                    "--dtype", "int32",
+                    "--port", "0",
+                    "--port-file", str(port_file),
+                    "--batch-window", "0",
+                    "--quit-after", "2",
+                ]
+            )
+        ),
+        name="cli-serve",
+    )
+    t.start()
+    for _ in range(400):  # wait for the listener to come up
+        if port_file.exists() and port_file.read_text():
+            break
+        time.sleep(0.05)
+    else:
+        pytest.fail("serve CLI never wrote its port file")
+    port = int(port_file.read_text())
+    status, body = _http(port, "GET", "/healthz")
+    assert status == 200
+    status, body = _http(
+        port, "POST", "/v1/query",
+        {"dataset": "default", "op": "kselect", "k": 1, "tier": "exact"},
+    )
+    assert status == 200
+    from mpi_k_selection_tpu.utils import datagen
+
+    x = datagen.generate(4096, pattern="uniform", seed=0, dtype="int32")
+    assert json.loads(body)["answers"][0]["value"] == int(np.min(x))
+    t.join(timeout=60)
+    assert not t.is_alive() and rc == [0]
+
+
+def test_cli_serve_parser_errors(capsys):
+    from mpi_k_selection_tpu.cli import build_serve_parser
+
+    p = build_serve_parser()
+    args = p.parse_args([])
+    assert args.port == 8080 and args.batch_window == 0.002
+    with pytest.raises(SystemExit):
+        p.parse_args(["--gen", "nonsense"])
+    capsys.readouterr()

@@ -342,6 +342,89 @@ def main() -> int:
     }
     print(f"  obs snapshot: {snapshot}")
 
+    # --- resident-dataset query server (serve/): in-process smoke — a
+    # mixed query burst across tiers over two datasets (spread int32 =
+    # unpinnable, constant int32 = always pinned), asserting the
+    # tier-auto escalation count and bit-equality with direct
+    # api.kselect on real silicon, so the next TPU run records serving
+    # numbers alongside the streaming sweep ---
+    print("resident-dataset query server:")
+    import threading as _sv_threading
+
+    from mpi_k_selection_tpu import api as _sv_api
+    from mpi_k_selection_tpu import obs as _sv_obs
+    from mpi_k_selection_tpu.serve import KSelectServer as _KSelectServer
+
+    sv_obs = _sv_obs.Observability(
+        events=_sv_obs.ListSink(), metrics=_sv_obs.MetricsRegistry()
+    )
+    sv_spread = rng.integers(-(2**31), 2**31 - 1, size=1 << 20, dtype=np.int32)
+    sv_flat = np.full(1 << 16, 424242, np.int32)
+    sv_ks = [1 + (i * 65537) % sv_spread.size for i in range(24)]
+    sv_want = {k: int(np.asarray(_sv_api.kselect(sv_spread, k))) for k in sv_ks}
+    with _KSelectServer(window=0.002, obs=sv_obs) as sv_srv:
+        sv_srv.add_dataset("spread", sv_spread)
+        sv_srv.add_dataset("flat", sv_flat)
+        sv_results: dict = {}
+        sv_flat_tiers: list = []
+        sv_lock = _sv_threading.Lock()
+
+        def sv_client(ks_shard):
+            # mixed burst: exact + auto ranks on the spread dataset,
+            # auto (always pinned) on the constant one
+            for k in ks_shard:
+                a_exact = sv_srv.kselect("spread", k, tier="exact")
+                a_auto = sv_srv.kselect("spread", k, tier="auto")
+                a_flat = sv_srv.kselect("flat", 1 + k % sv_flat.size, tier="auto")
+                with sv_lock:
+                    sv_results[k] = (int(a_exact.value), int(a_auto.value))
+                    sv_flat_tiers.append((a_flat.tier, int(a_flat.value)))
+
+        sv_threads = [
+            _sv_threading.Thread(target=sv_client, args=(sv_ks[i::8],))
+            for i in range(8)
+        ]
+        for t in sv_threads:
+            t.start()
+        for t in sv_threads:
+            t.join()
+        check(
+            "serve exact tier bit-equality vs api.kselect",
+            all(sv_results[k][0] == sv_want[k] for k in sv_ks),
+            True,
+        )
+        check(
+            "serve auto tier escalates to the same bits",
+            all(sv_results[k][1] == sv_want[k] for k in sv_ks),
+            True,
+        )
+        check(
+            "serve auto pinned on the constant dataset",
+            all(t == ("sketch", 424242) for t in sv_flat_tiers),
+            True,
+        )
+        # every auto query on the spread dataset escalated; none on flat
+        esc = sv_obs.metrics.counter("serve.tier_escalations").value
+        check("serve tier-auto escalation count", esc, len(sv_ks))
+        sv_sketch = sv_srv.kselect("spread", sv_ks[0], tier="sketch")
+        v_lo, v_hi = sv_sketch.value_bounds
+        check(
+            "serve sketch bounds bracket the exact answer",
+            bool(v_lo <= sv_want[sv_ks[0]] <= v_hi),
+            True,
+        )
+        sv_width = sv_obs.metrics.histogram("serve.batch_width").as_dict()
+        sv_cache = sv_srv.collect_metrics().as_dict()
+        print(
+            "  serve snapshot: "
+            f"batch_width={{count: {sv_width['count']}, "
+            f"mean: {round(sv_width['mean'], 2) if sv_width['count'] else None}, "
+            f"max: {sv_width['max']}}}, "
+            f"program_cache={{hits: {sv_cache['serve.program_cache.hits']['value']}, "
+            f"misses: {sv_cache['serve.program_cache.misses']['value']}}}, "
+            f"escalations={esc}"
+        )
+
     if failures:
         print(f"tpu_smoke: {len(failures)} FAILURES")
         return 1
