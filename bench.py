@@ -470,26 +470,39 @@ def bench_streaming_oc(on_tpu: bool):
         streaming_kselect,
         streaming_rank_certificate,
     )
+    from mpi_k_selection_tpu.streaming.executor import collect_hidden_frac
     from mpi_k_selection_tpu.streaming.pipeline import ingest_hidden_frac
     from mpi_k_selection_tpu.utils.profiling import PhaseTimer
 
     from mpi_k_selection_tpu.streaming.pipeline import STAGING_POOL
 
     def _obs_snapshot(o, pool_before):
-        """Compact embed of the run's metrics registry: occupancy,
-        StagingPool hit rate, stall seconds, chunks/bytes per device —
-        the numbers the TPU validation sweep needs alongside wall time.
-        The registry mirrors the MODULE pool's process-lifetime counters;
-        ``pool_before`` (hits, misses) rebases them to THIS run's deltas
-        so the record is per-run, not cumulative across warmups/records."""
+        """Compact embed of the run's metrics registry: occupancy (total
+        AND per executor phase — the descent/collect split is the deferred
+        executor's before/after evidence), StagingPool hit rate, stall
+        seconds, chunks/bytes per device — the numbers the TPU validation
+        sweep needs alongside wall time. The registry mirrors the MODULE
+        pool's process-lifetime counters; ``pool_before`` (hits, misses)
+        rebases them to THIS run's deltas so the record is per-run, not
+        cumulative across warmups/records."""
         snap = o.metrics.as_dict()
         occ = snap.get("inflight.occupancy", {})
         hits = snap.get("staging_pool.hits", {}).get("value", 0)
         misses = snap.get("staging_pool.misses", {}).get("value", 0)
+        by_phase = {}
+        for m in o.metrics.metrics():
+            if m.name == "inflight.occupancy" and m.labels:
+                ph = dict(m.labels).get("phase", "?")
+                by_phase[ph] = {
+                    "count": m.count,
+                    "mean": round(m.mean, 4) if m.count else None,
+                    "max": m.max,
+                }
         return {
             "inflight_occupancy": {
                 k: occ.get(k) for k in ("count", "mean", "max")
             },
+            "occupancy_by_phase": by_phase,
             "staging_pool_hits": hits - pool_before[0],
             "staging_pool_misses": misses - pool_before[1],
             "pipeline_stall_seconds": snap.get(
@@ -501,6 +514,14 @@ def bench_streaming_oc(on_tpu: bool):
                 if m.name == "ingest.chunks"
             },
         }
+
+    def _collect_frac(o, window):
+        """collect_hidden_frac off one run's labeled collect histogram."""
+        occ = o.metrics.histogram(
+            "inflight.occupancy", labels={"phase": "collect"}
+        )
+        frac = collect_hidden_frac(occ, window)
+        return round(frac, 4) if frac is not None else None
 
     n, chunk = (1 << 33, 1 << 27) if on_tpu else (1 << 22, 1 << 19)
     nchunks = n // chunk
@@ -606,14 +627,34 @@ def bench_streaming_oc(on_tpu: bool):
         sp_source, sp_k, radix_bits=sp_rb, collect_budget=sp_budget,
         spill="off",
     )
+    # the deferred executor's before/after on THIS record: the primary
+    # timed run uses the deferred default; a second spill run with
+    # deferred="off" (the pre-executor eager tee/collect) supplies
+    # `eager_seconds`, and the obs registries supply the per-phase window
+    # occupancy + collect_hidden_frac. Run across every local device when
+    # there is more than one — the serialization only shows p-wide
+    import jax as _jax
+
+    sp_ndev = len(_jax.devices())
+    sp_devices = sp_ndev if sp_ndev > 1 else None
+    obs_sp = Observability(metrics=MetricsRegistry())
     with SpillStore() as sp_store:
         t0 = time.perf_counter()
         ans_spill = streaming_kselect(
             sp_source, sp_k, radix_bits=sp_rb, collect_budget=sp_budget,
-            spill=sp_store,
+            spill=sp_store, devices=sp_devices, obs=obs_sp,
         )
         sp_s = time.perf_counter() - t0
         sp_passes = list(sp_store.pass_log)
+    obs_sp_eager = Observability(metrics=MetricsRegistry())
+    with SpillStore() as sp_store_eager:
+        t0 = time.perf_counter()
+        ans_spill_eager = streaming_kselect(
+            sp_source, sp_k, radix_bits=sp_rb, collect_budget=sp_budget,
+            spill=sp_store_eager, devices=sp_devices, deferred="off",
+            obs=obs_sp_eager,
+        )
+        sp_eager_s = time.perf_counter() - t0
     # one-shot leg: the same stream as a consumed generator, spill=auto —
     # the lifted replayable-source requirement must yield the SAME bits
     ans_oneshot = streaming_kselect(
@@ -631,7 +672,10 @@ def bench_streaming_oc(on_tpu: bool):
         if len(spill_reads) >= 2
         else 0.0
     )
-    exact_sp = int(ans_spill) == int(ans_off) == int(ans_oneshot)
+    exact_sp = (
+        int(ans_spill) == int(ans_off) == int(ans_oneshot)
+        == int(ans_spill_eager)
+    )
     _emit(
         {
             "metric": "kselect_streaming_oc_spill",
@@ -643,7 +687,23 @@ def bench_streaming_oc(on_tpu: bool):
             "chunk_elems": sp_chunk,
             "radix_bits": sp_rb,
             "collect_budget": sp_budget,
+            "devices": sp_ndev,
             "seconds": round(sp_s, 6),
+            # deferred-executor before/after (ISSUE 8): eager is the
+            # pre-executor consumption discipline on the SAME config; on
+            # the CPU CI mesh all virtual devices share one core, so the
+            # wall-clock ratio needs TPU validation — the occupancy
+            # split is the CI-provable half of the contract
+            "deferred": "on",
+            "eager_seconds": round(sp_eager_s, 6),
+            "deferred_speedup": round(sp_eager_s / sp_s, 3) if exact_sp else 0.0,
+            "collect_hidden_frac": _collect_frac(obs_sp, sp_ndev),
+            "occupancy_by_phase": _obs_snapshot(obs_sp, (0, 0))[
+                "occupancy_by_phase"
+            ],
+            "occupancy_by_phase_eager": _obs_snapshot(obs_sp_eager, (0, 0))[
+                "occupancy_by_phase"
+            ],
             "_spill": {
                 "passes": sp_passes,
                 "bytes_streamed_per_pass": [p["bytes_read"] for p in sp_passes],
@@ -683,7 +743,18 @@ def bench_streaming_oc(on_tpu: bool):
         )
         md_s = time.perf_counter() - t0
         hidden_md = ingest_hidden_frac(timer_md)
-        exact_md = int(ans_md) == int(ans_sync) == int(ans)
+        # eager (deferred="off") leg on the same stream: the pre-executor
+        # consumption discipline, the denominator of `deferred_speedup`
+        obs_md_eager = Observability(metrics=MetricsRegistry())
+        t0 = time.perf_counter()
+        ans_md_eager = streaming_kselect(
+            source, k, pipeline_depth=2, devices=ndev, deferred="off",
+            obs=obs_md_eager,
+        )
+        md_eager_s = time.perf_counter() - t0
+        exact_md = (
+            int(ans_md) == int(ans_sync) == int(ans) == int(ans_md_eager)
+        )
         _emit(
             {
                 "metric": (
@@ -703,6 +774,15 @@ def bench_streaming_oc(on_tpu: bool):
                 "seconds": round(md_s, 6),
                 "singledev_seconds": round(dt, 6),
                 "device_scaling": round(dt / md_s, 3) if exact_md else 0.0,
+                "deferred": "on",
+                "eager_seconds": round(md_eager_s, 6),
+                "deferred_speedup": (
+                    round(md_eager_s / md_s, 3) if exact_md else 0.0
+                ),
+                "collect_hidden_frac": _collect_frac(obs_md, ndev),
+                "occupancy_by_phase_eager": _obs_snapshot(
+                    obs_md_eager, (0, 0)
+                )["occupancy_by_phase"],
                 "ingest_hidden_frac": (
                     round(hidden_md, 4) if hidden_md is not None else 0.0
                 ),

@@ -1,4 +1,4 @@
-"""AST lint rules (KSL001-KSL010) — each encodes a bug class a human
+"""AST lint rules (KSL001-KSL011) — each encodes a bug class a human
 reviewer caught in this repository at least once. docs/ANALYSIS.md holds
 the catalog with the historical incident behind every rule.
 
@@ -740,3 +740,54 @@ class ServeHandlerCompile(Rule):
                             "serve/registry.py's ProgramCache, not on "
                             "handler paths"
                         )
+
+
+# ---------------------------------------------------------------------------
+# KSL011 — eager device gathers on streaming chunk-consume paths
+
+
+@register
+class StreamingEagerDeviceGather(Rule):
+    id = "KSL011"
+    title = "eager np.asarray of a masked/indexed device array in streaming/ outside executor.py"
+    rationale = (
+        "`np.asarray(kv[m])` (or `jax.device_get` of an indexed device "
+        "value) at chunk-consume time blocks the consumer on a "
+        "device->host sync PER CHUNK: the boolean gather's output shape "
+        "is data-dependent, so jax must materialize it eagerly, and on a "
+        "multi-device pass the p-wide in-flight window degrades toward "
+        "serial on exactly the biggest reads — the r6 finding that "
+        "serialized the spill tee and the survivor collect. The async "
+        "executor (streaming/executor.py) is the ONE sanctioned home for "
+        "that gather: it wraps the eager form as the deferred=off oracle "
+        "and replaces it with a fixed-shape device-side compaction whose "
+        "host materialization happens when the FIFO window pops. Any "
+        "other asarray-of-a-subscript in the streaming layer reintroduces "
+        "the serialization the executor retired."
+    )
+
+    _SYNC_CALLS = {"np.asarray", "numpy.asarray", "jax.device_get", "device_get"}
+    _SANCTIONED = ("streaming/executor.py",)
+
+    def check_module(self, mod: SourceModule):
+        p = pathlib.Path(mod.path).resolve().as_posix()
+        if "/streaming/" not in p or _is_test_file(mod):
+            return
+        if _path_endswith(mod, *self._SANCTIONED):
+            return  # the deferral surface owns the (oracle) eager gather
+        for node in ast.walk(mod.tree):
+            if (
+                isinstance(node, ast.Call)
+                and dotted_name(node.func) in self._SYNC_CALLS
+                and node.args
+                and isinstance(node.args[0], ast.Subscript)
+            ):
+                yield node.lineno, (
+                    f"`{dotted_name(node.func)}` of an indexed/masked array "
+                    "on a streaming chunk path — an eager per-chunk "
+                    "device->host gather; route it through the async "
+                    "executor's deferred compaction "
+                    "(streaming/executor.py: dispatch_compaction / "
+                    "materialize_compacted) so the transfer happens when "
+                    "the FIFO window pops"
+                )

@@ -15,8 +15,10 @@ asserts a property every review round has had to re-derive by hand:
   Covers the streaming device/host histogram boundary: every per-chunk
   device count program of the (multi-device) staged ingest — chunked
   single-/multi-prefix and the sketch deep fold — stays int32, the
-  cross-chunk host merge int64, and the multi-device collect filter
-  stays a bool predicate — at two chunk sizes.
+  cross-chunk host merge int64, the multi-device collect filter stays a
+  bool predicate, and the deferred executor's compaction keeps an int32
+  survivor count and a dtype-preserving compacted buffer — at two chunk
+  sizes.
 - **KSC103 jaxpr stability across batch sizes**: the same kernel traced
   at nearby n produces the identical primitive sequence — a divergence
   means some Python-level branch depends on n in a way that recompiles
@@ -166,20 +168,20 @@ def _streaming_ingest_cases():
 
 
 def _streaming_collect_mask_cases():
-    """The survivor-collect filter program the multi-device collect pass
-    dispatches on each staged chunk's own device
-    (streaming/chunked.py:_collect_survivors): a shift-compare PREDICATE.
-    It must trace to a bool mask (an integer-typed compare would silently
-    widen per-device memory and change the gather semantics), and its
-    trail must be stable across chunk LENGTHS: unlike the histogram
-    programs, the runtime filter runs over ``StagedKeys.valid()`` — a
-    per-``n_valid`` slice, not the padded bucket — so the grid pairs a
-    pow2 bucket size with a ragged valid-slice size (each distinct length
-    still costs one XLA compile per device; the contract gates program
-    STRUCTURE keying on n, which would make that cost a recompile storm)."""
+    """The survivor-collect filter PREDICATE the eager (``deferred=off``)
+    collect/tee paths run on each staged chunk's own device
+    (streaming/executor.py:prefix_mask): a shift-compare. It must trace to
+    a bool mask (an integer-typed compare would silently widen per-device
+    memory and change the gather semantics), and its trail must be stable
+    across chunk LENGTHS: the eager filter runs over
+    ``StagedKeys.valid()`` — a per-``n_valid`` slice, not the padded
+    bucket — so the grid pairs a pow2 bucket size with a ragged
+    valid-slice size (each distinct length still costs one XLA compile per
+    device; the contract gates program STRUCTURE keying on n, which would
+    make that cost a recompile storm)."""
     import jax
 
-    path = "mpi_k_selection_tpu/streaming/chunked.py"
+    path = "mpi_k_selection_tpu/streaming/executor.py"
 
     def collect_mask(u):
         return jax.lax.shift_right_logical(
@@ -194,6 +196,45 @@ def _streaming_collect_mask_cases():
             "uint32",
             # a staging bucket AND a ragged valid-slice length
             (_STREAMING_INGEST_SIZES[0], _STREAMING_INGEST_SIZES[0] + 311),
+        ),
+    ]
+
+
+def _streaming_compaction_cases():
+    """The deferred executor's mask -> count -> fixed-shape compaction
+    (streaming/executor.py:_compact_core) — the program the collect and
+    the spill tee dispatch per staged chunk under ``deferred``. Its
+    survivor count must be the per-chunk int32 partial (the streaming
+    counter discipline: chunk < 2^31), the compacted output must preserve
+    the key dtype, and — because it runs over the WHOLE padded bucket
+    with ``n_valid`` and the ``(shift, prefix)`` specs as traced scalars —
+    its primitive trail must be identical across bucket sizes (one XLA
+    compile per (bucket, dtype, #specs), the KSC103 contract the deferral
+    design leans on)."""
+    import numpy as np
+
+    from mpi_k_selection_tpu.streaming.executor import _compact_core
+
+    path = "mpi_k_selection_tpu/streaming/executor.py"
+
+    def compact(u):
+        # two specs at distinct resolved depths: the union-mask (spill
+        # tee) shape; a single-spec (collect) program is the same trace
+        # with a shorter unrolled union loop
+        return _compact_core(
+            u,
+            np.int32(u.shape[0] - 7),
+            np.asarray([24, 16], np.uint32),
+            np.asarray([0, 3], np.uint32),
+        )
+
+    return [
+        (
+            path,
+            "streaming deferred compaction[uint32, 2 specs]",
+            compact,
+            "uint32",
+            _STREAMING_INGEST_SIZES,
         ),
     ]
 
@@ -368,6 +409,26 @@ def check_counter_width() -> list[Finding]:
                             f"{label} n={n}: survivor filter traced as "
                             f"{cdt}, want bool")
                 )
+    # the deferred compaction: survivor count is the per-chunk int32
+    # partial, the compacted buffer preserves the key dtype (a widened
+    # compaction would silently double per-device memory; a narrowed one
+    # is the KSL002 truncation class on device)
+    for case_path, label, fn, dt, sizes in _streaming_compaction_cases():
+        for n in sizes:
+            out, cnt = jax.eval_shape(fn, _spec(n, dt))
+            if np.dtype(out.dtype) != np.dtype(dt):
+                findings.append(
+                    Finding("KSC102", case_path, 0,
+                            f"{label} n={n}: compacted survivors traced as "
+                            f"{np.dtype(out.dtype)}, want {dt}")
+                )
+            if np.dtype(cnt.dtype) != np.dtype(np.int32):
+                findings.append(
+                    Finding("KSC102", case_path, 0,
+                            f"{label} n={n}: survivor count traced as "
+                            f"{np.dtype(cnt.dtype)}, want the int32 "
+                            "per-chunk partial")
+                )
     # host-merge side (numpy method — host-only, nothing touches a device):
     # both the single- and multi-prefix merge inputs must already be int64
     kdt = np.dtype(np.uint32)
@@ -439,6 +500,7 @@ def check_jaxpr_stability() -> list[Finding]:
     # filter predicate is on the grid for the same reason
     cases += _streaming_ingest_cases()
     cases += _streaming_collect_mask_cases()
+    cases += _streaming_compaction_cases()
     for path, label, fn, dt, (n1, n2) in cases:
         t1 = _primitive_trail(jax.make_jaxpr(fn)(_spec(n1, dt)))
         t2 = _primitive_trail(jax.make_jaxpr(fn)(_spec(n2, dt)))

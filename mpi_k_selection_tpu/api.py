@@ -303,17 +303,24 @@ def kselect_streaming(source, k, **kwargs):
     default, spills exactly for those; ``"force"`` always; ``"off"``
     keeps today's replay path and rejects one-shot sources;
     ``spill_dir`` roots the temp store). Answers are bit-identical to
-    ``spill="off"`` in every mode.
+    ``spill="off"`` in every mode. ``deferred`` (default ``"auto"`` = on)
+    runs the per-chunk consumers — histogram merge, survivor collect,
+    rank-certificate folds, spill tee — under the async streaming
+    executor (streaming/executor.py): staged chunks dispatch fixed-shape
+    device-side compactions whose host materialization happens when the
+    p-wide FIFO window pops, so multi-device collect/spill passes scale
+    like the histogram passes instead of serializing on per-chunk eager
+    gathers; ``"off"`` is the historical eager path, bit-identical.
 
     ``obs`` (an :class:`~mpi_k_selection_tpu.obs.Observability`) turns on
     the descent telemetry — typed per-pass/per-chunk events, a metrics
-    registry (occupancy, stall seconds, bytes per device), and
-    producer/consumer trace spans — with a bit-identical-answers
-    guarantee (docs/OBSERVABILITY.md). See
+    registry (occupancy per executor phase, stall seconds, bytes per
+    device), and producer/consumer trace spans — with a
+    bit-identical-answers guarantee (docs/OBSERVABILITY.md). See
     streaming/chunked.py:streaming_kselect for the full option set
     (``radix_bits``, ``hist_method``, ``collect_budget``, ``sketch``,
     ``pipeline_depth``, ``timer``, ``devices``, ``spill``, ``spill_dir``,
-    ``obs``)."""
+    ``deferred``, ``obs``)."""
     from mpi_k_selection_tpu.streaming.chunked import streaming_kselect
 
     return streaming_kselect(source, k, **kwargs)
@@ -334,7 +341,11 @@ class StreamingQuantiles:
     compute (streaming/pipeline.py; 0 = synchronous, bit-identical).
     ``devices`` spreads that ingest round-robin across chips (None/1 =
     single device; answers and sketches stay bit-identical for every
-    device count — see streaming/chunked.py)."""
+    device count — see streaming/chunked.py). ``deferred`` picks the
+    executor discipline for the exact refinement passes
+    (streaming/executor.py; default auto = deferred device-side
+    compaction, ``"off"`` the historical eager gathers — bit-identical
+    either way)."""
 
     def __init__(
         self,
@@ -344,8 +355,13 @@ class StreamingQuantiles:
         levels: int = 4,
         pipeline_depth: int | None = None,
         devices=None,
+        deferred=None,
         obs=None,
     ):
+        from mpi_k_selection_tpu.streaming.executor import (
+            DEFAULT_DEFERRED,
+            resolve_deferred,
+        )
         from mpi_k_selection_tpu.streaming.pipeline import (
             resolve_stream_devices,
             validate_pipeline_depth,
@@ -355,6 +371,10 @@ class StreamingQuantiles:
         self.pipeline_depth = validate_pipeline_depth(pipeline_depth)
         resolve_stream_devices(devices)  # validate eagerly, like depth
         self.devices = devices
+        #: executor discipline for the exact refinement passes
+        #: (streaming/executor.py; None resolves to the package default)
+        self.deferred = DEFAULT_DEFERRED if deferred is None else deferred
+        resolve_deferred(self.deferred)  # validate eagerly, like depth
         #: optional Observability bundle threaded through update_stream
         #: and refine_quantiles (off = None, the default)
         self.obs = obs
@@ -392,6 +412,7 @@ class StreamingQuantiles:
             levels=self.sketch.levels,
             pipeline_depth=self.pipeline_depth,
             devices=self.devices,
+            deferred=self.deferred,
             obs=self.obs,
         )
         out.sketch = self.sketch.merge(
@@ -422,6 +443,7 @@ class StreamingQuantiles:
             sketch=self.sketch,
             pipeline_depth=self.pipeline_depth,
             devices=self.devices,
+            deferred=self.deferred,
             obs=self.obs,
         )
 

@@ -143,6 +143,17 @@ def build_parser() -> argparse.ArgumentParser:
         "worst-case footprint ~2x the stream's key bytes (~3x for "
         "caller-owned stores that keep their pass-0 generation)",
     )
+    p.add_argument(
+        "--deferred", choices=("auto", "on", "off"), default="auto",
+        help="--streaming per-chunk consumption discipline (the async "
+        "executor, streaming/executor.py): auto/on (default) dispatch "
+        "each staged chunk's survivor filters (collect, spill tee) and "
+        "certificate counts as device-side fixed-shape programs that "
+        "materialize host-side only when the p-wide FIFO window pops — "
+        "multi-device collect/spill passes scale like the histogram "
+        "passes; off = the historical eager gather at chunk-arrival "
+        "time. Answers are bit-identical in every mode",
+    )
     p.add_argument("--repeats", type=int, default=1)
     p.add_argument("--verify", action="store_true", help="check against the seq oracle")
     p.add_argument(
@@ -386,6 +397,7 @@ def _run_streaming(args, obs=None):
         devices=devices,
         spill=spill_store if spill_store is not None else args.spill,
         spill_dir=args.spill_dir,
+        deferred=args.deferred,
         obs=obs,
     )
     try:
@@ -407,6 +419,7 @@ def _run_streaming(args, obs=None):
         record.extra["pipeline_depth"] = depth
         record.extra["ingest_devices"] = n_ingest
         record.extra["spill"] = args.spill
+        record.extra["deferred"] = args.deferred
         if spill_store is not None:
             record.extra["spill_passes"] = list(spill_store.pass_log)
         if ptimer is not None and ptimer.phases:
@@ -456,7 +469,8 @@ def _run_streaming(args, obs=None):
                 cert_obs = obs_lib.Observability(trace=obs.trace)
             less, leq = streaming_rank_certificate(
                 spill_store if spill_store is not None else source,
-                answer, pipeline_depth=depth, devices=devices, obs=cert_obs,
+                answer, pipeline_depth=depth, devices=devices,
+                deferred=args.deferred, obs=cert_obs,
             )
             cert_ok = less < k <= leq
             record.extra["rank_certificate"] = [less, leq]

@@ -246,6 +246,12 @@ def check_stream_invariants(events, spill_pass_log=None) -> None:
       (``bucket_total == keys_read``); later passes count only the
       surviving active-prefix populations, so ``bucket_total`` is bounded
       by ``keys_read`` and non-increasing pass over pass;
+    - the terminal collect event carries the honest per-spec accounting
+      (the executor knows every spec's survivor count at drain time):
+      ``survivors`` aligns with ``prefixes`` one collected population per
+      spec, each >= 1 (a collect spec is a walked bucket holding the
+      rank), ``bucket_total`` is their sum and ``bucket_max`` their max,
+      all bounded by that pass's ``keys_read``;
     - chunk events: per-pass chunk indices 0..chunks-1 in order, sizes
       summing to ``keys_read``, staged slots well-formed;
     - with ``spill_pass_log`` (a ``SpillStore.pass_log``): the events'
@@ -262,6 +268,25 @@ def check_stream_invariants(events, spill_pass_log=None) -> None:
     prev = None
     for e in passes:
         if e.pass_index == "collect":
+            assert len(e.survivors) == len(e.prefixes), (
+                f"collect: {len(e.survivors)} survivor populations for "
+                f"{len(e.prefixes)} specs"
+            )
+            assert all(s >= 1 for s in e.survivors), (
+                f"collect: empty spec population in {e.survivors} — every "
+                "collect spec is a walked bucket holding its rank"
+            )
+            assert e.bucket_total == sum(e.survivors), (
+                f"collect: bucket_total {e.bucket_total} != "
+                f"sum(survivors) {sum(e.survivors)}"
+            )
+            assert e.bucket_max == max(e.survivors, default=0), (
+                f"collect: bucket_max {e.bucket_max} != max(survivors)"
+            )
+            assert e.bucket_total <= e.keys_read, (
+                f"collect: collected {e.bucket_total} exceeds keys_read "
+                f"{e.keys_read}"
+            )
             continue
         assert len(e.survivors) >= 1, f"pass {e.pass_index}: no survivors tuple"
         assert all(0 <= s <= e.keys_read for s in e.survivors), (

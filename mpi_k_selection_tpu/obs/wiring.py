@@ -34,11 +34,37 @@ def staged_slot(keys, devs):
     return None
 
 
-def window_occupancy(obs):
-    """The InflightWindow occupancy histogram when metrics are on."""
-    if obs is not None and obs.metrics is not None:
-        return obs.metrics.histogram("inflight.occupancy")
-    return None
+class _FanoutHistogram:
+    """Observes into several histograms at once — the aggregate
+    ``inflight.occupancy`` plus its per-phase labeled twin, so the
+    executor's collect/certificate/sketch windows stay separable from the
+    histogram passes' without breaking the historical unlabeled series."""
+
+    __slots__ = ("_hists",)
+
+    def __init__(self, hists):
+        self._hists = tuple(hists)
+
+    def observe(self, value) -> None:
+        for h in self._hists:
+            h.observe(value)
+
+
+def window_occupancy(obs, phase: str | None = None):
+    """The InflightWindow occupancy handle when metrics are on: the
+    unlabeled aggregate histogram, fanned out to
+    ``inflight.occupancy{phase=...}`` when the caller names its executor
+    phase (``descent`` | ``collect`` | ``certificate`` | ``sketch``) —
+    the per-pass window utilization the deferred-executor before/after
+    evidence reads (bench_streaming_oc's ``collect_hidden_frac``)."""
+    if obs is None or obs.metrics is None:
+        return None
+    base = obs.metrics.histogram("inflight.occupancy")
+    if phase is None:
+        return base
+    return _FanoutHistogram(
+        (base, obs.metrics.histogram("inflight.occupancy", labels={"phase": phase}))
+    )
 
 
 def attach_timer(obs, timer):

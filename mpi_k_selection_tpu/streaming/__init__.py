@@ -35,12 +35,19 @@ from mpi_k_selection_tpu.streaming.chunked import (
     streaming_kselect_many,
     streaming_rank_certificate,
 )
+from mpi_k_selection_tpu.streaming.executor import (
+    DEFAULT_DEFERRED,
+    StreamExecutor,
+    collect_hidden_frac,
+    resolve_deferred,
+)
 from mpi_k_selection_tpu.streaming.pipeline import (
     DEFAULT_PIPELINE_DEPTH,
     ChunkPipeline,
     StagedKeys,
     StagingPool,
     ingest_hidden_frac,
+    live_staged_keys,
     resolve_stream_devices,
 )
 from mpi_k_selection_tpu.streaming.sketch import RadixSketch
@@ -53,6 +60,7 @@ from mpi_k_selection_tpu.streaming.spill import (
 
 __all__ = [
     "ChunkPipeline",
+    "DEFAULT_DEFERRED",
     "DEFAULT_PIPELINE_DEPTH",
     "DEFAULT_SPILL",
     "RadixSketch",
@@ -62,8 +70,12 @@ __all__ = [
     "SpillStore",
     "StagedKeys",
     "StagingPool",
+    "StreamExecutor",
     "as_chunk_source",
+    "collect_hidden_frac",
     "ingest_hidden_frac",
+    "live_staged_keys",
+    "resolve_deferred",
     "resolve_stream_devices",
     "streaming_kselect",
     "streaming_kselect_many",

@@ -34,7 +34,7 @@ path, kept as the correctness oracle; both return bit-identical answers.
 With ``devices`` > 1 the pipelined passes also spread across chips: the
 producer stages chunk *j* onto ``devices[j % p]`` (round-robin) and the
 consumer keeps one histogram dispatch in flight per device
-(:class:`_HistogramWindow`), merging the per-device int32 partials into
+(streaming/executor.py:StreamExecutor), merging the per-device int32 partials into
 the host int64 accumulator strictly in chunk order — the pipelined twin
 of ``parallel/sketch.py:distributed_sketch``'s psum merge, and because
 the merge order is fixed (and int64 addition is exact), answers stay
@@ -51,6 +51,21 @@ generation read (~N·(2 + 1/2^b + ...) total bytes instead of ~passes·N)
 and one-shot sources become first-class. ``spill="off"`` is the pure
 replay path, bit-identical to the spill path at every devices x depth
 combination.
+
+Per-chunk consumption — the histogram merge, the survivor collect, the
+rank-certificate count folds, and the spill tee — runs under ONE
+event-driven scheduler (streaming/executor.py:StreamExecutor) with
+**deferred host transfers** (the ``deferred`` knob): each staged chunk's
+work dispatches as a device-side handle (for the collect and the tee, a
+jit-compiled mask -> count -> fixed-shape compaction per staging bucket)
+and materializes host-side only when the in-flight FIFO window pops —
+so on a multi-device pass the consumer no longer blocks per chunk on an
+eager boolean gather, and the staged buffer is released exactly when its
+last in-flight result lands. ``deferred="off"`` is the pre-executor
+eager path; answers are bit-identical across the whole devices x depth x
+spill x deferred grid. With deferral on, spill generation reads also use
+mmap-backed record payloads (no per-record heap copy of the bytes the
+device filter is about to discard).
 """
 
 from __future__ import annotations
@@ -62,8 +77,10 @@ import numpy as np
 from mpi_k_selection_tpu.obs import events as _ev
 from mpi_k_selection_tpu.obs import metrics as _om
 from mpi_k_selection_tpu.obs import wiring as _wr
+from mpi_k_selection_tpu.streaming import executor as _ex
 from mpi_k_selection_tpu.streaming import pipeline as _pl
 from mpi_k_selection_tpu.streaming import spill as _sp
+from mpi_k_selection_tpu.streaming.executor import DEFAULT_DEFERRED
 from mpi_k_selection_tpu.streaming.pipeline import DEFAULT_PIPELINE_DEPTH, StagedKeys
 from mpi_k_selection_tpu.utils import dtypes as _dt
 
@@ -118,21 +135,23 @@ class _OneShotSource:
         return self._it
 
 
-def as_chunk_source(source, *, one_shot_ok: bool = False):
+def as_chunk_source(source, *, one_shot_ok: bool = False, mmap: bool = False):
     """Normalize ``source`` to a zero-arg callable returning a fresh chunk
     iterator — the replayable form every streaming pass needs.
 
     Accepted: a list/tuple of arrays, a single array (one chunk), a
     zero-arg callable returning an iterable of arrays, or a
     :class:`~mpi_k_selection_tpu.streaming.spill.SpillStore` with a
-    committed generation (replayed from disk). A bare one-shot
-    iterator/generator is accepted only under ``one_shot_ok`` (the spill
-    descent: pass 0 tees it to disk and never reads it again); otherwise
-    it is rejected with instructions — exact selection re-reads the
-    stream once per radix pass, which a consumed generator cannot serve.
+    committed generation (replayed from disk; ``mmap`` selects mmap-backed
+    record payload reads — the deferred executor's replay mode). A bare
+    one-shot iterator/generator is accepted only under ``one_shot_ok``
+    (the spill descent: pass 0 tees it to disk and never reads it again);
+    otherwise it is rejected with instructions — exact selection re-reads
+    the stream once per radix pass, which a consumed generator cannot
+    serve.
     """
     if isinstance(source, _sp.SpillStore):
-        return source.latest_generation().as_source()
+        return source.latest_generation().as_source(mmap=mmap)
     if callable(source):
         return source
     if isinstance(source, (list, tuple)):
@@ -287,132 +306,12 @@ def resolve_stream_hist(hist_method: str, dtype) -> str:
     return hist_method
 
 
-def _dispatch_chunk_histograms(keys, shift, radix_bits, prefixes, method, kdt):
-    """DISPATCH one chunk's digit histogram(s) at ``shift`` for every
-    prefix in ``prefixes`` (``None`` = no filter) and return an in-flight
-    handle for :func:`_finish_chunk_histograms` — the chunk-side work is
-    paid ONCE and shared across prefixes: host chunks compute the
-    digit/prefix arrays once, device chunks cross the tunnel once and stay
-    on device for the counts (the whole point on TPU); only the
-    (2**radix_bits,) counts per prefix come back at finish time.
-
-    Device work is dispatched asynchronously on the chunk's OWN device
-    (jax async dispatch; :class:`~mpi_k_selection_tpu.streaming.pipeline.
-    StagedKeys` are committed to their round-robin slot, so up to one
-    dispatch per ingest device runs concurrently under
-    :class:`_HistogramWindow`). The ``"numpy"`` method computes host-side
-    immediately — there is nothing to overlap.
-
-    Pipelined passes hand in :class:`StagedKeys` — a pow2-padded,
-    already-device-resident buffer. The histogram runs over the WHOLE
-    padded buffer (fixed shape, one compile per bucket size) and the pad
-    contribution is subtracted host-side at finish: pad keys are key-space
-    0, so they land in digit bucket 0 and only under the all-zero prefix —
-    an exact integer correction."""
-    staged = isinstance(keys, StagedKeys)
-    if method == "numpy":
-        if staged:  # pragma: no cover - staging only feeds device methods
-            keys = np.asarray(keys.valid())
-        k = keys if isinstance(keys, np.ndarray) else np.asarray(keys)
-        dig = ((k >> kdt.type(shift)) & kdt.type((1 << radix_bits) - 1)).astype(
-            np.int64
-        )
-        nb = 1 << radix_bits
-        if len(prefixes) == 1 and prefixes[0] is None:
-            return (None, {None: np.bincount(dig, minlength=nb).astype(np.int64)})
-        up = k >> kdt.type(shift + radix_bits)
-        return (
-            None,
-            {
-                p: np.bincount(dig[up == kdt.type(p)], minlength=nb).astype(np.int64)
-                for p in prefixes
-            },
-        )
-    import jax.numpy as jnp
-
-    from mpi_k_selection_tpu.ops.histogram import (
-        masked_radix_histogram,
-        multi_masked_radix_histogram,
-    )
-
-    dk = keys.data if staged else jnp.asarray(keys)  # ksel: noqa[KSL002] -- 64-bit keys only reach this device branch with x64 on: resolve_stream_hist routes them to the host 'numpy' method otherwise
-    if len(prefixes) == 1 and prefixes[0] is None:
-        h = masked_radix_histogram(
-            dk,
-            shift=shift,
-            radix_bits=radix_bits,
-            prefix=None,
-            method=method,
-            count_dtype=jnp.int32,  # exact per chunk (chunk size < 2^31)
-        )
-    else:
-        # the shared-sweep primitive of the resident multi-rank descent: on
-        # the pallas methods all K prefix queries ride ONE read of the chunk
-        # (other methods fall back to K single-prefix sweeps — correct,
-        # just K reads)
-        h = multi_masked_radix_histogram(
-            dk,
-            shift=shift,
-            radix_bits=radix_bits,
-            prefixes=np.asarray(prefixes, kdt),
-            method=method,
-            count_dtype=jnp.int32,
-        )
-    return ((keys if staged else None, list(prefixes), h), None)
-
-
-def _finish_chunk_histograms(handle):
-    """Materialize one :func:`_dispatch_chunk_histograms` handle into the
-    ``{prefix: int64 histogram}`` dict: block on the device counts, widen
-    to the host int64 accumulator dtype, apply the exact pad correction,
-    and release (donate) the staged ring slot."""
-    inflight, done = handle
-    if done is not None:
-        return done
-    staged, prefixes, h = inflight
-    if len(prefixes) == 1 and prefixes[0] is None:
-        out = {None: np.asarray(h).astype(np.int64)}
-    else:
-        hk = np.asarray(h).astype(np.int64)
-        out = {p: hk[i] for i, p in enumerate(prefixes)}
-    if staged is not None:
-        if staged.pad:
-            # pad keys are key-space 0: digit (0 >> shift) & mask == 0, and
-            # they pass a prefix filter only when every upper bit is 0
-            for p, hist in out.items():
-                if p is None or int(p) == 0:
-                    hist[0] -= staged.pad
-        # the counts above are host-materialized (np.asarray blocked on
-        # them), so the ring slot can be donated back eagerly instead of
-        # waiting out the queue's references
-        staged.release()
-    return out
-
-
-def _chunk_histograms(keys, shift, radix_bits, prefixes, method, kdt):
-    """Dispatch + finish in one step — the serial form the synchronous
-    (depth-0 / single-device) paths and the contract checks use."""
-    return _finish_chunk_histograms(
-        _dispatch_chunk_histograms(keys, shift, radix_bits, prefixes, method, kdt)
-    )
-
-
-class _HistogramWindow(_pl.InflightWindow):
-    """The descent's :class:`~mpi_k_selection_tpu.streaming.pipeline.
-    InflightWindow` specialization: ``push`` dispatches the chunk's
-    histogram(s) and returns a list of ZERO or ONE finished
-    ``{prefix: int64 hist}`` dicts, merged by the callers strictly in
-    chunk order (int64 addition is exact and order-invariant anyway — the
-    window's fixed FIFO order is belt and braces, and keeps the
-    replay-stability diagnostics reproducible)."""
-
-    def __init__(self, window: int, occupancy=None):
-        super().__init__(window, _finish_chunk_histograms, occupancy=occupancy)
-
-    def push(self, keys, shift, radix_bits, prefixes, method, kdt):
-        return super().push(
-            _dispatch_chunk_histograms(keys, shift, radix_bits, prefixes, method, kdt)
-        )
+# the per-chunk device dispatch/finish pair and the FIFO scheduler live in
+# streaming/executor.py (ONE consumption discipline for histogram merge,
+# survivor collect, certificate folds, and the spill tee); these aliases
+# keep the historical import surface (contract checks, tests) working
+_chunk_histograms = _ex.chunk_histograms
+_prefix_mask = _ex.prefix_mask
 
 
 def _np_walk(hist, kk, prefix, radix_bits):
@@ -424,23 +323,6 @@ def _np_walk(hist, kk, prefix, radix_bits):
     kk = int(kk - (cum[b - 1] if b else 0))
     prefix = ((int(prefix) << radix_bits) | b) if prefix is not None else b
     return prefix, kk, int(hist[b])
-
-
-def _prefix_mask(kv, resolved, prefix, kdt, total_bits):
-    """The survivor filter predicate — keys whose top ``resolved`` bits
-    equal ``prefix`` — on ``kv``'s own residency (host numpy, or a device
-    shift-compare tracing to a bool mask). The ONE predicate shared by the
-    survivor collect and the spill tee, so the KSC102/KSC103 contract
-    coverage of its traced program transfers to every caller by
-    construction."""
-    shift = total_bits - resolved
-    if isinstance(kv, np.ndarray):
-        return (kv >> kdt.type(shift)) == kdt.type(prefix)
-    import jax
-
-    return jax.lax.shift_right_logical(
-        kv, kv.dtype.type(shift)
-    ) == kv.dtype.type(prefix)
 
 
 def _hist_summary(hists) -> tuple[int, int, int]:
@@ -458,78 +340,89 @@ def _hist_summary(hists) -> tuple[int, int, int]:
 
 def _collect_survivors(
     src, dtype, specs, *, pipeline_depth=0, timer=None, devices=None,
-    hist_method=None, obs=None, read_from="source",
+    hist_method=None, obs=None, read_from="source", deferred=True,
 ):
     """One streamed pass collecting survivors for EVERY ``(resolved_bits,
     prefix) -> expected population`` spec at once — the shared finish of
     the multi-rank descent (a single-rank descent passes one spec). Keys
-    whose top ``resolved_bits`` equal ``prefix`` survive; device chunks are
-    filtered ON device (eager boolean indexing) so only survivors cross
-    back to the host. Returns ``{spec: host uint key array}``.
+    whose top ``resolved_bits`` equal ``prefix`` survive; device chunks
+    are filtered ON device so only survivors cross back to the host.
+    Returns ``{spec: host uint key array}``.
 
     The single-device pipelined path overlaps produce/encode with the
-    filtering but never stages (``hist_method`` stays ``None``): the
-    collect's device work is a data-dependent gather, not a fixed-shape
-    kernel, so padding buys no compile reuse there. With > 1 ingest
-    device (and a device ``hist_method`` — the host-exact routes keep
-    filtering on host), chunks ARE staged round-robin so each device
-    filters its own resident chunks: the host->device transfer rides the
-    producer thread and only survivors cross back. Survivor order stays
-    the chunk order either way (and the final ``np.partition`` is
-    order-invariant over the collected multiset regardless)."""
+    filtering but never stages (``hist_method`` stays ``None``). With > 1
+    ingest device (and a device ``hist_method`` — the host-exact routes
+    keep filtering on host), chunks ARE staged round-robin so each device
+    filters its own resident chunks. Under ``deferred`` (the default)
+    each staged chunk's filter dispatches as a fixed-shape compaction on
+    its own device and the survivors cross back only when the p-wide
+    FIFO window pops (streaming/executor.py) — the consumer never blocks
+    per chunk, which is what lets the collect pass scale with devices
+    like the histogram passes. ``deferred=False`` keeps the historical
+    eager boolean gather. Survivor multisets are identical either way
+    (and the final ``np.partition`` is order-invariant regardless)."""
     kdt = np.dtype(_dt.key_dtype(dtype))
     total_bits = _dt.key_bits(dtype)
     devs = _pl.resolve_stream_devices(devices)
     multi = len(devs) > 1 and _pl.validate_pipeline_depth(pipeline_depth) > 0
-    out = {s: [] for s in specs}
+    sorted_specs = sorted(specs)
+    collector = _ex.CollectConsumer(
+        sorted_specs, kdt, total_bits, deferred=deferred
+    )
+    ex = _ex.StreamExecutor(
+        [collector], window=len(devs) if multi else 1,
+        occupancy=_wr.window_occupancy(obs, phase="collect"),
+    )
     chunk_i = keys_read = 0
-    with _pl._phase(timer, "descent.collect"), _key_chunk_stream(
-        src, dtype, pipeline_depth=pipeline_depth, timer=timer,
-        hist_method=hist_method if multi else None,
-        devices=devs if multi else None,
-    ) as kc:
-        for keys, _ in kc:
-            if obs is not None:
-                _wr.chunk_event(obs, "collect", chunk_i, keys, kdt, devs)
-            chunk_i += 1
-            keys_read += int(keys.size)
-            staged = isinstance(keys, StagedKeys)
-            kv = keys.valid() if staged else keys
-            host = isinstance(kv, np.ndarray)
-            for resolved, prefix in out:
-                m = _prefix_mask(kv, resolved, prefix, kdt, total_bits)
-                # host indexing, or an eager boolean gather device-side
-                surv = kv[m] if host else np.asarray(kv[m])
-                if surv.size:
-                    out[(resolved, prefix)].append(np.asarray(surv, kdt))
-            if staged:
-                keys.release()
-    if obs is not None:
-        obs.emit(
-            _ev.StreamPassEvent(
-                pass_index="collect",
-                resolved_bits=0,
-                prefixes=tuple(int(p) for _, p in sorted(specs)),
-                chunks=chunk_i,
-                keys_read=keys_read,
-                bytes_read=keys_read * kdt.itemsize,
-                read_from=read_from,
-                bucket_total=0,
-                bucket_max=0,
-                bucket_nonzero=0,
-                survivors=(),
-            )
-        )
-    collected = {}
-    for spec, parts in out.items():
-        c = np.concatenate(parts) if parts else np.empty((0,), kdt)
+    keys = None
+    try:
+        with _pl._phase(timer, "descent.collect"), _key_chunk_stream(
+            src, dtype, pipeline_depth=pipeline_depth, timer=timer,
+            hist_method=hist_method if multi else None,
+            devices=devs if multi else None,
+        ) as kc:
+            for keys, _ in kc:
+                if obs is not None:
+                    _wr.chunk_event(obs, "collect", chunk_i, keys, kdt, devs)
+                chunk_i += 1
+                keys_read += int(keys.size)
+                ex.push(keys)
+            ex.drain()
+    except BaseException:
+        ex.abort()
+        _ex.release_staged(keys)  # the chunk in hand (idempotent)
+        raise
+    collected = collector.collected(kdt)
+    for spec in sorted_specs:
+        c = collected[spec]
         if c.size != specs[spec]:  # pragma: no cover - source changed between passes
             raise RuntimeError(
                 f"chunk source is not replay-stable: collected {c.size} "
                 f"survivors, histogram pass counted {specs[spec]}. The source "
                 "callable must yield identical data on every invocation."
             )
-        collected[spec] = c
+    if obs is not None:
+        # honest terminal accounting: the executor knows every spec's
+        # survivor count at drain time — bucket_total/max/nonzero describe
+        # the collected populations and `survivors` aligns with `prefixes`
+        # (both in sorted-spec order), so check_stream_invariants can hold
+        # the collect event to the same books as the histogram passes
+        sizes = [int(collected[s].size) for s in sorted_specs]
+        obs.emit(
+            _ev.StreamPassEvent(
+                pass_index="collect",
+                resolved_bits=0,
+                prefixes=tuple(int(p) for _, p in sorted_specs),
+                chunks=chunk_i,
+                keys_read=keys_read,
+                bytes_read=keys_read * kdt.itemsize,
+                read_from=read_from,
+                bucket_total=sum(sizes),
+                bucket_max=max(sizes, default=0),
+                bucket_nonzero=sum(1 for s in sizes if s),
+                survivors=tuple(sizes),
+            )
+        )
     return collected
 
 
@@ -537,31 +430,6 @@ def _validate_ks(ks, n):
     for k in ks:
         if not 1 <= k <= n:
             raise ValueError(f"k={k} out of range [1, {n}]")
-
-
-def _spill_tee_survivors(writer, keys, specs, dtype, kdt, total_bits, devs):
-    """Filter ONE chunk to the union of surviving ``(resolved_bits,
-    prefix)`` specs and append the compacted survivors to the next spill
-    generation — the geometric-shrink half of the spill descent. The
-    filter is the survivor-collect predicate (shift-compare -> bool mask,
-    the program KSC102/KSC103 trace), OR-ed over the specs and run on the
-    chunk's OWN device for staged chunks (only survivors cross back to the
-    host); host-exact routes filter host-side. Runs at push time — before
-    the histogram window can ``release()`` the staged buffer."""
-    staged = isinstance(keys, StagedKeys)
-    kv = keys.valid() if staged else keys
-    slot = _wr.staged_slot(keys, devs)
-    m = None
-    for resolved, prefix in specs:
-        mi = _prefix_mask(kv, resolved, prefix, kdt, total_bits)
-        m = mi if m is None else (m | mi)
-    if m is None:  # pragma: no cover - a pass always has >= 1 spec
-        return
-    # host indexing, or an eager boolean gather on the owning device —
-    # only survivors cross back
-    surv = kv[m] if isinstance(kv, np.ndarray) else np.asarray(kv[m])
-    if surv.size:
-        writer.append(np.asarray(surv, kdt), dtype, device_slot=slot)
 
 
 def _resolve_spill(source, spill, spill_dir):
@@ -608,6 +476,7 @@ def streaming_kselect(
     devices=None,
     spill=DEFAULT_SPILL,
     spill_dir=None,
+    deferred=DEFAULT_DEFERRED,
     obs=None,
 ):
     """Exact k-th smallest (1-indexed) over a chunked stream.
@@ -659,12 +528,25 @@ def streaming_kselect(
     temp dir). Answers are bit-identical to ``spill="off"`` in every mode,
     for every devices x pipeline_depth combination.
 
+    ``deferred`` governs the per-chunk consumption discipline
+    (streaming/executor.py): ``"auto"``/``"on"`` (default) dispatch each
+    staged chunk's survivor filter — the collect's and the spill tee's —
+    as a device-side fixed-shape compaction whose host materialization
+    happens when the p-wide FIFO window pops, so the consumer never
+    blocks per chunk and the collect/spill passes scale with ``devices``
+    like the histogram passes; spill replays also read record payloads
+    via mmap. ``"off"`` keeps the historical eager gather at
+    chunk-arrival time. Answers are bit-identical across the whole
+    devices x pipeline_depth x spill x deferred grid; host chunks and
+    the host-exact routes (64-bit-no-x64, f64-on-TPU) never stage and so
+    bypass deferral by construction.
+
     ``obs`` (an :class:`~mpi_k_selection_tpu.obs.Observability`) turns on
     the descent telemetry: one typed event per streamed pass and per
     consumed chunk, metrics (StagingPool hits/misses, stall seconds,
-    in-flight window occupancy, chunks/bytes per device, spilled bytes),
-    and producer/consumer trace spans. Off by default; enabling it never
-    changes an answer bit (see docs/OBSERVABILITY.md).
+    in-flight window occupancy — also per executor phase, spilled
+    bytes), and producer/consumer trace spans. Off by default; enabling
+    it never changes an answer bit (see docs/OBSERVABILITY.md).
     """
     return streaming_kselect_many(
         source,
@@ -678,6 +560,7 @@ def streaming_kselect(
         devices=devices,
         spill=spill,
         spill_dir=spill_dir,
+        deferred=deferred,
         obs=obs,
     )[0]
 
@@ -695,6 +578,7 @@ def streaming_kselect_many(
     devices=None,
     spill=DEFAULT_SPILL,
     spill_dir=None,
+    deferred=DEFAULT_DEFERRED,
     obs=None,
 ):
     """Exact k-th smallest for EVERY (1-indexed) rank in ``ks``, sharing
@@ -705,8 +589,8 @@ def streaming_kselect_many(
     dominant cost, so m quantiles over one stream cost roughly the passes
     of one. Per-rank semantics are exactly :func:`streaming_kselect`'s
     (including its ``pipeline_depth``/``timer``/``devices``,
-    ``spill``/``spill_dir`` and ``obs`` knobs); returns a list in input
-    order.
+    ``spill``/``spill_dir``, ``deferred`` and ``obs`` knobs); returns a
+    list in input order.
 
     With spill engaged the "replay" above is a generation read: pass 0
     tees the encoded keys to the spill store, every later pass filters the
@@ -714,13 +598,18 @@ def streaming_kselect_many(
     set of that pass plus parked ranks awaiting the collect) and writes
     only the compacted survivors — so the bytes streamed per pass shrink
     by ~2^radix_bits while the multiset of keys each histogram counts is
-    unchanged, keeping answers bit-identical to the replay path.
+    unchanged, keeping answers bit-identical to the replay path. Under
+    ``deferred`` the tee's filter rides the same executor window as the
+    histogram dispatches (one device-side compaction per staged chunk,
+    record written at FIFO-finish time), so the spill pass no longer
+    serializes on per-chunk gathers.
     """
     pipeline_depth = _pl.validate_pipeline_depth(pipeline_depth)
     devs = _pl.resolve_stream_devices(devices)
+    defer = _ex.resolve_deferred(deferred)
     timer, _restore_recorder = _wr.attach_timer(obs, timer)
-    occupancy = _wr.window_occupancy(obs)
-    # one in-flight histogram slot per ingest device; the synchronous
+    occupancy = _wr.window_occupancy(obs, phase="descent")
+    # one in-flight bundle slot per ingest device; the synchronous
     # (depth-0) oracle stays strictly serial regardless of the knob
     window = len(devs) if pipeline_depth > 0 else 1
     # None keeps the PR 3 uncommitted default-device staging; an explicit
@@ -734,12 +623,12 @@ def streaming_kselect_many(
         return []
 
     store, own_store, read_gen = _resolve_spill(source, spill, spill_dir)
-    src = as_chunk_source(source, one_shot_ok=store is not None)
+    src = as_chunk_source(source, one_shot_ok=store is not None, mmap=defer)
     created = []  # generations this call wrote — its cleanup set
     keep_gen0 = None  # the pass-0 tee, preserved in caller-owned stores
 
     def _gen_src():
-        return read_gen.as_source() if read_gen is not None else src
+        return read_gen.as_source(mmap=defer) if read_gen is not None else src
 
     def _log_pass(label, wrote=None):
         if store is None:
@@ -808,7 +697,7 @@ def streaming_kselect_many(
                 if store is not None and read_gen is None
                 else None
             )
-            win = _HistogramWindow(window, occupancy)
+            hist_c = ex = keys = None
             try:
                 with _pl._phase(timer, "descent.pass"), _key_chunk_stream(
                     _gen_src(), hist_method=hist_method, spill=writer,
@@ -826,22 +715,28 @@ def streaming_kselect_many(
                                 )
                             method = resolve_stream_hist(hist_method, dtype)
                             shift0 = total_bits - radix_bits
-                            hist = np.zeros((1 << radix_bits,), np.int64)
+                            hist_c = _ex.HistogramConsumer(
+                                shift0, radix_bits, [None], method, kdt
+                            )
+                            ex = _ex.StreamExecutor(
+                                [hist_c], window=window, occupancy=occupancy
+                            )
                         if obs is not None:
                             _wr.chunk_event(obs, 0, chunk_i0, keys, kdt, devs)
                         chunk_i0 += 1
                         n += int(keys.size)
-                        for h in win.push(
-                            keys, shift0, radix_bits, [None], method, kdt
-                        ):
-                            hist += h[None]
-                    for h in win.drain():
-                        hist += h[None]
+                        ex.push(keys)
+                    if ex is not None:
+                        ex.drain()
                 if n == 0:
                     raise ValueError(
                         "streaming selection requires a non-empty stream"
                     )
+                hist = hist_c.hists[None]
             except BaseException:
+                if ex is not None:
+                    ex.abort()
+                _ex.release_staged(keys)  # the chunk in hand (idempotent)
                 if writer is not None:
                     writer.abort()
                 raise
@@ -906,7 +801,6 @@ def streaming_kselect_many(
             shift = total_bits - resolved - radix_bits
             prefixes = sorted({st[0] for st in states if _active(st)})
             expected = {st[0]: st[3] for st in states if _active(st)}
-            hists = {p: np.zeros((1 << radix_bits,), np.int64) for p in prefixes}
             writer = filter_specs = None
             if store is not None:
                 # survivors this pass must carry forward: the active
@@ -925,7 +819,23 @@ def streaming_kselect_many(
             pass_label = resolved // radix_bits
             pass_read_gen = read_gen  # what this pass reads from
             chunk_i = 0
-            win = _HistogramWindow(window, occupancy)
+            # ONE executor bundle per chunk: the spill tee (first, so its
+            # eager form writes before the histogram handle can finish)
+            # and the histogram dispatch share the FIFO window, and the
+            # staged buffer is released when the LAST of the two results
+            # materializes — not before
+            hist_c = _ex.HistogramConsumer(shift, radix_bits, prefixes, method, kdt)
+            consumers = [hist_c]
+            if writer is not None:
+                consumers.insert(
+                    0,
+                    _ex.SpillTeeConsumer(
+                        writer, filter_specs, dtype, kdt, total_bits, devs,
+                        deferred=defer,
+                    ),
+                )
+            ex = _ex.StreamExecutor(consumers, window=window, occupancy=occupancy)
+            keys = None
             try:
                 with _pl._phase(timer, "descent.pass"), _key_chunk_stream(
                     _gen_src(), dtype, hist_method=method, **stream_kw
@@ -934,26 +844,15 @@ def streaming_kselect_many(
                         if obs is not None:
                             _wr.chunk_event(obs, pass_label, chunk_i, keys, kdt, devs)
                         chunk_i += 1
-                        if writer is not None:
-                            # tee BEFORE the window can release the staged
-                            # buffer; the filter runs on the chunk's own
-                            # device, only survivors cross back
-                            _spill_tee_survivors(
-                                writer, keys, filter_specs, dtype, kdt,
-                                total_bits, devs,
-                            )
-                        for hd in win.push(
-                            keys, shift, radix_bits, prefixes, method, kdt
-                        ):
-                            for p, h in hd.items():
-                                hists[p] += h
-                    for hd in win.drain():
-                        for p, h in hd.items():
-                            hists[p] += h
+                        ex.push(keys)
+                    ex.drain()
             except BaseException:
+                ex.abort()
+                _ex.release_staged(keys)  # the chunk in hand (idempotent)
                 if writer is not None:
                     writer.abort()
                 raise
+            hists = hist_c.hists
             for p in prefixes:
                 # replay-stability check, mirroring _collect_survivors':
                 # this pass's population under each surviving prefix must
@@ -1032,6 +931,7 @@ def streaming_kselect_many(
                 timer=timer, devices=None if devices is None else devs,
                 hist_method=method, obs=obs,
                 read_from="spill" if read_gen is not None else "source",
+                deferred=defer,
             )
             _log_pass("collect")
 
@@ -1070,7 +970,7 @@ def streaming_kselect_many(
 
 def streaming_rank_certificate(
     source, value, *, pipeline_depth: int = DEFAULT_PIPELINE_DEPTH, timer=None,
-    devices=None, obs=None,
+    devices=None, deferred=DEFAULT_DEFERRED, obs=None,
 ):
     """``(#elements < value, #elements <= value)`` streamed — the O(n)
     exactness proof of utils/debug.py:rank_certificate without residency:
@@ -1081,31 +981,26 @@ def streaming_rank_certificate(
     counts consume keys wherever they already live). ``devices`` > 1
     stages chunks round-robin so each device counts its own resident
     chunks, with the per-chunk int counts folded into the host int
-    accumulators in chunk order (integer addition — order-exact either
-    way); the host-exact 64-bit/f64-on-TPU routes keep counting on host.
-    ``source`` may be a :class:`~mpi_k_selection_tpu.streaming.spill.
-    SpillStore` with a committed generation: the single counting pass then
-    replays the spilled keys instead of the original stream (certifying a
-    one-shot source's answer without re-reading it)."""
-    src = as_chunk_source(source)
+    accumulators in FIFO chunk order (integer addition — order-exact
+    either way); the host-exact 64-bit/f64-on-TPU routes keep counting on
+    host. ``deferred`` (default on) traces the staged counts over the
+    whole padded bucket with an exact pad correction — one compile per
+    staging bucket instead of one per ragged chunk length — and reads
+    spill records via mmap; ``"off"`` keeps the historical valid-slice
+    sums (bit-identical counts either way). ``source`` may be a
+    :class:`~mpi_k_selection_tpu.streaming.spill.SpillStore` with a
+    committed generation: the single counting pass then replays the
+    spilled keys instead of the original stream (certifying a one-shot
+    source's answer without re-reading it)."""
+    defer = _ex.resolve_deferred(deferred)
+    src = as_chunk_source(source, mmap=defer)
     devs = _pl.resolve_stream_devices(devices)
     timer, _restore_recorder = _wr.attach_timer(obs, timer)
     multi = len(devs) > 1 and _pl.validate_pipeline_depth(pipeline_depth) > 0
-    less = leq = 0
     vkey = None
     kdt = None
+    counter = ex = keys = None
     chunk_i = keys_read = 0
-
-    def _finish_counts(handle):
-        staged, lt, le = handle
-        counts = (int(lt), int(le))
-        if staged is not None:
-            staged.release()
-        return counts
-
-    win = _pl.InflightWindow(
-        len(devs), _finish_counts, occupancy=_wr.window_occupancy(obs)
-    )
     try:
         with _pl._phase(timer, "certificate.pass"), _key_chunk_stream(
             src, pipeline_depth=pipeline_depth, timer=timer,
@@ -1120,33 +1015,33 @@ def streaming_rank_certificate(
                         np.asarray([value], np.dtype(chunk.dtype))
                     )[0]
                     kdt = np.dtype(_dt.key_dtype(np.dtype(chunk.dtype)))
+                    counter = _ex.CountLessLeqConsumer(vkey, kdt, deferred=defer)
+                    # both counts dispatch async on the chunk's own device;
+                    # the FIFO materializes the oldest once one bundle per
+                    # device is in flight (deferred: over the whole padded
+                    # bucket with the exact pad correction — one compile
+                    # per bucket instead of one per ragged chunk length)
+                    ex = _ex.StreamExecutor(
+                        [counter], window=len(devs),
+                        occupancy=_wr.window_occupancy(obs, phase="certificate"),
+                    )
                 if obs is not None:
                     _wr.chunk_event(obs, "certificate", chunk_i, keys, kdt, devs)
                 chunk_i += 1
                 keys_read += int(keys.size)
-                staged = isinstance(keys, StagedKeys)
-                kv = keys.valid() if staged else keys
-                if isinstance(kv, np.ndarray):
-                    less += int(np.count_nonzero(kv < vkey))
-                    leq += int(np.count_nonzero(kv <= vkey))
-                else:
-                    import jax.numpy as jnp
-
-                    v = kv.dtype.type(vkey)
-                    # dispatch both counts async on the chunk's own device;
-                    # materialize FIFO once one count per device is in flight
-                    for lt, le in win.push(
-                        (keys if staged else None, jnp.sum(kv < v), jnp.sum(kv <= v))
-                    ):
-                        less += lt
-                        leq += le
-            for lt, le in win.drain():
-                less += lt
-                leq += le
+                ex.push(keys)
+            if ex is not None:
+                ex.drain()
+    except BaseException:
+        if ex is not None:
+            ex.abort()
+        _ex.release_staged(keys)  # the chunk in hand (idempotent)
+        raise
     finally:
         _restore_recorder()
     if vkey is None:
         raise ValueError("streaming_rank_certificate requires a non-empty stream")
+    less, leq = counter.less, counter.leq
     if obs is not None:
         obs.emit(
             _ev.CertificateEvent(

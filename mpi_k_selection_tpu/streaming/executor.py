@@ -1,0 +1,574 @@
+"""Async streaming executor — ONE event-driven scheduler for every
+per-chunk device consumer, with deferred host transfers.
+
+Before this module, the streaming descent had FOUR per-chunk consumers,
+each with its own consumption discipline:
+
+- the histogram dispatch/merge rode an in-flight FIFO window (dispatch
+  async on the chunk's device, materialize the oldest once one dispatch
+  per ingest device is pending) — already overlap-friendly;
+- the survivor collect and the spill tee did an EAGER boolean gather
+  (``np.asarray(kv[m])``) at chunk-arrival time — the consumer blocked on
+  a device->host sync per chunk, so on a multi-device pass the p-wide
+  in-flight window degraded toward serial on exactly the biggest
+  (pass-1 spill / collect) reads — review r6, the ROADMAP's
+  "async streaming executor" item;
+- the rank-certificate count folds rode the window but traced their sums
+  over the per-chunk ``StagedKeys.valid()`` slice — one XLA compile per
+  distinct chunk length instead of one per staging bucket.
+
+This module unifies all four under the existing
+:class:`~mpi_k_selection_tpu.streaming.pipeline.InflightWindow` FIFO
+discipline with **deferred device-side compaction**: instead of gathering
+survivors eagerly, each chunk's work becomes a device-side dispatch
+handle — a jit-compiled mask -> count -> fixed-shape compaction program
+per (bucket, dtype, device), with the spec ``(shift, prefix)`` pairs as
+traced scalars so the program compiles ONCE per staging bucket (the
+KSC103 trail-stability contract) — whose host materialization
+(``np.asarray`` of only the compacted survivor prefix, plus the count)
+happens when the FIFO window pops, not when the chunk arrives.
+``StagedKeys.release()`` moves to handle-finish time, so staged buffers
+live exactly as long as their in-flight work.
+
+Determinism contract (the grid tests/test_executor.py enforces):
+
+- ``deferred="off"`` reproduces the pre-executor eager behavior exactly
+  (eager gathers at chunk-arrival time, certificate sums over the valid
+  slice); ``"auto"``/``"on"`` defer — and answers are bit-identical
+  across the whole devices x depth x spill x deferred grid, because
+  every downstream fold is order-invariant (int64 histogram sums, the
+  survivor multiset, integer certificate counts) and the FIFO fixes the
+  fold order anyway.
+- Deferral engages exactly for :class:`~mpi_k_selection_tpu.streaming.
+  pipeline.StagedKeys` chunks (device-resident, pow2-padded). Host
+  chunks — including the host-exact 64-bit-no-x64 and f64-on-TPU routes,
+  which never stage — always take the host path, and
+  ``pipeline_depth=0`` / unstaged device chunks keep the eager path, so
+  the synchronous oracle and the single-device defaults are unchanged.
+- Chunks with NO in-flight device work (all consumers folded at dispatch
+  time) skip the window entirely: no occupancy sample, immediate
+  release — exactly the pre-executor serial discipline, which is what
+  makes ``deferred="off"`` a bit-for-bit oracle rather than a near
+  re-implementation.
+
+This file is the ONE sanctioned home for the eager
+``np.asarray(<indexed device array>)`` gather under ``streaming/`` —
+lint rule KSL011 flags it anywhere else in the streaming layer, because
+an eager gather on a chunk-consume path is exactly the serialization
+this module retires.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from mpi_k_selection_tpu.obs import wiring as _wr
+from mpi_k_selection_tpu.streaming import pipeline as _pl
+from mpi_k_selection_tpu.streaming.pipeline import StagedKeys, _bucket_elems
+
+#: Default for the ``deferred`` knob: defer wherever a staged device
+#: chunk makes it possible (bit-identical, strictly less consumer
+#: blocking — there is no configuration where eager wins, so auto == on;
+#: the mode exists so a future heuristic can narrow it without an API
+#: change).
+DEFAULT_DEFERRED = "auto"
+
+#: The ``deferred`` knob's string modes (bools are also accepted).
+DEFERRED_MODES = ("auto", "on", "off")
+
+
+def resolve_deferred(deferred) -> bool:
+    """Normalize the ``deferred`` knob to a bool (True = deferred
+    device-side compaction engages for staged chunks). Accepts
+    ``"auto"``/``"on"``/``"off"`` or a plain bool; ``"auto"`` (the
+    default) currently equals ``"on"`` — see :data:`DEFAULT_DEFERRED`."""
+    if isinstance(deferred, (bool, np.bool_)):
+        return bool(deferred)
+    if deferred in ("auto", "on"):
+        return True
+    if deferred == "off":
+        return False
+    raise ValueError(
+        f"deferred must be one of {DEFERRED_MODES} or a bool, got {deferred!r}"
+    )
+
+
+def prefix_mask(kv, resolved, prefix, kdt, total_bits):
+    """The survivor filter predicate — keys whose top ``resolved`` bits
+    equal ``prefix`` — on ``kv``'s own residency (host numpy, or a device
+    shift-compare tracing to a bool mask). The ONE predicate shared by the
+    survivor collect, the spill tee, and the deferred compaction program,
+    so the KSC102/KSC103 contract coverage of its traced form transfers to
+    every caller by construction."""
+    shift = total_bits - resolved
+    if isinstance(kv, np.ndarray):
+        return (kv >> kdt.type(shift)) == kdt.type(prefix)
+    import jax
+
+    return jax.lax.shift_right_logical(
+        kv, kv.dtype.type(shift)
+    ) == kv.dtype.type(prefix)
+
+
+# ---------------------------------------------------------------------------
+# the deferred compaction program
+
+
+def _compact_core(data, n_valid, shifts, prefixes):
+    """mask -> count -> fixed-shape compaction over one padded staging
+    bucket: survivors (keys matching ANY ``(shift, prefix)`` spec, pad
+    lanes masked out) are scattered to the FRONT of a bucket-shaped
+    output, in chunk order, alongside their int32 count. Everything
+    data-dependent (``n_valid``, the spec scalars) rides as traced
+    values, so the program compiles once per (bucket, dtype, #specs) —
+    the same discipline as the staged histogram — and its primitive
+    trail is size-stable (KSC103). Only ``#specs`` is baked into the
+    trace (the union loop unrolls over it), and a pass's spec count is
+    fixed for every chunk of that pass."""
+    import jax
+    import jax.numpy as jnp
+
+    m = None
+    for j in range(shifts.shape[0]):
+        mj = jax.lax.shift_right_logical(data, shifts[j]) == prefixes[j]
+        m = mj if m is None else (m | mj)
+    m = m & (jax.lax.iota(jnp.int32, data.shape[0]) < n_valid)
+    mi = m.astype(jnp.int32)
+    pos = jnp.cumsum(mi) - 1  # survivor j's target slot (int32: bucket < 2^31)
+    tgt = jnp.where(m, pos, jnp.int32(data.shape[0]))  # non-survivors drop OOB
+    out = jnp.zeros(data.shape, data.dtype).at[tgt].set(data, mode="drop")
+    return out, jnp.sum(mi)
+
+
+_COMPACT_FN = None
+
+
+def _compact_fn():
+    global _COMPACT_FN
+    if _COMPACT_FN is None:
+        import jax
+
+        _COMPACT_FN = jax.jit(_compact_core)
+    return _COMPACT_FN
+
+
+def dispatch_compaction(staged: StagedKeys, specs, kdt, total_bits):
+    """Launch the compaction program for the union of ``(resolved_bits,
+    prefix)`` ``specs`` on the staged chunk's OWN device (async dispatch —
+    ``staged.data`` is committed, so the program runs where the chunk
+    lives). Returns the in-flight ``(compacted, count)`` handle for
+    :func:`materialize_compacted`."""
+    shifts = np.asarray([total_bits - r for r, _ in specs], kdt)
+    prefixes = np.asarray([p for _, p in specs], kdt)
+    return _compact_fn()(staged.data, np.int32(staged.n_valid), shifts, prefixes)
+
+
+def materialize_compacted(handle, kdt) -> np.ndarray:
+    """Block on one :func:`dispatch_compaction` handle and bring ONLY the
+    compacted survivors host-side: the count scalar first (by finish time
+    the program has typically long completed — that is the whole point of
+    the FIFO deferral), then the survivor prefix rounded up to its pow2
+    bucket (device slices compile per shape; the rounding bounds the
+    slice-shape set to log2(bucket) per staging bucket, the same
+    discipline as the staging pads)."""
+    compacted, count = handle
+    cnt = int(count)
+    if cnt == 0:
+        return np.empty((0,), kdt)
+    b = _bucket_elems(cnt)
+    if b >= compacted.shape[0]:
+        return np.asarray(compacted)[:cnt]
+    return np.asarray(compacted[:b])[:cnt]
+
+
+# ---------------------------------------------------------------------------
+# per-chunk histogram dispatch/finish (moved from streaming/chunked.py —
+# the executor owns every per-chunk device consumer)
+
+
+def dispatch_chunk_histograms(keys, shift, radix_bits, prefixes, method, kdt):
+    """DISPATCH one chunk's digit histogram(s) at ``shift`` for every
+    prefix in ``prefixes`` (``None`` = no filter) and return an in-flight
+    handle for :func:`finish_chunk_histograms` — the chunk-side work is
+    paid ONCE and shared across prefixes: host chunks compute the
+    digit/prefix arrays once, device chunks cross the tunnel once and stay
+    on device for the counts (the whole point on TPU); only the
+    (2**radix_bits,) counts per prefix come back at finish time.
+
+    Device work is dispatched asynchronously on the chunk's OWN device
+    (jax async dispatch; :class:`~mpi_k_selection_tpu.streaming.pipeline.
+    StagedKeys` are committed to their round-robin slot, so up to one
+    dispatch per ingest device runs concurrently under the executor's
+    window). The ``"numpy"`` method computes host-side immediately —
+    there is nothing to overlap.
+
+    Pipelined passes hand in :class:`StagedKeys` — a pow2-padded,
+    already-device-resident buffer. The histogram runs over the WHOLE
+    padded buffer (fixed shape, one compile per bucket size) and the pad
+    contribution is subtracted host-side at finish: pad keys are key-space
+    0, so they land in digit bucket 0 and only under the all-zero prefix —
+    an exact integer correction."""
+    staged = isinstance(keys, StagedKeys)
+    if method == "numpy":
+        if staged:  # pragma: no cover - staging only feeds device methods
+            keys = np.asarray(keys.valid())
+        k = keys if isinstance(keys, np.ndarray) else np.asarray(keys)
+        dig = ((k >> kdt.type(shift)) & kdt.type((1 << radix_bits) - 1)).astype(
+            np.int64
+        )
+        nb = 1 << radix_bits
+        if len(prefixes) == 1 and prefixes[0] is None:
+            return (None, {None: np.bincount(dig, minlength=nb).astype(np.int64)})
+        up = k >> kdt.type(shift + radix_bits)
+        return (
+            None,
+            {
+                p: np.bincount(dig[up == kdt.type(p)], minlength=nb).astype(np.int64)
+                for p in prefixes
+            },
+        )
+    import jax.numpy as jnp
+
+    from mpi_k_selection_tpu.ops.histogram import (
+        masked_radix_histogram,
+        multi_masked_radix_histogram,
+    )
+
+    dk = keys.data if staged else jnp.asarray(keys)  # ksel: noqa[KSL002] -- 64-bit keys only reach this device branch with x64 on: resolve_stream_hist routes them to the host 'numpy' method otherwise
+    if len(prefixes) == 1 and prefixes[0] is None:
+        h = masked_radix_histogram(
+            dk,
+            shift=shift,
+            radix_bits=radix_bits,
+            prefix=None,
+            method=method,
+            count_dtype=jnp.int32,  # exact per chunk (chunk size < 2^31)
+        )
+    else:
+        # the shared-sweep primitive of the resident multi-rank descent: on
+        # the pallas methods all K prefix queries ride ONE read of the chunk
+        # (other methods fall back to K single-prefix sweeps — correct,
+        # just K reads)
+        h = multi_masked_radix_histogram(
+            dk,
+            shift=shift,
+            radix_bits=radix_bits,
+            prefixes=np.asarray(prefixes, kdt),
+            method=method,
+            count_dtype=jnp.int32,
+        )
+    return ((keys if staged else None, list(prefixes), h), None)
+
+
+def finish_chunk_histograms(handle, release: bool = True):
+    """Materialize one :func:`dispatch_chunk_histograms` handle into the
+    ``{prefix: int64 histogram}`` dict: block on the device counts, widen
+    to the host int64 accumulator dtype, and apply the exact pad
+    correction. ``release`` donates the staged ring slot here — the
+    serial (:func:`chunk_histograms`) form; the executor passes False and
+    releases once EVERY consumer of the chunk has finished."""
+    inflight, done = handle
+    if done is not None:
+        return done
+    staged, prefixes, h = inflight
+    if len(prefixes) == 1 and prefixes[0] is None:
+        out = {None: np.asarray(h).astype(np.int64)}
+    else:
+        hk = np.asarray(h).astype(np.int64)
+        out = {p: hk[i] for i, p in enumerate(prefixes)}
+    if staged is not None:
+        if staged.pad:
+            # pad keys are key-space 0: digit (0 >> shift) & mask == 0, and
+            # they pass a prefix filter only when every upper bit is 0
+            for p, hist in out.items():
+                if p is None or int(p) == 0:
+                    hist[0] -= staged.pad
+        if release:
+            # the counts above are host-materialized (np.asarray blocked
+            # on them), so the ring slot can be donated back eagerly
+            staged.release()
+    return out
+
+
+def chunk_histograms(keys, shift, radix_bits, prefixes, method, kdt):
+    """Dispatch + finish in one step — the serial form the contract checks
+    and unit tests use."""
+    return finish_chunk_histograms(
+        dispatch_chunk_histograms(keys, shift, radix_bits, prefixes, method, kdt)
+    )
+
+
+# ---------------------------------------------------------------------------
+# consumers
+
+
+class Consumer:
+    """One per-chunk consumer under the executor: ``dispatch`` launches
+    (or, for host/eager work, completes) a chunk's work and returns an
+    in-flight handle — or ``None`` when everything already folded;
+    ``finish`` materializes a pending handle host-side, strictly in chunk
+    FIFO order. Implementations fold into their own accumulators; the
+    executor owns buffer lifetime (``StagedKeys.release()``)."""
+
+    def dispatch(self, keys, kv):  # pragma: no cover - protocol
+        raise NotImplementedError
+
+    def finish(self, handle) -> None:  # pragma: no cover - protocol
+        raise NotImplementedError
+
+
+class HistogramConsumer(Consumer):
+    """The descent's histogram merge: per-chunk dispatch via
+    :func:`dispatch_chunk_histograms`, per-prefix int64 accumulation at
+    finish (int64 addition is exact and order-invariant; the FIFO order is
+    belt and braces, and keeps the replay-stability diagnostics
+    reproducible)."""
+
+    def __init__(self, shift, radix_bits, prefixes, method, kdt):
+        self.hists = {
+            p: np.zeros((1 << radix_bits,), np.int64) for p in prefixes
+        }
+        self._args = (shift, radix_bits, list(prefixes), method, kdt)
+
+    def dispatch(self, keys, kv):
+        shift, radix_bits, prefixes, method, kdt = self._args
+        handle = dispatch_chunk_histograms(
+            keys, shift, radix_bits, prefixes, method, kdt
+        )
+        if handle[1] is not None:  # host-computed: fold now, nothing in flight
+            self._fold(handle[1])
+            return None
+        return handle
+
+    def finish(self, handle) -> None:
+        self._fold(finish_chunk_histograms(handle, release=False))
+
+    def _fold(self, hd) -> None:
+        for p, h in hd.items():
+            self.hists[p] += h
+
+
+class CollectConsumer(Consumer):
+    """The survivor collect: one filter per ``(resolved_bits, prefix)``
+    spec per chunk, survivors accumulated per spec in chunk order.
+    Deferred: one compaction dispatch per spec on the staged chunk's own
+    device, survivors crossing back only at FIFO-finish time. Eager
+    (``deferred="off"``, host chunks, unstaged device chunks): the
+    historical gather at dispatch time."""
+
+    def __init__(self, specs, kdt, total_bits, *, deferred: bool):
+        self.specs = list(specs)
+        self.out = {s: [] for s in self.specs}
+        self._kdt = kdt
+        self._bits = total_bits
+        self._deferred = bool(deferred)
+
+    def dispatch(self, keys, kv):
+        if self._deferred and isinstance(keys, StagedKeys):
+            return [
+                dispatch_compaction(keys, [spec], self._kdt, self._bits)
+                for spec in self.specs
+            ]
+        host = isinstance(kv, np.ndarray)
+        for spec in self.specs:
+            m = prefix_mask(kv, spec[0], spec[1], self._kdt, self._bits)
+            # host indexing, or the eager boolean gather device-side —
+            # the pre-executor path, kept as the deferred=off oracle
+            surv = kv[m] if host else np.asarray(kv[m])
+            if surv.size:
+                self.out[spec].append(np.asarray(surv, self._kdt))
+        return None
+
+    def finish(self, handles) -> None:
+        for spec, h in zip(self.specs, handles):
+            surv = materialize_compacted(h, self._kdt)
+            if surv.size:
+                self.out[spec].append(surv)
+
+    def collected(self, kdt) -> dict:
+        """``{spec: concatenated host key array}`` after the drain."""
+        return {
+            spec: (np.concatenate(parts) if parts else np.empty((0,), kdt))
+            for spec, parts in self.out.items()
+        }
+
+
+class SpillTeeConsumer(Consumer):
+    """The spill tee: filter ONE chunk to the union of surviving specs
+    (the collect predicate OR-ed over specs) and append the compacted
+    survivors to the next spill generation. Deferred: one union-mask
+    compaction on the chunk's own device, the record written at
+    FIFO-finish time — so the generation's record order follows the
+    executor's deterministic finish order (downstream consumers fold
+    order-invariantly; the staged slot each record carries preserves the
+    chunk->device replay contract regardless)."""
+
+    def __init__(self, writer, specs, dtype, kdt, total_bits, devs, *, deferred):
+        self._writer = writer
+        self._specs = list(specs)
+        self._dtype = dtype
+        self._kdt = kdt
+        self._bits = total_bits
+        self._devs = devs
+        self._deferred = bool(deferred)
+
+    def _append(self, surv, slot) -> None:
+        if surv.size:
+            self._writer.append(
+                np.asarray(surv, self._kdt), self._dtype, device_slot=slot
+            )
+
+    def dispatch(self, keys, kv):
+        slot = _wr.staged_slot(keys, self._devs)
+        if self._deferred and isinstance(keys, StagedKeys):
+            return (
+                slot,
+                dispatch_compaction(keys, self._specs, self._kdt, self._bits),
+            )
+        m = None
+        for resolved, prefix in self._specs:
+            mi = prefix_mask(kv, resolved, prefix, self._kdt, self._bits)
+            m = mi if m is None else (m | mi)
+        if m is None:  # pragma: no cover - a pass always has >= 1 spec
+            return None
+        # host indexing, or the eager gather on the owning device — the
+        # pre-executor path, kept as the deferred=off oracle
+        surv = kv[m] if isinstance(kv, np.ndarray) else np.asarray(kv[m])
+        self._append(surv, slot)
+        return None
+
+    def finish(self, handle) -> None:
+        slot, h = handle
+        self._append(materialize_compacted(h, self._kdt), slot)
+
+
+class CountLessLeqConsumer(Consumer):
+    """The rank certificate's ``(#keys < v, #keys <= v)`` folds. Deferred:
+    the sums run over the WHOLE padded bucket (one compile per staging
+    bucket, like the histograms) with the exact pad correction applied at
+    finish — pad keys are key-space 0, so each pad lane counts into
+    ``< v`` iff ``v != 0`` and into ``<= v`` always (unsigned key space).
+    Eager: the historical sums over the ragged valid slice."""
+
+    def __init__(self, vkey, kdt, *, deferred: bool):
+        self.less = 0
+        self.leq = 0
+        self._vkey = vkey
+        self._kdt = kdt
+        self._deferred = bool(deferred)
+
+    def dispatch(self, keys, kv):
+        if isinstance(kv, np.ndarray):
+            self.less += int(np.count_nonzero(kv < self._vkey))
+            self.leq += int(np.count_nonzero(kv <= self._vkey))
+            return None
+        import jax.numpy as jnp
+
+        if self._deferred and isinstance(keys, StagedKeys):
+            v = keys.data.dtype.type(self._vkey)
+            return (jnp.sum(keys.data < v), jnp.sum(keys.data <= v), keys.pad)
+        v = kv.dtype.type(self._vkey)
+        return (jnp.sum(kv < v), jnp.sum(kv <= v), 0)
+
+    def finish(self, handle) -> None:
+        lt, le, pad = handle
+        lt, le = int(lt), int(le)
+        if pad:
+            if int(self._vkey) != 0:
+                lt -= pad
+            le -= pad
+        self.less += lt
+        self.leq += le
+
+
+# ---------------------------------------------------------------------------
+# the scheduler
+
+
+class StreamExecutor:
+    """The one per-chunk consumption scheduler: every registered consumer
+    dispatches its device work for a chunk at ``push`` time, the bundle
+    rides the :class:`~mpi_k_selection_tpu.streaming.pipeline.
+    InflightWindow` FIFO (one slot per ingest device), and the chunk's
+    staged buffer is released when its bundle finishes — i.e. exactly
+    when the LAST result depending on it has materialized host-side.
+
+    A chunk whose every consumer folded at dispatch time (host chunks,
+    eager mode) carries no in-flight device work: it skips the window —
+    no occupancy sample, immediate release — reproducing the
+    pre-executor serial discipline bit for bit.
+
+    ``occupancy`` (an obs/metrics.py Histogram, or the phase-labeled
+    fan-out from obs/wiring.py:window_occupancy) samples the in-flight
+    bundle count at every windowed push — the r6 consumer-serialization
+    made measurable: a p-wide window sampling ~1 under multi-device load
+    is the serial regime, ~p the fully deferred one
+    (:func:`collect_hidden_frac`)."""
+
+    def __init__(self, consumers, *, window: int, occupancy=None):
+        self.consumers = list(consumers)
+        self.window = max(1, int(window))
+        self._win = _pl.InflightWindow(
+            self.window, self._finish_bundle, occupancy=occupancy
+        )
+
+    def push(self, keys) -> None:
+        """Consume one chunk: dispatch every consumer, enqueue the
+        in-flight bundle (finishing the oldest when the window is full),
+        or — with nothing in flight — release immediately."""
+        staged = isinstance(keys, StagedKeys)
+        kv = keys.valid() if staged else keys
+        handles = [c.dispatch(keys, kv) for c in self.consumers]
+        if all(h is None for h in handles):
+            if staged:
+                keys.release()
+            return
+        self._win.push((keys if staged else None, handles))
+
+    def _finish_bundle(self, bundle) -> None:
+        keys, handles = bundle
+        for c, h in zip(self.consumers, handles):
+            if h is not None:
+                c.finish(h)
+        if keys is not None:
+            keys.release()
+
+    def drain(self) -> None:
+        """Finish every pending bundle, oldest first (end of stream)."""
+        for _ in self._win.drain():
+            pass
+
+    def abort(self) -> None:
+        """Unwind: drop every pending bundle WITHOUT finishing it,
+        releasing the staged buffers (a raise mid-pass must not leak ring
+        slots — tests/conftest.py asserts the live-staged count returns
+        to baseline after every test)."""
+        for keys, _ in self._win.clear_pending():
+            if keys is not None:
+                keys.release()
+
+
+def release_staged(keys) -> None:
+    """Idempotently release a possibly-staged chunk — the unwind helper
+    for the chunk IN HAND when a consumer raises: at that instant it sits
+    in neither the pipeline queue (already popped) nor the executor
+    window (not yet pushed, or already finished — release is idempotent
+    either way), so the pass's except block must free it explicitly."""
+    if isinstance(keys, StagedKeys):
+        keys.release()
+
+
+def collect_hidden_frac(occupancy, window: int):
+    """How much of the window's extra capacity a deferred pass actually
+    used: ``(mean occupancy - 1) / (window - 1)``, clamped to [0, 1].
+    ~0.0 is the serial regime the eager gathers forced (every chunk
+    materialized before the next arrived); ~1.0 means the full p-wide
+    window stayed occupied — the per-chunk host transfers fully hidden
+    behind the other devices' in-flight work. ``None`` for a serial
+    window (<= 1) or when no sample was recorded (e.g. an eager pass,
+    which never enters the window)."""
+    if occupancy is None or window <= 1:
+        return None
+    if not getattr(occupancy, "count", 0):
+        return None
+    return max(0.0, min(1.0, (occupancy.mean - 1.0) / (window - 1.0)))

@@ -20,7 +20,7 @@ producer stages successive chunks round-robin onto the ingest device set
 concurrently — the pipelined twin of ``parallel/sketch.py:
 distributed_sketch``'s psum merge, with the per-device int32 partials
 merged into the host int64 accumulator in chunk order
-(streaming/chunked.py:_HistogramWindow).
+(streaming/executor.py:StreamExecutor + HistogramConsumer).
 
 Design:
 
@@ -254,17 +254,46 @@ class StagingPool:
 #: where the churn fix pays the most.
 STAGING_POOL = StagingPool()
 
+# live StagedKeys accounting: every stage_keys() increments, the FIRST
+# release() decrements — a leak detector for the executor's
+# release-at-handle-finish discipline (tests/conftest.py asserts the count
+# returns to its pre-test baseline after every test, including raise paths
+# with handles in flight).
+_LIVE_STAGED_LOCK = threading.Lock()
+_LIVE_STAGED = 0
+
+
+def _live_staged_inc() -> None:
+    global _LIVE_STAGED
+    with _LIVE_STAGED_LOCK:
+        _LIVE_STAGED += 1
+
+
+def _live_staged_dec() -> None:
+    global _LIVE_STAGED
+    with _LIVE_STAGED_LOCK:
+        _LIVE_STAGED -= 1
+
+
+def live_staged_keys() -> int:
+    """Number of :class:`StagedKeys` buffers staged but not yet
+    ``release()``d — 0 between passes; a nonzero steady state is a leaked
+    ring slot."""
+    with _LIVE_STAGED_LOCK:
+        return _LIVE_STAGED
+
 
 class InflightWindow:
     """FIFO window of in-flight device dispatches — at most ``window``
     handles pending, finished strictly in push order.
 
-    The one multi-device consumption discipline, shared by the descent's
-    histogram merge (streaming/chunked.py:_HistogramWindow), the rank
-    certificate's count folds, and the sketch's deepest-level folds
-    (streaming/sketch.py:update_stream): dispatch per-chunk device work
-    asynchronously (one slot per ingest device), materialize the OLDEST
-    handle once the window fills, drain the stragglers at end of stream.
+    The one multi-device consumption discipline, which every per-chunk
+    consumer — histogram merge, survivor collect, rank-certificate count
+    folds, spill tee, sketch deep folds — now rides through the async
+    executor (streaming/executor.py:StreamExecutor): dispatch per-chunk
+    device work asynchronously (one slot per ingest device), materialize
+    the OLDEST handle once the window fills, drain the stragglers at end
+    of stream.
     The strict FIFO order makes every host merge device-order-
     deterministic: results fold in chunk order no matter which device
     finishes first. With ``window=1`` every push finishes its own handle
@@ -299,6 +328,15 @@ class InflightWindow:
         while self._q:
             yield self._finish(self._q.popleft())
 
+    def clear_pending(self) -> list:
+        """Drop every pending handle WITHOUT finishing it, returning them
+        oldest first — the unwind path (streaming/executor.py:
+        StreamExecutor.abort) releases their resources without
+        materializing in-flight device work."""
+        items = list(self._q)
+        self._q.clear()
+        return items
+
 
 @dataclasses.dataclass(frozen=True)
 class StagedKeys:
@@ -320,6 +358,9 @@ class StagedKeys:
     host_buf: object = None
     pool: object = None
     device: object = None
+    # set by stage_keys: this buffer participates in the live-staged leak
+    # accounting (release() decrements exactly once)
+    tracked: bool = False
 
     @property
     def size(self) -> int:
@@ -340,7 +381,9 @@ class StagedKeys:
         once every result depending on it has materialized host-side. The
         host pad buffer goes back to its :class:`StagingPool` free-list
         here — not at stage time — because the device array may alias it.
-        Idempotent (the pool hand-back happens exactly once)."""
+        Idempotent: the pool hand-back and the live-staged decrement each
+        happen exactly once (unwind paths — executor abort, pipeline
+        close — may race a normal release on the same chunk)."""
         delete = getattr(self.data, "delete", None)
         if delete is not None:
             try:
@@ -352,6 +395,9 @@ class StagedKeys:
             # frozen dataclass: clear via object.__setattr__ so a second
             # release() cannot double-insert the buffer (aliasing hazard)
             object.__setattr__(self, "host_buf", None)
+        if self.tracked:
+            object.__setattr__(self, "tracked", False)
+            _live_staged_dec()
 
 
 def _bucket_elems(n: int) -> int:
@@ -380,9 +426,10 @@ def stage_keys(keys: np.ndarray, device=None, pool: StagingPool | None = None) -
     if bucket == n:
         data = jax.device_put(keys, device)
         data.block_until_ready()
+        _live_staged_inc()
         # device recorded even without a pad buffer: the spill tee keys
         # its records by the staged slot (chunk->device determinism)
-        return StagedKeys(data, n, device=device)
+        return StagedKeys(data, n, device=device, tracked=True)
     if pool is None:
         pool = STAGING_POOL
     buf = pool.acquire(bucket, keys.dtype, device)
@@ -390,10 +437,13 @@ def stage_keys(keys: np.ndarray, device=None, pool: StagingPool | None = None) -
     buf[n:] = 0  # zero only the pad tail, not the whole bucket
     data = jax.device_put(buf, device)
     data.block_until_ready()
+    _live_staged_inc()
     # the pad buffer is NOT recycled yet: device_put may alias host memory
     # (CPU zero-copy), so it rides the StagedKeys and returns to the pool
     # when the consumer release()s the slot
-    return StagedKeys(data, n, host_buf=buf, pool=pool, device=device)
+    return StagedKeys(
+        data, n, host_buf=buf, pool=pool, device=device, tracked=True
+    )
 
 
 @dataclasses.dataclass
@@ -558,6 +608,11 @@ class ChunkPipeline:
                 # keys — at the bench's 512 MB staged chunks that dead
                 # weight would double the per-slot memory footprint
                 if not self._put((keys, np.empty((0,), c.dtype))):
+                    # consumer closed mid-put: the chunk we hold never
+                    # reaches it — release its staged slot here, or the
+                    # ring buffer (and the leak accounting) never sees it
+                    if isinstance(keys, StagedKeys):
+                        keys.release()
                     return
             self._put(_DONE)
         except BaseException as e:  # re-raised by the consumer
@@ -599,12 +654,24 @@ class ChunkPipeline:
         (including consumer-side exceptions like the replay-stability
         raise), so no thread outlives its pass."""
         self._stop.set()
-        while True:
-            try:
-                self._q.get_nowait()
-            except queue.Empty:
-                break
+
+        def _drain_queue():
+            while True:
+                try:
+                    item = self._q.get_nowait()
+                except queue.Empty:
+                    return
+                # staged chunks the consumer never saw: release their ring
+                # slots (and live-staged accounting) instead of dropping
+                # them on the floor
+                if isinstance(item, tuple) and isinstance(item[0], StagedKeys):
+                    item[0].release()
+
+        _drain_queue()
         self._thread.join(timeout=10.0)
+        # a final put may have landed between the drain above and the
+        # producer observing the stop flag — sweep again after the join
+        _drain_queue()
         if self._thread.is_alive():
             # a source blocked past the join timeout (slow disk/network
             # read): the no-thread-outlives-its-pass guarantee is violated

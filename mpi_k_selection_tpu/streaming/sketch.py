@@ -75,6 +75,39 @@ def _staged_extremes(data, n_valid):
     return _staged_extremes_fn(data, n_valid)
 
 
+class _SketchFoldConsumer:
+    """The sketch's :class:`~mpi_k_selection_tpu.streaming.executor.
+    StreamExecutor` consumer: staged chunks dispatch their deepest-level
+    int32 histogram + key-space extremes on their OWN device
+    (:meth:`RadixSketch._dispatch_staged`) and fold in FIFO chunk order at
+    finish; host/device-resident chunks fold immediately at dispatch (the
+    historical inline path). Buffer release rides the executor."""
+
+    def __init__(self, sketch: "RadixSketch"):
+        self._sketch = sketch
+        self.staged_chunks = 0
+
+    def dispatch(self, keys, kv):
+        import numpy as _np
+
+        from mpi_k_selection_tpu.streaming import pipeline as _pl
+
+        if isinstance(keys, _pl.StagedKeys):
+            self.staged_chunks += 1
+            return self._sketch._dispatch_staged(keys)
+        # device chunks arrive as device keys (bitwise twins of the host
+        # transform; the f64-on-TPU route already resolved to host-exact
+        # keys inside the iterator) — land them host-side for the bincount
+        # accumulator
+        if not isinstance(kv, _np.ndarray):
+            kv = _np.asarray(kv)
+        self._sketch._update_keys(kv)
+        return None
+
+    def finish(self, handle) -> None:
+        self._sketch._fold_staged(handle)
+
+
 class RadixSketch:
     """Mergeable multi-level radix-digit histogram over one dtype's streams."""
 
@@ -184,6 +217,7 @@ class RadixSketch:
         Returns ``self``."""
         from mpi_k_selection_tpu.obs import events as _ev
         from mpi_k_selection_tpu.obs import wiring as _wr
+        from mpi_k_selection_tpu.streaming import executor as _exec
         from mpi_k_selection_tpu.streaming import pipeline as _pl
         from mpi_k_selection_tpu.streaming import spill as _sp
         from mpi_k_selection_tpu.streaming.chunked import (
@@ -202,11 +236,13 @@ class RadixSketch:
             )
         src = as_chunk_source(source, one_shot_ok=spill is not None)
         writer = spill.new_generation() if spill is not None else None
-        win = _pl.InflightWindow(
-            len(devs), self._fold_staged,
-            occupancy=_wr.window_occupancy(obs),
+        consumer = _SketchFoldConsumer(self)
+        ex = _exec.StreamExecutor(
+            [consumer], window=len(devs),
+            occupancy=_wr.window_occupancy(obs, phase="sketch"),
         )
-        chunk_i = keys_read = staged_chunks = 0
+        chunk_i = keys_read = 0
+        keys = None
         try:
             with _pl._phase(timer, "sketch.pass"), _key_chunk_stream(
                 src, self.dtype, pipeline_depth=pipeline_depth, timer=timer,
@@ -225,20 +261,11 @@ class RadixSketch:
                         )
                     chunk_i += 1
                     keys_read += int(keys.size)
-                    if isinstance(keys, _pl.StagedKeys):
-                        staged_chunks += 1
-                        win.push(self._dispatch_staged(keys))
-                        continue
-                    # device chunks arrive as device keys (bitwise twins of
-                    # the host transform; the f64-on-TPU route already
-                    # resolved to host-exact keys inside the iterator) —
-                    # land them host-side for the bincount accumulator
-                    if not isinstance(keys, np.ndarray):
-                        keys = np.asarray(keys)
-                    self._update_keys(keys)
-                for _ in win.drain():
-                    pass
+                    ex.push(keys)
+                ex.drain()
         except BaseException:
+            ex.abort()
+            _exec.release_staged(keys)  # the chunk in hand (idempotent)
             if writer is not None:
                 writer.abort()
             raise
@@ -254,7 +281,7 @@ class RadixSketch:
                     chunks=chunk_i,
                     keys_read=keys_read,
                     bytes_read=keys_read * self.kdt.itemsize,
-                    staged_chunks=staged_chunks,
+                    staged_chunks=consumer.staged_chunks,
                 )
             )
             if obs.metrics is not None:
@@ -292,7 +319,9 @@ class RadixSketch:
         """Materialize one :meth:`_dispatch_staged` handle into the host
         int64 pyramid — the same int32-partial -> int64-accumulator merge
         discipline as ``parallel/sketch.py:distributed_sketch`` (pad keys
-        are key-space 0: an exact subtraction from deep bucket 0)."""
+        are key-space 0: an exact subtraction from deep bucket 0). Buffer
+        release belongs to the executor, which frees the staged slot once
+        the whole bundle has finished."""
         staged, deep, dmin, dmax = handle
         h = np.asarray(deep).astype(np.int64)
         if staged.pad:
@@ -305,7 +334,6 @@ class RadixSketch:
         if self._max_key is None or kmax > self._max_key:
             self._max_key = kmax
         self.n += staged.n_valid
-        staged.release()
 
     def _fold_deep_histogram(self, deep: np.ndarray) -> None:
         """Accumulate one deepest-level int64 histogram into every level

@@ -239,22 +239,30 @@ class SpillGeneration:
     def keys(self) -> int:
         return sum(r.n_valid for r in self.records)
 
-    def iter_chunks(self):
+    def iter_chunks(self, mmap: bool = False):
         """Yield every record as a :class:`SpillChunk`, validating headers,
         sizes and checksums — any mismatch raises
-        :class:`~mpi_k_selection_tpu.errors.SpillRecordError`."""
+        :class:`~mpi_k_selection_tpu.errors.SpillRecordError`. With
+        ``mmap`` the payload is served as a read-only ``np.memmap`` view
+        (page-cache backed, checksummed in place) instead of a fresh heap
+        copy — the deferred executor's replay mode, where most of each
+        record's bytes are about to be filtered away on device anyway."""
         if self.dropped:
             raise SpillError(
                 f"spill generation {self.index} was dropped (or its store "
                 "closed); it can no longer serve as a chunk source"
             )
         for rec in self.records:
-            yield _read_record(rec)
+            yield _read_record(rec, mmap=mmap)
 
-    def as_source(self):
+    def as_source(self, mmap: bool = False):
         """Zero-arg callable returning a fresh record iterator — the
         replayable chunk-source form streaming/chunked.py consumes."""
-        return self.iter_chunks
+        if not mmap:
+            return self.iter_chunks
+        import functools
+
+        return functools.partial(self.iter_chunks, mmap=True)
 
     def __repr__(self) -> str:  # pragma: no cover - debugging aid
         return (
@@ -263,7 +271,7 @@ class SpillGeneration:
         )
 
 
-def _read_record(rec: SpillRecord) -> SpillChunk:
+def _read_record(rec: SpillRecord, mmap: bool = False) -> SpillChunk:
     try:
         f = open(rec.path, "rb")
     except OSError as e:
@@ -304,18 +312,40 @@ def _read_record(rec: SpillRecord) -> SpillChunk:
                 f"spill record {rec.path}: payload size {nbytes} != "
                 f"{n_valid} x {key_dt.itemsize}-byte keys"
             )
-        payload = f.read(nbytes)
-        if len(payload) != nbytes:
-            raise SpillRecordError(
-                f"spill record {rec.path}: truncated payload "
-                f"({len(payload)} of {nbytes} bytes)"
+        if not mmap:
+            payload = f.read(nbytes)
+            if len(payload) != nbytes:
+                raise SpillRecordError(
+                    f"spill record {rec.path}: truncated payload "
+                    f"({len(payload)} of {nbytes} bytes)"
+                )
+            if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+                raise SpillRecordError(
+                    f"spill record {rec.path}: checksum mismatch (corrupt payload)"
+                )
+            keys = np.frombuffer(payload, dtype=key_dt)
+    if mmap and n_valid == 0:  # pragma: no cover - writers skip empty chunks
+        keys = np.empty((0,), key_dt)
+    elif mmap:
+        # read-only page-cache view of the payload (no heap copy); the
+        # checksum still runs over EVERY payload byte before a single key
+        # reaches a consumer — mmap changes residency, never the contract
+        try:
+            keys = np.memmap(  # ksel: noqa[KSL008] -- mode="r": a read-only payload view inside the sanctioned spill module, not a write
+                rec.path, dtype=key_dt, mode="r",
+                offset=_HEADER.size, shape=(int(n_valid),),
             )
-        if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+        except (OSError, ValueError) as e:
+            raise SpillRecordError(
+                f"spill record {rec.path}: truncated payload (mmap of "
+                f"{nbytes} bytes failed: {e})"
+            ) from e
+        if (zlib.crc32(keys) & 0xFFFFFFFF) != crc:
             raise SpillRecordError(
                 f"spill record {rec.path}: checksum mismatch (corrupt payload)"
             )
     return SpillChunk(
-        keys=np.frombuffer(payload, dtype=key_dt),
+        keys=keys,
         orig_dtype=orig_dt,
         device_slot=None if slot < 0 else int(slot),
         chunk_index=int(chunk_index),

@@ -55,6 +55,24 @@ def _no_leaked_pipeline_threads():
 
 
 @pytest.fixture(autouse=True)
+def _no_leaked_staged_buffers():
+    """Every staged key buffer (``pipeline.stage_keys``) must be
+    ``release()``d by the time its pass returns — on success AND on every
+    raise path, including a consumer raise with executor bundles in
+    flight (StreamExecutor.abort) and a pipeline close with staged chunks
+    still queued. A nonzero delta is a leaked ring slot in
+    streaming/executor.py or streaming/pipeline.py, not test noise."""
+    from mpi_k_selection_tpu.streaming.pipeline import live_staged_keys
+
+    before = live_staged_keys()
+    yield
+    after = live_staged_keys()
+    assert after <= before, (
+        f"leaked staged key buffers: {after - before} never release()d"
+    )
+
+
+@pytest.fixture(autouse=True)
 def _no_leaked_spill_dirs():
     """Every internally-created spill store (streaming/spill.py) must be
     removed by the time its descent returns — on success AND on every

@@ -628,6 +628,85 @@ def test_ksl010_noqa(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# KSL011 — eager device gathers on streaming chunk-consume paths
+
+
+KSL011_POSITIVE = """
+    import numpy as np
+    import jax
+
+    def consume_chunk(kv, m, writer):
+        surv = np.asarray(kv[m])               # eager boolean gather
+        head = jax.device_get(kv[:128])        # eager slice transfer
+        if surv.size:
+            writer.append(surv)
+"""
+
+KSL011_NEGATIVE = """
+    import numpy as np
+
+    def consume_chunk(kv, handle, executor, kdt):
+        keys = np.asarray(kv)                  # whole-array, not a gather
+        surv = kv[keys > 0]                    # host indexing (numpy in, numpy out)
+        executor.push(handle)                  # deferral: no sync here
+        return np.asarray([1, 2], kdt)         # literal, not a subscript
+"""
+
+
+def test_ksl011_positive_in_streaming(tmp_path):
+    report = _lint_source(
+        tmp_path, KSL011_POSITIVE,
+        name="mpi_k_selection_tpu/streaming/consume.py",
+    )
+    hits = [f for f in report.unsuppressed if f.rule == "KSL011"]
+    assert len(hits) == 2  # np.asarray(kv[m]) + jax.device_get(kv[:128])
+    assert any("deferred compaction" in f.message for f in hits)
+
+
+def test_ksl011_negative_non_gather_asarray_ok(tmp_path):
+    report = _lint_source(
+        tmp_path, KSL011_NEGATIVE,
+        name="mpi_k_selection_tpu/streaming/consume.py",
+    )
+    assert "KSL011" not in _rules_hit(report)
+
+
+def test_ksl011_quiet_in_executor_outside_streaming_and_tests(tmp_path):
+    # the executor owns the (deferred=off oracle) eager gather
+    report = _lint_source(
+        tmp_path, KSL011_POSITIVE,
+        name="mpi_k_selection_tpu/streaming/executor.py",
+    )
+    assert "KSL011" not in _rules_hit(report)
+    # the same pattern outside streaming/ is KSL011-quiet (KSL001 owns
+    # the jit-reachable variant)
+    report = _lint_source(
+        tmp_path, KSL011_POSITIVE, name="mpi_k_selection_tpu/ops/mod.py"
+    )
+    assert "KSL011" not in _rules_hit(report)
+    # test files poke chunks freely
+    report = _lint_source(
+        tmp_path, KSL011_POSITIVE,
+        name="mpi_k_selection_tpu/streaming/test_mod.py",
+    )
+    assert "KSL011" not in _rules_hit(report)
+
+
+def test_ksl011_noqa(tmp_path):
+    src = KSL011_POSITIVE.replace(
+        "surv = np.asarray(kv[m])               # eager boolean gather",
+        "surv = np.asarray(kv[m])  # ksel: noqa[KSL011] -- fixture justification",
+    )
+    report = _lint_source(
+        tmp_path, src, name="mpi_k_selection_tpu/streaming/consume.py"
+    )
+    hits = [f for f in report.unsuppressed if f.rule == "KSL011"]
+    assert len(hits) == 1  # the device_get gather still fires
+    sup = [f for f in report.findings if f.rule == "KSL011" and f.suppressed]
+    assert sup and sup[0].justification == "fixture justification"
+
+
+# ---------------------------------------------------------------------------
 # jaxpr contract checks (KSC101-KSC103) self-tests
 
 

@@ -1,0 +1,476 @@
+"""Async streaming executor (streaming/executor.py, ISSUE 8).
+
+The contracts under test:
+
+- **Bit-equality over the full grid**: devices {1, 2, max} x
+  pipeline_depth {0, 2} x spill {off, force} x deferred {on, off} all
+  return identical bits over heterogeneous (host + device + ragged +
+  empty) chunk streams, and ``deferred="off"`` reproduces the
+  pre-executor eager path.
+- **Host-exact routes bypass deferral**: 64-bit keys without x64 and
+  float64 (host key space) never stage, so nothing ever enters the
+  deferred window.
+- **Release discipline**: a consumer raise with bundles in flight leaks
+  neither ``ksel-pipeline-*`` threads nor staged buffers (the autouse
+  conftest fixtures enforce both; the tests also assert the live-staged
+  counter directly).
+- **The occupancy evidence**: on a multi-device deferred collect the
+  p-wide window's ``inflight.occupancy{phase="collect"}`` mean is > 1
+  (the r6 serialization retired), and the eager collect never samples it.
+- **Honest collect accounting**: the terminal StreamPassEvent carries the
+  per-spec survivor populations, held to the books by
+  check_stream_invariants.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mpi_k_selection_tpu import obs as obs_lib
+from mpi_k_selection_tpu.errors import SpillRecordError
+from mpi_k_selection_tpu.streaming import (
+    SpillStore,
+    StreamExecutor,
+    collect_hidden_frac,
+    live_staged_keys,
+    resolve_deferred,
+    streaming_kselect,
+    streaming_kselect_many,
+    streaming_rank_certificate,
+)
+from mpi_k_selection_tpu.streaming import executor as ex_mod
+from mpi_k_selection_tpu.streaming.pipeline import InflightWindow, stage_keys
+
+
+def _chunks(rng, sizes=(4096, 1, 0, 2777, 4096), device_chunk=1):
+    """Heterogeneous stream: host chunks, ragged sizes, an empty chunk,
+    and `device_chunk` chunks already resident on a device."""
+    out = [
+        rng.integers(-(2**31), 2**31 - 1, size=s, dtype=np.int32)
+        for s in sizes
+    ]
+    for i in range(device_chunk):
+        out[i * 3] = jnp.asarray(out[i * 3])
+    return out
+
+
+def _oracle(chunks, ks):
+    x = np.concatenate([np.asarray(c).ravel() for c in chunks])
+    part = np.partition(x, [k - 1 for k in ks])
+    return [int(part[k - 1]) for k in ks]
+
+
+# ---------------------------------------------------------------------------
+# the grid
+
+
+@pytest.mark.parametrize("devices", [None, 2, 8])
+@pytest.mark.parametrize("depth", [0, 2])
+@pytest.mark.parametrize("spill", ["off", "force"])
+@pytest.mark.parametrize("deferred", ["on", "off"])
+def test_grid_bit_equality(rng, devices, depth, spill, deferred):
+    chunks = _chunks(rng)
+    n = sum(int(np.asarray(c).size) for c in chunks)
+    ks = [1, n // 3, n // 2, n]
+    want = _oracle(chunks, ks)
+    got = streaming_kselect_many(
+        chunks, ks, radix_bits=8, collect_budget=256,
+        pipeline_depth=depth, devices=devices, spill=spill,
+        deferred=deferred,
+    )
+    assert [int(g) for g in got] == want
+    assert live_staged_keys() == 0
+
+
+def test_deferred_default_matches_eager_f32(rng):
+    chunks = [
+        rng.standard_normal(s).astype(np.float32) for s in (3000, 1500, 700)
+    ]
+    n = sum(c.size for c in chunks)
+    k = n // 2
+    kw = dict(radix_bits=8, collect_budget=128, devices=8, pipeline_depth=2)
+    a = streaming_kselect(chunks, k, deferred="on", **kw)
+    b = streaming_kselect(chunks, k, deferred="off", **kw)
+    c = streaming_kselect(chunks, k, pipeline_depth=0, radix_bits=8,
+                          collect_budget=128)
+    assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+    assert np.asarray(a).tobytes() == np.asarray(c).tobytes()
+
+
+def test_spill_generations_identical_across_deferred(rng):
+    """The deferred tee writes the SAME per-pass survivor bytes as the
+    eager tee (the multiset contract, visible in the pass_log)."""
+    chunks = _chunks(rng, sizes=(4096, 2048, 4096), device_chunk=0)
+    n = sum(c.size for c in chunks)
+    logs = {}
+    for deferred in ("on", "off"):
+        with SpillStore() as store:
+            streaming_kselect(
+                chunks, n // 2, radix_bits=4, collect_budget=64,
+                devices=8, pipeline_depth=2, spill=store, deferred=deferred,
+            )
+            logs[deferred] = [
+                {kk: e[kk] for kk in ("pass", "keys_read", "keys_written")
+                 if kk in e}
+                for e in store.pass_log
+            ]
+    assert logs["on"] == logs["off"]
+
+
+# ---------------------------------------------------------------------------
+# host-exact routes bypass deferral
+
+
+@pytest.mark.parametrize("dtype", [np.int64, np.float64])
+def test_host_exact_routes_bypass_deferral(rng, dtype):
+    """64-bit keys without x64 and f64 resolve to the host 'numpy' route:
+    nothing stages, so nothing enters the deferred window — and the
+    answers stay exact."""
+    if np.dtype(dtype).kind == "f":
+        chunks = [rng.standard_normal(s).astype(dtype) for s in (2000, 1000)]
+    else:
+        chunks = [
+            rng.integers(-(2**62), 2**62, size=s, dtype=dtype)
+            for s in (2000, 1000)
+        ]
+    n = sum(c.size for c in chunks)
+    k = n // 2
+    o = obs_lib.Observability.collecting()
+    got = streaming_kselect(
+        chunks, k, collect_budget=64, devices=8, pipeline_depth=2,
+        deferred="on", obs=o,
+    )
+    assert np.asarray(got).tobytes() == np.asarray(
+        np.sort(np.concatenate(chunks), kind="stable")[k - 1]
+    ).tobytes()
+    assert all(not e.staged for e in o.events.of_kind("stream.chunk"))
+    occ = o.metrics.histogram("inflight.occupancy")
+    assert occ.count == 0  # no bundle ever entered a window
+
+
+# ---------------------------------------------------------------------------
+# occupancy evidence
+
+
+def test_multidevice_deferred_collect_occupancy_mean_above_one(rng):
+    chunks = _chunks(rng, sizes=(4096,) * 6, device_chunk=0)
+    n = sum(c.size for c in chunks)
+    o = obs_lib.Observability.collecting()
+    streaming_kselect(
+        chunks, n // 2, collect_budget=n, devices=8, pipeline_depth=2,
+        deferred="on", obs=o,
+    )
+    occ = o.metrics.histogram(
+        "inflight.occupancy", labels={"phase": "collect"}
+    )
+    assert occ.count > 0
+    assert occ.mean > 1, (
+        f"deferred multi-device collect sampled mean occupancy {occ.mean} "
+        "— the window is degrading to serial"
+    )
+    frac = collect_hidden_frac(occ, 8)
+    assert frac is not None and 0.0 < frac <= 1.0
+
+
+def test_eager_collect_never_enters_the_window(rng):
+    chunks = _chunks(rng, sizes=(4096,) * 6, device_chunk=0)
+    n = sum(c.size for c in chunks)
+    o = obs_lib.Observability.collecting()
+    streaming_kselect(
+        chunks, n // 2, collect_budget=n, devices=8, pipeline_depth=2,
+        deferred="off", obs=o,
+    )
+    occ = o.metrics.histogram(
+        "inflight.occupancy", labels={"phase": "collect"}
+    )
+    assert occ.count == 0  # eager bundles skip the window entirely
+    assert collect_hidden_frac(occ, 8) is None
+
+
+# ---------------------------------------------------------------------------
+# honest collect accounting
+
+
+def test_collect_event_carries_honest_accounting(rng):
+    chunks = _chunks(rng, sizes=(4096, 2048, 1024), device_chunk=0)
+    n = sum(c.size for c in chunks)
+    ks = [1, n // 4, n // 2, n]
+    o = obs_lib.Observability.collecting()
+    streaming_kselect_many(
+        chunks, ks, radix_bits=4, collect_budget=64, devices=8,
+        pipeline_depth=2, obs=o,
+    )
+    obs_lib.check_stream_invariants(o.events.events)
+    passes = o.events.of_kind("stream.pass")
+    coll = passes[-1]
+    assert coll.pass_index == "collect"
+    assert coll.survivors and len(coll.survivors) == len(coll.prefixes)
+    assert all(s >= 1 for s in coll.survivors)
+    assert coll.bucket_total == sum(coll.survivors)
+    assert coll.bucket_max == max(coll.survivors)
+    assert coll.bucket_total <= coll.keys_read
+    # the collected populations are exactly the parked ranks' walked
+    # bucket counts from the histogram passes
+    assert coll.bucket_total <= passes[0].keys_read
+
+
+# ---------------------------------------------------------------------------
+# raise paths: no leaked threads, no leaked staged buffers
+
+
+class _Boom(Exception):
+    pass
+
+
+def _raise_on_chunk(pass_index, chunk_index):
+    def cb(event):
+        if (
+            event.kind == "stream.chunk"
+            and event.pass_index == pass_index
+            and event.chunk_index == chunk_index
+        ):
+            raise _Boom(f"injected at {pass_index}/{chunk_index}")
+
+    return obs_lib.CallbackSink(cb)
+
+
+@pytest.mark.parametrize("pass_index", [1, "collect"])
+def test_consumer_raise_with_bundles_in_flight_releases_everything(
+    rng, pass_index
+):
+    """A consumer-side raise mid-pass — after several deferred bundles
+    are in flight on a multi-device window — must unwind cleanly: the
+    executor aborts its pending bundles, the pipeline joins its producer
+    and releases queued staged chunks, internal spill stores are removed
+    (conftest enforces the thread/dir halves; the staged-buffer half is
+    asserted here AND by its autouse fixture)."""
+    chunks = _chunks(rng, sizes=(4096,) * 6, device_chunk=0)
+    n = sum(c.size for c in chunks)
+    base = live_staged_keys()
+    o = obs_lib.Observability(events=_raise_on_chunk(pass_index, 3))
+    with pytest.raises(_Boom):
+        streaming_kselect(
+            chunks, n // 2, radix_bits=4,
+            collect_budget=64 if pass_index == 1 else n,
+            devices=8, pipeline_depth=2, spill="force", deferred="on",
+            obs=o,
+        )
+    assert live_staged_keys() == base
+
+
+def test_certificate_raise_with_bundles_in_flight(rng):
+    chunks = _chunks(rng, sizes=(4096,) * 6, device_chunk=0)
+    base = live_staged_keys()
+    o = obs_lib.Observability(events=_raise_on_chunk("certificate", 3))
+    with pytest.raises(_Boom):
+        streaming_rank_certificate(
+            chunks, 0, devices=8, pipeline_depth=2, deferred="on", obs=o
+        )
+    assert live_staged_keys() == base
+
+
+# ---------------------------------------------------------------------------
+# the compaction program
+
+
+def test_compaction_matches_numpy_filter(rng):
+    kdt = np.dtype(np.uint32)
+    keys = rng.integers(0, 2**32, size=3011, dtype=np.uint32)  # ragged: pads
+    staged = stage_keys(keys)
+    try:
+        specs = [(8, int(keys[0] >> 24)), (16, int(keys[5] >> 16))]
+        handle = ex_mod.dispatch_compaction(staged, specs, kdt, 32)
+        got = ex_mod.materialize_compacted(handle, kdt)
+    finally:
+        staged.release()
+    m = np.zeros(keys.shape, bool)
+    for resolved, prefix in specs:
+        m |= (keys >> np.uint32(32 - resolved)) == np.uint32(prefix)
+    want = keys[m]
+    assert got.dtype == kdt
+    np.testing.assert_array_equal(got, want)  # order preserved, not just set
+
+
+def test_compaction_empty_and_full(rng):
+    kdt = np.dtype(np.uint32)
+    keys = np.full(1000, 0xABCD1234, np.uint32)  # ragged -> padded bucket
+    staged = stage_keys(keys)
+    try:
+        none = ex_mod.materialize_compacted(
+            ex_mod.dispatch_compaction(staged, [(16, 0x1111)], kdt, 32), kdt
+        )
+        all_ = ex_mod.materialize_compacted(
+            ex_mod.dispatch_compaction(staged, [(16, 0xABCD)], kdt, 32), kdt
+        )
+    finally:
+        staged.release()
+    assert none.size == 0
+    np.testing.assert_array_equal(all_, keys)  # pads must NOT leak in
+
+
+def test_certificate_deferred_pad_correction_at_key_zero(rng):
+    """Pad lanes are key-space 0; a probe value whose key IS 0 (int32
+    min) exercises both halves of the exact pad correction."""
+    lo = -(2**31)
+    chunks = [
+        np.asarray([lo, lo, 5, -3], np.int32),
+        rng.integers(lo, 2**31 - 1, size=777, dtype=np.int32),  # ragged
+    ]
+    for value in (lo, 0, 7):
+        got_on = streaming_rank_certificate(
+            chunks, value, devices=8, pipeline_depth=2, deferred="on"
+        )
+        got_off = streaming_rank_certificate(
+            chunks, value, devices=8, pipeline_depth=2, deferred="off"
+        )
+        x = np.concatenate(chunks)
+        want = (int(np.sum(x < value)), int(np.sum(x <= value)))
+        assert got_on == got_off == want
+
+
+# ---------------------------------------------------------------------------
+# mmap spill replay
+
+
+def test_mmap_spill_replay_bit_identical(rng):
+    chunks = _chunks(rng, sizes=(4096, 2048), device_chunk=0)
+    n = sum(c.size for c in chunks)
+    with SpillStore() as store:
+        # tee gen 0 via a forced spill descent, then read the store back
+        # as a source under both executor modes
+        want = int(streaming_kselect(chunks, n // 2, spill=store))
+        got_mmap = int(streaming_kselect(store, n // 3, deferred="on"))
+        got_read = int(streaming_kselect(store, n // 3, deferred="off"))
+    x = np.concatenate(chunks)
+    assert got_mmap == got_read == int(np.partition(x, n // 3 - 1)[n // 3 - 1])
+    assert want == int(np.partition(x, n // 2 - 1)[n // 2 - 1])
+
+
+def test_mmap_read_still_checksums(rng):
+    import glob
+    import os
+
+    chunks = [rng.integers(0, 100, size=2048, dtype=np.int32)]
+    with SpillStore() as store:
+        streaming_kselect(chunks, 100, spill=store)
+        recs = sorted(
+            glob.glob(os.path.join(store.root, "gen-*", "r*.kspill"))
+        )
+        assert recs
+        with open(recs[0], "r+b") as f:  # flip one payload byte
+            f.seek(-1, os.SEEK_END)
+            b = f.read(1)
+            f.seek(-1, os.SEEK_END)
+            f.write(bytes([b[0] ^ 0xFF]))
+        with pytest.raises(SpillRecordError, match="checksum"):
+            streaming_kselect(store, 100, deferred="on")  # mmap route
+        with pytest.raises(SpillRecordError, match="checksum"):
+            streaming_kselect(store, 100, deferred="off")  # buffered route
+
+
+# ---------------------------------------------------------------------------
+# knob + helper units
+
+
+def test_resolve_deferred():
+    assert resolve_deferred("auto") is True
+    assert resolve_deferred("on") is True
+    assert resolve_deferred("off") is False
+    assert resolve_deferred(True) is True
+    assert resolve_deferred(False) is False
+    with pytest.raises(ValueError, match="deferred"):
+        resolve_deferred("sometimes")
+    with pytest.raises(ValueError, match="deferred"):
+        streaming_kselect([np.arange(4, dtype=np.int32)], 1, deferred=1.5)
+
+
+def test_collect_hidden_frac_math():
+    class H:
+        count = 4
+        mean = 3.0
+
+    assert collect_hidden_frac(H(), 5) == pytest.approx(0.5)
+    assert collect_hidden_frac(H(), 1) is None  # serial window
+    assert collect_hidden_frac(None, 8) is None
+    H.count = 0
+    assert collect_hidden_frac(H(), 8) is None  # no samples
+
+    class Full:
+        count = 10
+        mean = 9.0
+
+    assert collect_hidden_frac(Full(), 8) == 1.0  # clamped
+
+
+def test_inflight_window_clear_pending():
+    done = []
+    win = InflightWindow(4, done.append)
+    for i in range(3):
+        win.push(i)
+    assert done == []
+    assert win.clear_pending() == [0, 1, 2]
+    assert list(win.drain()) == []
+    assert done == []
+
+
+def test_executor_eager_bundles_skip_window():
+    class Eager:
+        folded = []
+
+        def dispatch(self, keys, kv):
+            self.folded.append(int(kv.sum()))
+            return None
+
+        def finish(self, handle):  # pragma: no cover - never pending
+            raise AssertionError("eager consumer must not be finished")
+
+    class Occ:
+        samples = []
+
+        def observe(self, v):
+            self.samples.append(v)
+
+    ex = StreamExecutor([Eager()], window=8, occupancy=Occ())
+    for i in range(5):
+        ex.push(np.full(3, i, np.int64))
+    ex.drain()
+    assert Eager.folded == [0, 3, 6, 9, 12]
+    assert Occ.samples == []
+
+
+def test_streaming_quantiles_deferred_knob(rng):
+    from mpi_k_selection_tpu.api import StreamingQuantiles
+
+    with pytest.raises(ValueError, match="deferred"):
+        StreamingQuantiles(np.float32, deferred="bogus")
+    chunks = [rng.standard_normal(4000).astype(np.float32) for _ in range(3)]
+    qs = (0.1, 0.5, 0.9)
+    got = {}
+    for deferred in ("on", "off"):
+        sq = StreamingQuantiles(
+            np.float32, devices=8, deferred=deferred
+        ).update_stream(chunks)
+        got[deferred] = [
+            np.asarray(v).tobytes() for v in sq.refine_quantiles(qs, chunks)
+        ]
+    assert got["on"] == got["off"]
+
+
+def test_cli_deferred_flag(capsys):
+    import json
+
+    from mpi_k_selection_tpu.cli import main
+
+    for mode in ("on", "off"):
+        rc = main([
+            "--streaming", "--backend", "tpu", "--n", "40000",
+            "--chunk-elems", "8192", "--devices", "2", "--verify", "--check",
+            "--deferred", mode, "--json",
+        ])
+        assert rc == 0
+        rec = json.loads(capsys.readouterr().out)
+        assert rec["extra"]["exact_match"] is True
+        assert rec["extra"]["certificate_ok"] is True
+        assert rec["extra"]["deferred"] == mode

@@ -186,6 +186,7 @@ def test_round_robin_places_chunks_on_successive_devices(rng):
         for keys, _ in pipe:
             assert isinstance(keys, pl.StagedKeys)
             seen.append(next(iter(keys.data.devices())))
+            keys.release()  # the consumer contract: every staged slot freed
     finally:
         pipe.close()
     assert seen == [devs[i % len(devs)] for i in range(6)]

@@ -230,6 +230,7 @@ def test_pipeline_inherits_default_device(rng):
                 assert isinstance(keys, pl.StagedKeys)
                 assert next(iter(keys.data.devices())) == target
                 n += keys.size
+                keys.release()  # the consumer contract: every slot freed
         finally:
             pipe.close()
     assert n == x.size

@@ -254,10 +254,17 @@ def main() -> int:
     want_sp = int(_sp_ksel(sp_chunks, sp_k, spill="off", **sp_kw))
     sp_devgrid = (1, ndev) if ndev > 1 else (1,)
     for dv in sp_devgrid:
-        got_sp = int(
-            _sp_ksel(sp_chunks, sp_k, spill="force", devices=dv, **sp_kw)
-        )
-        check(f"spill=force devices={dv} bit-identical", got_sp, want_sp)
+        for deferred in ("on", "off"):
+            got_sp = int(
+                _sp_ksel(
+                    sp_chunks, sp_k, spill="force", devices=dv,
+                    deferred=deferred, **sp_kw,
+                )
+            )
+            check(
+                f"spill=force devices={dv} deferred={deferred} bit-identical",
+                got_sp, want_sp,
+            )
     got_os = int(_sp_ksel(iter(sp_chunks), sp_k, **sp_kw))  # spill=auto
     check("spill one-shot generator", got_os, want_sp)
     with SpillStore() as sp_store:
@@ -270,6 +277,35 @@ def main() -> int:
             b <= a / (1 << 3) for a, b in zip(reads, reads[1:])
         )
         check("spill passes shrink geometrically", shrink_ok, True)
+
+    # the spill-pass device_scaling the ROADMAP sweep item needs: the
+    # deferred spill descent's wall at devices {1, all} (+ the eager
+    # wall at devices=all as the before/after) — on real silicon these
+    # are the numbers that show the r6 consumer serialization retired
+    # (CPU-mesh devices share one core, so only TPU values are load-
+    # bearing). time_fn blocks on the result: device-sync semantics.
+    if ndev > 1:
+        from mpi_k_selection_tpu.utils.timing import time_fn as _time_fn
+
+        spill_walls = {}
+        for label, dv, deferred in (
+            ("devices=1 deferred", 1, "on"),
+            (f"devices={ndev} deferred", ndev, "on"),
+            (f"devices={ndev} eager", ndev, "off"),
+        ):
+            secs, _ = _time_fn(
+                lambda dv=dv, deferred=deferred: _sp_ksel(
+                    sp_chunks, sp_k, spill="force", devices=dv,
+                    deferred=deferred, **sp_kw,
+                )
+            )
+            spill_walls[label] = round(secs, 4)
+        d1 = spill_walls["devices=1 deferred"]
+        dp = spill_walls[f"devices={ndev} deferred"]
+        print(
+            f"    spill-pass walls: {spill_walls} -> device_scaling "
+            f"{round(d1 / dp, 3) if dp else None}"
+        )
 
     # --- obs snapshot (ISSUE 6): one instrumented pipelined streaming run
     # whose record carries the numbers the ROADMAP TPU-validation sweep
@@ -322,10 +358,22 @@ def main() -> int:
     parsed = _json.loads(trace_json)
     check("obs chrome trace parses", bool(parsed["traceEvents"]), True)
     occ = o.metrics.histogram("inflight.occupancy")
+    occ_coll = o.metrics.histogram(
+        "inflight.occupancy", labels={"phase": "collect"}
+    )
+    from mpi_k_selection_tpu.streaming import (
+        collect_hidden_frac as _coll_frac,
+    )
+
+    chf = _coll_frac(occ_coll, ndev if ndev > 1 else 1)
     hidden_ob = _hidden_frac(ob_timer)
     snapshot = {
         "occupancy_mean": round(occ.mean, 3) if occ.count else None,
         "occupancy_max": occ.max,
+        "collect_occupancy_mean": (
+            round(occ_coll.mean, 3) if occ_coll.count else None
+        ),
+        "collect_hidden_frac": round(chf, 4) if chf is not None else None,
         "ingest_hidden_frac": (
             round(hidden_ob, 4) if hidden_ob is not None else None
         ),
