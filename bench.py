@@ -896,6 +896,103 @@ def bench_serve(on_tpu: bool):
     return exact
 
 
+def bench_chaos(on_tpu: bool):
+    """Resilience cost + seeded recovery on the spill config (ISSUE 9).
+
+    Two legs over the SAME stream (radix_bits=4 + tiny budget — the deep
+    spill descent, the config every recovery hook sits on):
+
+    - **fault-free overhead**: wall time with ``retry="off"`` (the
+      pre-resilience PR 8 path) vs ``retry`` at its default (policies
+      armed, no faults injected) — best-of-5 each, interleaved so host
+      drift hits both legs alike. The acceptance gate is
+      ``overhead_frac <= 0.02``: the policies are O(1) checks per
+      chunk/pass, so arming them must be ~free.
+    - **seeded chaos recovery**: the same descent under
+      ``FaultPlan.seeded`` (transient source/stage raises, spill-record
+      corruption, stalls through a VirtualSleeper so backoff costs no
+      wall time), REQUIRING the recovered answer be bit-identical to
+      the fault-free one, and reporting what fired and which recovery
+      actions ran.
+    """
+    import numpy as np
+
+    from mpi_k_selection_tpu import faults
+    from mpi_k_selection_tpu.obs import ListSink, Observability
+    from mpi_k_selection_tpu.streaming.chunked import streaming_kselect
+
+    n, chunk = (1 << 24, 1 << 21) if on_tpu else (1 << 21, 1 << 18)
+    nchunks, k = n // chunk, n // 2
+    rb, budget = 4, 512
+
+    def gen(i):
+        return np.random.default_rng(77 + i).integers(
+            -(2**31), 2**31 - 1, size=chunk, dtype=np.int32
+        )
+
+    source = lambda: (gen(i) for i in range(nchunks))
+    kw = dict(radix_bits=rb, collect_budget=budget, spill="force")
+
+    # warmup compiles every program both timed legs hit
+    streaming_kselect(source, k, **kw)
+
+    best_off = best_on = float("inf")
+    ans_off = ans_on = None
+    for _ in range(5):
+        t0 = time.perf_counter()
+        ans_off = streaming_kselect(source, k, retry="off", **kw)
+        best_off = min(best_off, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        ans_on = streaming_kselect(source, k, **kw)  # default policy
+        best_on = min(best_on, time.perf_counter() - t0)
+    overhead = best_on / best_off - 1.0
+
+    vs = faults.VirtualSleeper()
+    obs = Observability(events=ListSink())
+    plan = faults.FaultPlan.seeded(9, n_chunks=nchunks, faults=4)
+    with faults.inject(plan, sleeper=vs, obs=obs) as inj:
+        ans_chaos = streaming_kselect(
+            inj.wrap_chunk_source(source), k,
+            retry=faults.RetryPolicy(sleeper=vs), obs=obs, **kw,
+        )
+    exact = int(ans_off) == int(ans_on) == int(ans_chaos)
+    gate = 0.02
+    ok = exact and overhead <= gate
+    _emit(
+        {
+            "metric": "kselect_chaos_resilience",
+            # headline: fault-free throughput WITH the policies armed —
+            # the number that must not regress vs the PR 8 spill record
+            "value": round(n / best_on, 1) if exact else 0.0,
+            "unit": "elems/sec/chip",
+            "n": n,
+            "k": k,
+            "chunks": nchunks,
+            "radix_bits": rb,
+            "collect_budget": budget,
+            "seconds_retry_off": round(best_off, 6),
+            "seconds_retry_default": round(best_on, 6),
+            "overhead_frac": round(overhead, 4),
+            "overhead_gate": gate,
+            "chaos": {
+                "seed": 9,
+                "fired": list(inj.fired),
+                "recovery_actions": sorted(
+                    {
+                        e.action
+                        for e in obs.events.of_kind("fault")
+                        if e.action != "inject"
+                    }
+                ),
+                "virtual_backoff_seconds": round(vs.total, 4),
+                "recovered_exact": int(ans_chaos) == int(ans_off),
+            },
+            "exact_match": bool(exact),
+        }
+    )
+    return ok
+
+
 def bench_cgm_native():
     """BASELINE config: CGM/MPI parity backend, 4 ranks, N=16M, k=N/2.
 
@@ -984,6 +1081,7 @@ def main() -> int:
     )
     ok &= bench_streaming_oc(on_tpu)
     ok &= bench_serve(on_tpu)
+    ok &= bench_chaos(on_tpu)
     ok &= bench_cgm_native()
     ok &= bench_seq_oracle()
     return 0 if ok else 1

@@ -1,4 +1,4 @@
-"""AST lint rules (KSL001-KSL011) — each encodes a bug class a human
+"""AST lint rules (KSL001-KSL012) — each encodes a bug class a human
 reviewer caught in this repository at least once. docs/ANALYSIS.md holds
 the catalog with the historical incident behind every rule.
 
@@ -791,3 +791,89 @@ class StreamingEagerDeviceGather(Rule):
                     "materialize_compacted) so the transfer happens when "
                     "the FIFO window pops"
                 )
+
+
+# ---------------------------------------------------------------------------
+# KSL012 — silent broad excepts in the resilience layers; raw time.sleep
+
+
+@register
+class SilentSwallowOrRawSleep(Rule):
+    id = "KSL012"
+    title = (
+        "silent broad except in streaming//serve//faults/, or time.sleep "
+        "outside the injectable sleeper"
+    )
+    rationale = (
+        "The resilience vertical (faults/, docs/ROBUSTNESS.md) classifies "
+        "failures: transients are retried, spill corruption takes the "
+        "re-read/rebuild ladder, overload sheds — and every action emits a "
+        "typed FaultEvent. A bare `except:`/`except Exception:` that "
+        "neither re-raises nor even LOOKS at the exception swallows a "
+        "failure none of that machinery ever sees: the descent keeps "
+        "running on corrupt state, or a server thread dies silently — the "
+        "MPI_Abort posture's evil twin. Separately, a raw `time.sleep` "
+        "hard-codes real waiting into backoff/stall paths, making the "
+        "seeded chaos grid minutes-slow and untestable; "
+        "faults/sleeper.py's injectable Sleeper is the one sanctioned "
+        "wait surface (the waiting twin of KSL004's clock discipline)."
+    )
+
+    _BROAD = {"Exception", "BaseException"}
+    _SCOPED = ("/streaming/", "/serve/", "/faults/")
+    _SLEEPER = ("faults/sleeper.py",)
+
+    def _is_broad(self, handler: ast.ExceptHandler) -> bool:
+        if handler.type is None:
+            return True
+        types = (
+            handler.type.elts
+            if isinstance(handler.type, ast.Tuple)
+            else [handler.type]
+        )
+        return any(
+            dotted_name(t).split(".")[-1] in self._BROAD for t in types
+        )
+
+    def check_module(self, mod: SourceModule):
+        p = pathlib.Path(mod.path).resolve().as_posix()
+        if _is_test_file(mod):
+            return
+        if "/mpi_k_selection_tpu/" in p and not _path_endswith(
+            mod, *self._SLEEPER
+        ):
+            for node in ast.walk(mod.tree):
+                if (
+                    isinstance(node, ast.Call)
+                    and dotted_name(node.func) == "time.sleep"
+                ):
+                    yield node.lineno, (
+                        "`time.sleep()` outside faults/sleeper.py — route "
+                        "waiting through the injectable Sleeper "
+                        "(RetryPolicy backoff, chaos stalls) so tests and "
+                        "the seeded harness can virtualize it"
+                    )
+        if not any(seg in p for seg in self._SCOPED):
+            return
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ExceptHandler) or not self._is_broad(node):
+                continue
+            # sanctioned handling: re-raising (incl. conditionally), or
+            # binding the exception and actually using it (transporting
+            # it to another thread, mapping it to a status/typed error,
+            # emitting it) — "silent" means the exception VALUE is dropped
+            if any(isinstance(x, ast.Raise) for x in ast.walk(node)):
+                continue
+            if node.name and any(
+                isinstance(x, ast.Name) and x.id == node.name
+                for stmt in node.body
+                for x in ast.walk(stmt)
+            ):
+                continue
+            yield node.lineno, (
+                "broad except swallows the failure (no re-raise, and the "
+                "exception value is never used): the resilience layers "
+                "must retry, rebuild, shed, or surface a typed error — "
+                "and emit a FaultEvent — never drop a failure on the "
+                "floor (faults/, docs/ROBUSTNESS.md)"
+            )

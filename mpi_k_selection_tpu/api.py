@@ -311,6 +311,14 @@ def kselect_streaming(source, k, **kwargs):
     p-wide FIFO window pops, so multi-device collect/spill passes scale
     like the histogram passes instead of serializing on per-chunk eager
     gathers; ``"off"`` is the historical eager path, bit-identical.
+    ``retry`` arms the resilience policies (docs/ROBUSTNESS.md; default
+    on): transient source errors re-pull mid-pass, staging transfers
+    retry in place, failed passes re-run from the previous spill
+    generation, corrupt spill records re-read then rebuild, and ENOSPC
+    degrades ``spill="auto"`` with a warning (teeing generation 0 itself
+    has nothing to degrade to and raises typed) — recovered answers are
+    bit-identical to fault-free runs, and exhausted policies raise
+    typed errors; ``"off"`` restores fail-on-first-fault.
 
     ``obs`` (an :class:`~mpi_k_selection_tpu.obs.Observability`) turns on
     the descent telemetry — typed per-pass/per-chunk events, a metrics
@@ -320,7 +328,7 @@ def kselect_streaming(source, k, **kwargs):
     streaming/chunked.py:streaming_kselect for the full option set
     (``radix_bits``, ``hist_method``, ``collect_budget``, ``sketch``,
     ``pipeline_depth``, ``timer``, ``devices``, ``spill``, ``spill_dir``,
-    ``deferred``, ``obs``)."""
+    ``deferred``, ``retry``, ``obs``)."""
     from mpi_k_selection_tpu.streaming.chunked import streaming_kselect
 
     return streaming_kselect(source, k, **kwargs)

@@ -154,6 +154,26 @@ def build_parser() -> argparse.ArgumentParser:
         "passes; off = the historical eager gather at chunk-arrival "
         "time. Answers are bit-identical in every mode",
     )
+    p.add_argument(
+        "--retry", choices=("default", "off"), default="default",
+        help="--streaming resilience policies (faults/, docs/ROBUSTNESS.md): "
+        "default = bounded retry (3 attempts, exponential backoff) for "
+        "transient source/staging failures, pass re-runs from the previous "
+        "spill generation, the corrupt-record re-read/rebuild ladder, and "
+        "the ENOSPC spill downgrade; off = fail on the first fault (the "
+        "pre-resilience behavior). Recovered answers are bit-identical",
+    )
+    p.add_argument(
+        "--chaos", type=int, default=None, metavar="SEED",
+        help="--streaming fault-injection harness (faults/): run the solve "
+        "under a FaultPlan.seeded(SEED) — deterministic transient "
+        "source/staging raises, spill-record corruption, stalls — and "
+        "record what fired and what recovered in the result record's "
+        "'chaos' entry. The same SEED replays the same faults; "
+        "--verify/--check still hold, proving recovery changed no answer "
+        "bit. Faults are injected on the FIRST touch of each chosen "
+        "site/index, so with --repeats > 1 later repeats run fault-free",
+    )
     p.add_argument("--repeats", type=int, default=1)
     p.add_argument("--verify", action="store_true", help="check against the seq oracle")
     p.add_argument(
@@ -392,16 +412,38 @@ def _run_streaming(args, obs=None):
         if args.spill == "force" and args.repeats <= 1
         else None
     )
+    # --chaos SEED: arm the seeded fault-injection harness around the
+    # solve (faults/). The solve's source is wrapped so scheduled pulls
+    # fail; --verify/--check below use the UNWRAPPED source, so the
+    # exactness checks judge the RECOVERED answer against clean reads.
+    import contextlib
+
+    injector = None
+    solve_source = source
+    inject_ctx = contextlib.nullcontext()
+    if args.chaos is not None:
+        from mpi_k_selection_tpu.faults import FaultInjector, FaultPlan
+        from mpi_k_selection_tpu.faults import inject as _arm
+
+        nchunks_plan = max(1, -(-n // args.chunk_elems))
+        injector = FaultInjector(
+            FaultPlan.seeded(args.chaos, n_chunks=nchunks_plan), obs=obs
+        )
+        solve_source = injector.wrap_chunk_source(source)
+        inject_ctx = _arm(injector)
     fn = lambda: kselect_streaming(
-        source, k, hist_method=hist_method, pipeline_depth=depth, timer=ptimer,
+        solve_source, k, hist_method=hist_method, pipeline_depth=depth,
+        timer=ptimer,
         devices=devices,
         spill=spill_store if spill_store is not None else args.spill,
         spill_dir=args.spill_dir,
         deferred=args.deferred,
+        retry=args.retry,
         obs=obs,
     )
     try:
-        seconds, answer = time_fn(fn, repeats=args.repeats, warmup=0)
+        with inject_ctx:
+            seconds, answer = time_fn(fn, repeats=args.repeats, warmup=0)
         record = ResultRecord(
             answer=np.asarray(answer).item(),
             n=n,
@@ -420,6 +462,19 @@ def _run_streaming(args, obs=None):
         record.extra["ingest_devices"] = n_ingest
         record.extra["spill"] = args.spill
         record.extra["deferred"] = args.deferred
+        record.extra["retry"] = args.retry
+        if injector is not None:
+            record.extra["chaos"] = {
+                "seed": args.chaos,
+                "plan": [
+                    {
+                        "site": s.site, "index": s.index, "kind": s.kind,
+                        "attempts": list(s.attempts),
+                    }
+                    for s in injector.plan.specs
+                ],
+                "fired": list(injector.fired),
+            }
         if spill_store is not None:
             record.extra["spill_passes"] = list(spill_store.pass_log)
         if ptimer is not None and ptimer.phases:
@@ -467,10 +522,21 @@ def _run_streaming(args, obs=None):
                 from mpi_k_selection_tpu import obs as obs_lib
 
                 cert_obs = obs_lib.Observability(trace=obs.trace)
+            # under --chaos, persistent disk faults (corrupt_disk,
+            # truncate) may have damaged the CLI-owned store's gen-0
+            # records — the SOLVE recovered by rebuilding from the
+            # source, but a certificate replaying the damaged store
+            # would (correctly) raise SpillRecordError; certify against
+            # the clean source instead, which is also the stronger check
+            cert_src = (
+                spill_store
+                if spill_store is not None and injector is None
+                else source
+            )
             less, leq = streaming_rank_certificate(
-                spill_store if spill_store is not None else source,
+                cert_src,
                 answer, pipeline_depth=depth, devices=devices,
-                deferred=args.deferred, obs=cert_obs,
+                deferred=args.deferred, retry=args.retry, obs=cert_obs,
             )
             cert_ok = less < k <= leq
             record.extra["rank_certificate"] = [less, leq]

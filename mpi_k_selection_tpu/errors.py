@@ -25,3 +25,35 @@ class SpillRecordError(SpillError):
     header/payload, or a checksum/metadata mismatch. Raised BEFORE any key
     reaches a histogram: a corrupt spill cache must fail loudly, never feed
     the descent silently wrong survivors."""
+
+
+class SpillCapacityError(SpillError):
+    """The spill store ran out of disk (ENOSPC) in a mode that cannot
+    degrade: ``spill="force"`` and caller-owned stores asked for the spill
+    explicitly, so a silent fallback to the replay path would hide a real
+    capacity problem. ``spill="auto"`` descents degrade to the replay of
+    the last good generation instead of raising this (a warning
+    FaultEvent marks the downgrade) — see docs/ROBUSTNESS.md."""
+
+
+class TransientError(RuntimeError):
+    """A failure the caller believes is retryable — a chunk-source hiccup,
+    a staging transfer blip. The resilience policies
+    (faults/policy.py:RetryPolicy) retry exactly this class (plus
+    ``ConnectionError``/``TimeoutError``) with bounded backoff; anything
+    else propagates immediately, because retrying a logic error just
+    repeats it. The fault-injection harness raises this for its
+    ``"raise"`` fault kind, so injected transients exercise the same
+    recovery path real ones take."""
+
+
+class RetryExhaustedError(RuntimeError):
+    """A :class:`~mpi_k_selection_tpu.faults.RetryPolicy` ran out of
+    attempts: the operation kept failing with transient errors past
+    ``max_attempts``. Carries ``site`` (which operation) and ``attempts``;
+    the last underlying error rides ``__cause__``."""
+
+    def __init__(self, message: str, *, site: str = "", attempts: int = 0):
+        super().__init__(message)
+        self.site = site
+        self.attempts = attempts

@@ -1,0 +1,94 @@
+"""Deterministic fault injection + resilience policies.
+
+The reference's only failure story is ``MPI_Abort`` — any anomaly kills
+the program. A system serving heavy traffic must *degrade* under faults
+instead: retry the transient ones, rebuild from redundant state, shed
+load, and keep every exactness guarantee intact through recovery. This
+package is both halves of that story:
+
+- **Injection** (plan.py, inject.py, sleeper.py): a seeded, frozen
+  :class:`FaultPlan` — replayable from one integer — executed by a
+  :class:`FaultInjector` at the real failure surfaces (chunk pull,
+  staging ``device_put``, spill record write/read, the serve dispatch
+  loop), with sleeper-backed stalls and REAL on-disk corruption so the
+  production validation machinery (CRC32, size checks) trips exactly as
+  it would in the wild. Armed via the :func:`inject` context manager;
+  usable from tests, the gauntlet, and the CLI ``--chaos`` knob.
+- **Policies** (policy.py): :class:`RetryPolicy` (bounded attempts,
+  exponential backoff through the injectable
+  :class:`~mpi_k_selection_tpu.faults.sleeper.Sleeper`),
+  :func:`retry_call` (in-place retry), and :func:`resilient_source`
+  (mid-pass re-pull for replayable chunk sources). Pass-level recovery —
+  re-running a streamed pass from the previous spill generation, the
+  corrupt-record re-read/rebuild ladder, the ENOSPC downgrade — lives
+  with the descent (streaming/chunked.py) and consumes these policies.
+
+Every fault, retry, shed and downgrade emits a typed
+:class:`~mpi_k_selection_tpu.obs.events.FaultEvent` plus metrics through
+the existing obs registry, and recovered runs are test-enforced
+bit-identical to fault-free runs across the devices x depth x spill x
+deferred grid (tests/test_faults.py). See docs/ROBUSTNESS.md for the
+fault taxonomy and recovery semantics.
+"""
+
+from __future__ import annotations
+
+from mpi_k_selection_tpu.errors import (
+    RetryExhaustedError,
+    SpillCapacityError,
+    TransientError,
+)
+from mpi_k_selection_tpu.faults.inject import (
+    FaultInjector,
+    active_injector,
+    apply_disk_fault,
+    inject,
+    maybe_fault,
+)
+from mpi_k_selection_tpu.faults.plan import (
+    FAULT_KINDS,
+    FAULT_SITES,
+    FaultPlan,
+    FaultSpec,
+)
+from mpi_k_selection_tpu.faults.policy import (
+    DEFAULT_RETRY,
+    DEFAULT_RETRYABLE,
+    RetryPolicy,
+    resilient_source,
+    resolve_retry,
+    retry_call,
+)
+from mpi_k_selection_tpu.faults.sleeper import (
+    DEFAULT_SLEEPER,
+    RealSleeper,
+    Sleeper,
+    VirtualSleeper,
+    resolve_sleeper,
+)
+
+__all__ = [
+    "DEFAULT_RETRY",
+    "DEFAULT_RETRYABLE",
+    "DEFAULT_SLEEPER",
+    "FAULT_KINDS",
+    "FAULT_SITES",
+    "FaultInjector",
+    "FaultPlan",
+    "FaultSpec",
+    "RealSleeper",
+    "RetryExhaustedError",
+    "RetryPolicy",
+    "Sleeper",
+    "SpillCapacityError",
+    "TransientError",
+    "VirtualSleeper",
+    "active_injector",
+    "apply_disk_fault",
+    "inject",
+    "maybe_fault",
+    "resilient_source",
+    "resolve_retry",
+    "resolve_sleeper",
+    "retry_call",
+]

@@ -1,0 +1,237 @@
+"""Fault injector — executes a :class:`~mpi_k_selection_tpu.faults.plan.
+FaultPlan` at the real failure surfaces.
+
+The streaming and serving layers carry cheap hook points
+(``maybe_fault(site, ...)`` — one module-global ``is None`` check when no
+harness is active) at exactly the places real faults strike: the chunk
+pull, the staging ``device_put``, spill record writes and reads, and the
+batcher's dispatch loop. Activating a plan (:func:`inject`, a context
+manager) arms those hooks process-wide; the injector counts occurrences
+and attempts per site under a lock (producer threads, request threads and
+the consumer all hit it), fires the scheduled fault kinds — transient
+raises, sleeper-backed stalls, on-disk corruption/truncation so the REAL
+CRC/size validation trips, ENOSPC — and logs every firing (``fired``,
+plus a :class:`~mpi_k_selection_tpu.obs.events.FaultEvent` per firing
+when an obs bundle is attached).
+
+Only ONE injector can be active at a time (nesting raises): the plan's
+occurrence counters are process-global state, and two overlapping plans
+would see interleaved counts neither seeded for.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import errno as _errno
+import os
+import threading
+
+from mpi_k_selection_tpu.errors import SpillRecordError, TransientError
+from mpi_k_selection_tpu.faults.plan import FaultPlan, FaultSpec
+from mpi_k_selection_tpu.faults.sleeper import resolve_sleeper
+from mpi_k_selection_tpu.obs.wiring import fault_event
+
+
+class FaultInjector:
+    """Runtime executor for one plan. ``check``/``maybe_fault`` are the
+    hook-point API; ``wrap_chunk_source`` arms a chunk source with the
+    plan's ``"source"`` specs. ``fired`` is the chronological injection
+    log (dicts: site/kind/index/attempt) the chaos tests and the CLI
+    ``--chaos`` report read back."""
+
+    def __init__(self, plan: FaultPlan, *, sleeper=None, obs=None):
+        if not isinstance(plan, FaultPlan):
+            raise ValueError(f"expected a FaultPlan, got {plan!r}")
+        self.plan = plan
+        self.sleeper = resolve_sleeper(sleeper)
+        self.obs = obs
+        self._lock = threading.Lock()
+        self._site_calls: dict[str, int] = {}  # auto-index per site
+        self._attempts: dict[tuple, int] = {}  # (site, index) -> tries
+        self.fired: list[dict] = []
+        self._by_key = {}
+        for s in plan.specs:
+            # later specs for the same (site, index) extend the earlier
+            # ones' attempt set rather than silently shadowing them
+            self._by_key.setdefault((s.site, s.index), []).append(s)
+
+    # -- bookkeeping -------------------------------------------------------
+
+    def _next_index(self, site: str) -> int:
+        i = self._site_calls.get(site, 0)
+        self._site_calls[site] = i + 1
+        return i
+
+    def check(self, site: str, index: int | None = None) -> FaultSpec | None:
+        """Advance the (site, index) attempt counter and return the spec
+        scheduled for this attempt, if any. ``index=None`` auto-indexes
+        by site call order (the ``stage``/``spill.write``/
+        ``serve.dispatch`` sites, where "occurrence i" means the i-th
+        call)."""
+        with self._lock:
+            if index is None:
+                index = self._next_index(site)
+            key = (site, int(index))
+            attempt = self._attempts.get(key, 0)
+            self._attempts[key] = attempt + 1
+            for spec in self._by_key.get(key, ()):
+                if attempt in spec.attempts:
+                    self.fired.append(
+                        {
+                            "site": site,
+                            "kind": spec.kind,
+                            "index": int(index),
+                            "attempt": attempt,
+                        }
+                    )
+                    self._emit(spec, int(index), attempt)
+                    return spec
+        return None
+
+    def _emit(self, spec: FaultSpec, index: int, attempt: int) -> None:
+        fault_event(
+            self.obs, spec.site, "inject",
+            fault_kind=spec.kind, index=index, attempt=attempt,
+            counter="faults.injected", labels={"site": spec.site},
+        )
+
+    # -- execution ---------------------------------------------------------
+
+    def maybe_fault(self, site: str, index: int | None = None, path=None):
+        """Hook-point entry: fire the scheduled fault for this call, if
+        any. Raising kinds raise here (``"raise"`` ->
+        :class:`TransientError`; ``"enospc"`` -> ``OSError(ENOSPC)``;
+        ``"corrupt"`` -> :class:`SpillRecordError`, the transient bad
+        read). ``"stall"`` sleeps through the injectable sleeper and
+        proceeds. The persistent disk kinds (``"corrupt_disk"``,
+        ``"truncate"``) damage ``path`` on disk and proceed — the caller's
+        own CRC/size validation then fails exactly as it would for real
+        corruption."""
+        spec = self.check(site, index)
+        if spec is None:
+            return None
+        if spec.kind == "stall":
+            self.sleeper.sleep(spec.arg)
+            return spec
+        if spec.kind == "raise":
+            raise TransientError(
+                f"injected transient fault at {site}[{spec.index}]"
+            )
+        if spec.kind == "enospc":
+            raise OSError(
+                _errno.ENOSPC,
+                f"injected ENOSPC at {site}[{spec.index}]",
+            )
+        if spec.kind == "corrupt":
+            raise SpillRecordError(
+                f"injected transient checksum mismatch at {site}[{spec.index}]"
+            )
+        # persistent disk damage: the real validation machinery trips
+        if path is not None:
+            apply_disk_fault(path, spec.kind)
+        return spec
+
+    def wrap_chunk_source(self, src):
+        """Arm a replayable chunk-source callable with this injector's
+        ``"source"`` specs: pulling chunk *i* consults
+        ``maybe_fault("source", i)`` first, so scheduled raises/stalls
+        strike before the chunk exists — the upstream-hiccup shape. The
+        wrapped source stays replayable (each invocation re-iterates the
+        inner source; the per-chunk attempt counters persist across
+        invocations, which is exactly what lets retries and later passes
+        see the chunk recover)."""
+        injector = self
+
+        def wrapped():
+            it = iter(src())
+            def gen():
+                i = 0
+                while True:
+                    injector.maybe_fault("source", i)
+                    try:
+                        chunk = next(it)
+                    except StopIteration:
+                        return
+                    yield chunk
+                    i += 1
+            return gen()
+
+        return wrapped
+
+
+def apply_disk_fault(path: str, kind: str) -> None:
+    """Persist one fault into a spill record file: ``"corrupt_disk"``
+    XORs the file's last byte (payload territory — the header is
+    fixed-size at the front, and records are validated header-first, so
+    the flip lands in checksummed payload); ``"truncate"`` cuts the file
+    in half. Both make the record's own validation
+    (:class:`~mpi_k_selection_tpu.errors.SpillRecordError`) fire on
+    every subsequent read — real, persistent damage."""
+    size = os.path.getsize(path)
+    if kind == "truncate":
+        os.truncate(path, size // 2)
+        return
+    if kind == "corrupt_disk":
+        if size == 0:  # pragma: no cover - records always carry a header
+            return
+        with open(path, "r+b") as f:
+            f.seek(size - 1)
+            b = f.read(1)
+            f.seek(size - 1)
+            f.write(bytes([b[0] ^ 0xFF]))
+        return
+    raise ValueError(f"not a disk fault kind: {kind!r}")  # pragma: no cover
+
+
+# -- the process-wide active injector ---------------------------------------
+
+_ACTIVE: FaultInjector | None = None
+_ACTIVE_LOCK = threading.Lock()
+
+
+def active_injector() -> FaultInjector | None:
+    """The currently armed injector (None = no harness active — the
+    production state; every hook point is one ``is None`` check then)."""
+    return _ACTIVE
+
+
+def maybe_fault(site: str, index: int | None = None, path=None):
+    """The hook-point helper library code calls: no-op without an armed
+    injector, else :meth:`FaultInjector.maybe_fault`."""
+    inj = _ACTIVE
+    if inj is None:
+        return None
+    return inj.maybe_fault(site, index, path=path)
+
+
+@contextlib.contextmanager
+def inject(plan_or_injector, *, sleeper=None, obs=None):
+    """Arm a plan (or a pre-built injector) process-wide for the body of
+    the ``with`` block, yielding the injector (its ``fired`` log is the
+    post-run evidence). Exactly one injector may be active; nesting
+    raises. The hooks are disarmed on EVERY exit path."""
+    global _ACTIVE
+    if isinstance(plan_or_injector, FaultInjector):
+        if sleeper is not None or obs is not None:
+            # silently dropping these would de-virtualize sleeps (a
+            # "virtual" chaos run blocking for real) and lose every
+            # inject event from the telemetry — fail loudly instead
+            raise ValueError(
+                "pass sleeper=/obs= to FaultInjector(...) itself; "
+                "inject() does not rewire a pre-built injector"
+            )
+        inj = plan_or_injector
+    else:
+        inj = FaultInjector(plan_or_injector, sleeper=sleeper, obs=obs)
+    with _ACTIVE_LOCK:
+        if _ACTIVE is not None:
+            raise RuntimeError(
+                "a fault injector is already active; nested inject() is "
+                "not supported (occurrence counters are process-global)"
+            )
+        _ACTIVE = inj
+    try:
+        yield inj
+    finally:
+        with _ACTIVE_LOCK:
+            _ACTIVE = None

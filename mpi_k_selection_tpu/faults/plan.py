@@ -1,0 +1,183 @@
+"""Seeded fault plans — deterministic, replayable fault schedules.
+
+The reference's failure model is ``MPI_Abort`` on any anomaly; hardening
+the streaming/spill/serve vertical against real faults requires a way to
+*produce* those faults deterministically: ad-hoc mocks drift from the
+real failure surfaces, and random chaos that cannot be replayed from a
+seed cannot be debugged or regression-gated. A :class:`FaultPlan` is a
+frozen schedule — "fail occurrence *i* of site S on attempt *j* with
+fault kind K" — that the runtime injector (faults/inject.py) executes at
+the real hook points (chunk pull, staging ``device_put``, spill record
+write/read, the serve dispatch loop). The same plan replays the same
+faults, and :meth:`FaultPlan.seeded` derives one from a single integer,
+so the chaos grid, the gauntlet and the CLI ``--chaos`` knob all speak
+one seed.
+
+No clocks, no real sleeping: the ``"stall"`` kind waits through the
+injectable :class:`~mpi_k_selection_tpu.faults.sleeper.Sleeper` (KSL004
+discipline extended to waiting — see faults/sleeper.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+#: Every fault kind the injector can execute. Semantics:
+#:
+#: - ``"raise"``    — raise :class:`~mpi_k_selection_tpu.errors.
+#:   TransientError` (the retryable class) at the hook point;
+#: - ``"stall"``    — a slow producer/medium: sleep ``arg`` seconds via
+#:   the injectable sleeper, then proceed normally;
+#: - ``"corrupt"``  — a transient bad read: the spill reader raises
+#:   SpillRecordError for the matching attempt only (a re-read sees the
+#:   intact bytes — a flipped bit on the wire, not on the platter);
+#: - ``"corrupt_disk"`` — flip one payload byte ON DISK (persistent): the
+#:   real CRC32 check fails on this and every later read of the record;
+#: - ``"truncate"`` — truncate the record file on disk (persistent): the
+#:   real payload-size check fails from then on;
+#: - ``"enospc"``   — raise ``OSError(errno.ENOSPC)`` at the write hook.
+FAULT_KINDS = ("raise", "stall", "corrupt", "corrupt_disk", "truncate", "enospc")
+
+#: The hook points fault specs can target:
+#:
+#: - ``"source"``       — pulling chunk ``index`` from a wrapped chunk
+#:   source (faults/inject.py:wrap_chunk_source);
+#: - ``"stage"``        — the ``index``-th staging ``device_put``
+#:   (streaming/pipeline.py:stage_keys);
+#: - ``"spill.write"``  — appending record ``index`` of a generation
+#:   (streaming/spill.py:SpillWriter.append; per-generation record
+#:   counts, so attempt *j* of record *i* is its write in the *j*-th
+#:   generation — or re-run — that reaches it);
+#: - ``"spill.read"``   — reading the record with chunk_index ``index``
+#:   (streaming/spill.py:_read_record);
+#: - ``"serve.dispatch"`` — the ``index``-th dispatch round of the query
+#:   server's batcher loop (serve/batcher.py), OUTSIDE the per-group
+#:   error isolation — the supervisor-restart path. Rounds are a global
+#:   call sequence (a restart does not re-run a round), so only
+#:   ``attempts=(0,)`` is meaningful here.
+FAULT_SITES = ("source", "stage", "spill.write", "spill.read", "serve.dispatch")
+
+#: Which kinds make sense at which site (validated at plan build time so
+#: a typo fails at construction, not silently never-fires).
+_SITE_KINDS = {
+    "source": ("raise", "stall"),
+    "stage": ("raise", "stall"),
+    "spill.write": ("raise", "enospc"),
+    "spill.read": ("raise", "corrupt", "corrupt_disk", "truncate"),
+    "serve.dispatch": ("raise",),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One scheduled fault: occurrence ``index`` of ``site`` fails on
+    each attempt number in ``attempts`` (0-based; the injector counts how
+    many times that occurrence has been tried) with fault ``kind``.
+    ``arg`` parameterizes the kind (stall seconds).
+
+    The attempt counter spans the whole run: a chunk re-pulled by a
+    retry, a record re-read by the recovery ladder, and a chunk replayed
+    by a later radix pass all advance the same counter — so
+    ``attempts=(0,)`` is "fail the first touch, recover on the next" and
+    ``attempts=tuple(range(99))`` is "hard failure, exhaust any policy".
+    """
+
+    site: str
+    index: int
+    kind: str
+    attempts: tuple = (0,)
+    arg: float = 0.0
+
+    def __post_init__(self):
+        if self.site not in FAULT_SITES:
+            raise ValueError(
+                f"unknown fault site {self.site!r}; choose from {FAULT_SITES}"
+            )
+        if self.kind not in FAULT_KINDS:
+            raise ValueError(
+                f"unknown fault kind {self.kind!r}; choose from {FAULT_KINDS}"
+            )
+        if self.kind not in _SITE_KINDS[self.site]:
+            raise ValueError(
+                f"fault kind {self.kind!r} does not apply at site "
+                f"{self.site!r} (valid: {_SITE_KINDS[self.site]})"
+            )
+        if self.index < 0:
+            raise ValueError(f"fault index must be >= 0, got {self.index}")
+        atts = tuple(int(a) for a in self.attempts)
+        if not atts or any(a < 0 for a in atts):
+            raise ValueError(
+                f"attempts must be a non-empty tuple of ints >= 0, got "
+                f"{self.attempts!r}"
+            )
+        object.__setattr__(self, "attempts", atts)
+        object.__setattr__(self, "index", int(self.index))
+        object.__setattr__(self, "arg", float(self.arg))
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """An immutable fault schedule. Build one explicitly from specs, or
+    derive one from a seed (:meth:`seeded`) — either way the plan is pure
+    data: executing it is the injector's job (faults/inject.py), so one
+    plan can drive many runs (the bit-equality grid runs every
+    devices x depth x spill x deferred combination under the SAME plan).
+    """
+
+    specs: tuple = ()
+    seed: int | None = None
+
+    def __post_init__(self):
+        specs = tuple(self.specs)
+        for s in specs:
+            if not isinstance(s, FaultSpec):
+                raise ValueError(f"FaultPlan specs must be FaultSpec, got {s!r}")
+        object.__setattr__(self, "specs", specs)
+
+    def for_site(self, site: str) -> tuple:
+        return tuple(s for s in self.specs if s.site == site)
+
+    @classmethod
+    def seeded(
+        cls,
+        seed: int,
+        *,
+        n_chunks: int = 8,
+        faults: int = 3,
+        sites: tuple = ("source", "stage", "spill.read"),
+        recoverable: bool = True,
+        stall_seconds: float = 0.001,
+    ) -> "FaultPlan":
+        """A deterministic plan from one integer: ``faults`` specs drawn
+        over ``sites``, each targeting an occurrence index in
+        ``[0, n_chunks)`` with a kind valid at its site. With
+        ``recoverable`` (the default) every spec fails a SINGLE attempt
+        — first-touch transients a default RetryPolicy / the spill
+        recovery ladder absorbs, which is what the bit-equality chaos
+        grid wants; ``recoverable=False`` makes every spec hard (fails
+        every attempt), the exhausted-policy form. Same seed, same plan
+        — the replayability contract the chaos tests and ``--chaos``
+        lean on."""
+        rng = np.random.default_rng(int(seed))
+        specs = []
+        for _ in range(int(faults)):
+            site = sites[int(rng.integers(len(sites)))]
+            kinds = _SITE_KINDS[site]
+            kind = kinds[int(rng.integers(len(kinds)))]
+            index = int(rng.integers(max(1, int(n_chunks))))
+            attempts = (0,) if recoverable else tuple(range(99))
+            if kind == "stall":
+                # a stall needs no recovery: keep it single-shot always
+                attempts = (0,)
+            specs.append(
+                FaultSpec(
+                    site=site,
+                    index=index,
+                    kind=kind,
+                    attempts=attempts,
+                    arg=stall_seconds if kind == "stall" else 0.0,
+                )
+            )
+        return cls(specs=tuple(specs), seed=int(seed))

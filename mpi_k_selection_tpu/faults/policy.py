@@ -1,0 +1,215 @@
+"""Resilience policies — bounded retry with injectable backoff.
+
+The reference aborts the whole program on any anomaly (``MPI_Abort``);
+the streaming vertical instead classifies failures and retries exactly
+the transient class — :class:`~mpi_k_selection_tpu.errors.
+TransientError` plus ``ConnectionError``/``TimeoutError`` by default —
+with bounded exponential backoff through the injectable sleeper
+(faults/sleeper.py; no raw ``time.sleep``, KSL012). Everything else
+propagates immediately: retrying a logic error just repeats it, slower.
+
+Two shapes of retry live here:
+
+- :func:`retry_call` — retry ONE operation in place (the staging
+  ``device_put``, where the host buffer is still in hand and re-issuing
+  the transfer is free);
+- :func:`resilient_source` — the mid-pass re-pull for replayable chunk
+  sources: a transient error while pulling chunk *i* re-invokes the
+  source callable, fast-forwards past the *i* chunks already consumed
+  (replay-stability is already a hard contract of the descent — the
+  downstream expected-count checks fail loudly if the re-pull drifts),
+  and resumes the pass WITHOUT restarting it. Exhaustion raises the
+  typed :class:`~mpi_k_selection_tpu.errors.RetryExhaustedError` with
+  the last failure as ``__cause__``.
+
+Pass-level recovery (re-running a whole streamed pass from the previous
+spill generation, the corrupt-record ladder, the ENOSPC downgrade) is
+descent-shaped and lives with the descent
+(streaming/chunked.py:_recover_pass); it consumes this module's policy
+for its attempt bounds and backoff.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from mpi_k_selection_tpu.errors import RetryExhaustedError, TransientError
+from mpi_k_selection_tpu.faults.sleeper import resolve_sleeper
+from mpi_k_selection_tpu.obs.wiring import fault_event
+
+#: Exception classes the default policy treats as transient. Deliberately
+#: narrow: plain RuntimeError/ValueError are logic errors, SpillRecordError
+#: has its own (re-read -> rebuild) ladder, and OSError-at-large would
+#: swallow ENOSPC, which has its own downgrade path.
+DEFAULT_RETRYABLE = (TransientError, ConnectionError, TimeoutError)
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded-retry configuration. ``max_attempts`` counts TOTAL tries
+    (3 = one original + two retries); backoff before retry *r* (1-based)
+    is ``min(backoff_base * 2**(r-1), backoff_max)`` seconds through
+    ``sleeper`` (None = the real package sleeper; tests pass a
+    :class:`~mpi_k_selection_tpu.faults.sleeper.VirtualSleeper`)."""
+
+    max_attempts: int = 3
+    backoff_base: float = 0.05
+    backoff_max: float = 2.0
+    retryable: tuple = DEFAULT_RETRYABLE
+    sleeper: object = None
+
+    def __post_init__(self):
+        if int(self.max_attempts) < 1:
+            raise ValueError(
+                f"max_attempts must be >= 1, got {self.max_attempts}"
+            )
+        if self.backoff_base < 0 or self.backoff_max < 0:
+            raise ValueError("backoff bounds must be >= 0")
+
+    def is_retryable(self, exc: BaseException) -> bool:
+        return isinstance(exc, tuple(self.retryable))
+
+    def backoff(self, retry: int) -> float:
+        """Seconds to wait before retry number ``retry`` (1-based)."""
+        return min(self.backoff_base * (2.0 ** max(0, retry - 1)), self.backoff_max)
+
+    def sleep(self, retry: int) -> None:
+        resolve_sleeper(self.sleeper).sleep(self.backoff(retry))
+
+
+#: The package default: 3 total attempts, 50 ms doubling backoff capped
+#: at 2 s. ``retry=None`` on the streaming entry points resolves here.
+DEFAULT_RETRY = RetryPolicy()
+
+
+def resolve_retry(retry):
+    """Normalize the ``retry`` knob: ``None``/``"default"`` ->
+    :data:`DEFAULT_RETRY`, ``"off"``/``False`` -> ``None`` (fail on the
+    first transient, the pre-resilience behavior), a
+    :class:`RetryPolicy` passes through."""
+    if retry is None or retry == "default":
+        return DEFAULT_RETRY
+    if retry == "off" or retry is False:
+        return None
+    if isinstance(retry, RetryPolicy):
+        return retry
+    raise ValueError(
+        f"retry must be None, 'default', 'off', or a RetryPolicy, got "
+        f"{retry!r}"
+    )
+
+
+def _emit_retry(obs, site, retry, exc) -> None:
+    fault_event(
+        obs, site, "retry", exc=exc, attempt=retry,
+        counter="faults.retries", labels={"site": site},
+    )
+
+
+def retry_call(fn, policy: RetryPolicy | None, *, site: str, obs=None):
+    """Run ``fn()`` under ``policy``: transient failures are retried in
+    place with backoff, up to ``policy.max_attempts`` total tries; the
+    exhausted form raises :class:`RetryExhaustedError` (last failure as
+    ``__cause__``). ``policy=None`` is a plain call."""
+    if policy is None:
+        return fn()
+    last = None
+    for attempt in range(policy.max_attempts):
+        try:
+            return fn()
+        except BaseException as e:
+            if not policy.is_retryable(e):
+                raise
+            last = e
+            retry = attempt + 1
+            if retry >= policy.max_attempts:
+                break
+            _emit_retry(obs, site, retry, e)
+            policy.sleep(retry)
+    raise RetryExhaustedError(
+        f"{site}: still failing after {policy.max_attempts} attempts "
+        f"({type(last).__name__}: {last})",
+        site=site,
+        attempts=policy.max_attempts,
+    ) from last
+
+
+def resilient_source(src, policy: RetryPolicy | None, *, obs=None):
+    """Wrap a REPLAYABLE chunk-source callable with mid-pass re-pull:
+    a transient error while pulling chunk *i* re-invokes ``src()``,
+    fast-forwards the fresh iterator past the *i* chunks this pass
+    already consumed, and resumes — the pass never restarts, and the
+    downstream replay-stability checks (expected per-prefix counts)
+    guarantee a drifting re-pull fails loudly rather than answering
+    wrong. Transient errors during the fast-forward count against the
+    same budget. ``policy=None`` returns ``src`` unchanged.
+
+    Only for replayable sources: a one-shot iterator cannot be
+    re-invoked (the spill path's recovery for those is the gen-0 tee —
+    streaming/chunked.py)."""
+    if policy is None:
+        return src
+
+    def wrapped():
+        def gen():
+            it = iter(src())
+            i = 0  # chunks successfully handed downstream
+            # the budget is per INCIDENT, not per stream: a successful
+            # pull resets it, so isolated transients on a long stream
+            # never accumulate into a spurious exhaustion — only
+            # max_attempts consecutive failures around one chunk exhaust
+            retries = 0
+
+            def _absorb(e, doing: str) -> None:
+                """One failure against the incident budget: re-raise
+                non-retryables, raise the typed exhausted form past the
+                budget, else emit the retry event and back off."""
+                nonlocal retries
+                if not policy.is_retryable(e):
+                    raise e
+                retries += 1
+                if retries >= policy.max_attempts:
+                    raise RetryExhaustedError(
+                        f"chunk source: {doing} still failing after "
+                        f"{policy.max_attempts} attempts "
+                        f"({type(e).__name__}: {e})",
+                        site="source",
+                        attempts=policy.max_attempts,
+                    ) from e
+                _emit_retry(obs, "source", retries, e)
+                policy.sleep(retries)
+
+            while True:
+                try:
+                    chunk = next(it)
+                except StopIteration:
+                    return
+                except BaseException as e:
+                    _absorb(e, f"pulling chunk {i}")
+                    # re-pull: fresh iterator, skip the chunks already
+                    # consumed (faults during the skip share the
+                    # incident's budget)
+                    it = iter(src())
+                    skipped = 0
+                    while skipped < i:
+                        try:
+                            next(it)
+                            skipped += 1
+                        except StopIteration:
+                            raise RuntimeError(
+                                "chunk source is not replay-stable: the "
+                                f"re-pulled stream ended after {skipped} "
+                                f"chunks, {i} were already consumed"
+                            ) from e
+                        except BaseException as e2:
+                            _absorb(e2, "the re-pull")
+                            it = iter(src())
+                            skipped = 0
+                    continue
+                yield chunk
+                i += 1
+                retries = 0  # incident over: the next chunk gets a full budget
+
+        return gen()
+
+    return wrapped

@@ -1,0 +1,75 @@
+"""Injectable sleepers — the ONE sanctioned ``time.sleep`` surface.
+
+KSL004 keeps raw clocks out of library code (utils/timing.py and
+utils/profiling.py own them); this module is the matching discipline for
+*waiting*: every backoff, stall injection, and pacing delay in the
+package goes through a :class:`Sleeper` so tests and the seeded chaos
+harness can replace real waiting with a recorded, deterministic no-op —
+a retry ladder that actually slept through its exponential backoff would
+turn the chaos grid into a minutes-long suite and make every timing
+assertion flaky. Lint rule KSL012 flags ``time.sleep`` anywhere else in
+the package (docs/ANALYSIS.md).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class Sleeper:
+    """Sleeper protocol: ``sleep(seconds)`` blocks (or pretends to) for
+    the requested duration. Implementations must be thread-safe — retry
+    policies sleep on producer threads and request threads alike."""
+
+    def sleep(self, seconds: float) -> None:  # pragma: no cover - protocol
+        raise NotImplementedError
+
+
+class RealSleeper(Sleeper):
+    """Actually sleeps. The package-wide default
+    (:data:`DEFAULT_SLEEPER`); the one place ``time.sleep`` is allowed
+    (KSL012)."""
+
+    def sleep(self, seconds: float) -> None:
+        if seconds > 0:
+            time.sleep(seconds)
+
+
+class VirtualSleeper(Sleeper):
+    """Records every requested sleep without blocking — the test/chaos
+    form: backoff schedules stay assertable (``slept`` holds the exact
+    durations, in call order) and the chaos grid runs at full speed.
+    Thread-safe append."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.slept: list[float] = []
+
+    def sleep(self, seconds: float) -> None:
+        with self._lock:
+            self.slept.append(float(seconds))
+
+    @property
+    def total(self) -> float:
+        """Sum of requested sleep seconds (what a RealSleeper would have
+        cost)."""
+        with self._lock:
+            return sum(self.slept)
+
+
+#: The package default: real waiting. Policies and injectors resolve a
+#: ``sleeper=None`` knob to this.
+DEFAULT_SLEEPER = RealSleeper()
+
+
+def resolve_sleeper(sleeper) -> Sleeper:
+    """``None`` -> :data:`DEFAULT_SLEEPER`; anything with a ``sleep``
+    callable passes through; everything else is rejected."""
+    if sleeper is None:
+        return DEFAULT_SLEEPER
+    if callable(getattr(sleeper, "sleep", None)):
+        return sleeper
+    raise ValueError(
+        f"sleeper must expose a sleep(seconds) method, got {sleeper!r}"
+    )

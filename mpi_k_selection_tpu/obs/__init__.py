@@ -41,6 +41,7 @@ from mpi_k_selection_tpu.obs.events import (
     ChunkEvent,
     DistributedSelectEvent,
     EventSink,
+    FaultEvent,
     ListSink,
     ObsEvent,
     ResidentSelectEvent,
@@ -67,6 +68,7 @@ __all__ = [
     "Counter",
     "DistributedSelectEvent",
     "EventSink",
+    "FaultEvent",
     "Gauge",
     "Histogram",
     "ListSink",

@@ -180,6 +180,44 @@ class ServeQueryEvent(ObsEvent):
 
 
 @dataclasses.dataclass(frozen=True)
+class FaultEvent(ObsEvent):
+    """One fault observation: an injected fault firing, or a resilience
+    policy acting on a (real or injected) failure. ``action`` is the
+    lifecycle step:
+
+    - ``"inject"``  — the harness fired a scheduled fault (site/kind/
+      index/attempt name it);
+    - ``"retry"``   — a RetryPolicy is retrying after a transient error;
+    - ``"reread"``  — the spill recovery ladder is re-reading a
+      generation after a record validation failure;
+    - ``"rebuild"`` — the ladder gave up on the generation and is
+      re-running the pass from its fallback (the replayable source, or a
+      one-shot run's gen-0 tee);
+    - ``"degrade"`` — ENOSPC downgraded ``spill="auto"`` to the replay
+      of the last good generation (spilling disabled for the rest of the
+      descent);
+    - ``"shed"``    — the query server refused admission (queue depth
+      bound);
+    - ``"deadline"``— a request's deadline expired (failed fast);
+    - ``"restart"`` — the batcher's dispatch loop crashed and was
+      restarted (in-flight queries failed, queued ones survive).
+
+    ``error`` is the triggering exception rendered as
+    ``"TypeName: message"`` (empty for injections and sheds). Pure host
+    observation, like every event here: emitting can never change an
+    answer bit."""
+
+    kind: ClassVar[str] = "fault"
+
+    site: str
+    action: str
+    fault_kind: str | None = None
+    index: int | None = None
+    attempt: int = 0
+    error: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
 class ServeBatchEvent(ObsEvent):
     """One coalesced dispatch of the query server's batcher: how many
     client requests rode the shared-pass walk and the total rank-query
@@ -327,6 +365,16 @@ def check_stream_invariants(events, spill_pass_log=None) -> None:
         chunks = by_pass.get(e.pass_index, [])
         if not chunks:  # chunk events off, or a zero-chunk pass
             continue
+        # a recovered pass (faults/policy.py: pass-level retry, spill
+        # rebuild) re-ran its chunk loop, so the pass may carry chunk
+        # events from ABORTED attempts before the successful one; only
+        # the final attempt — the run from the LAST chunk_index == 0
+        # onward — describes the pass the StreamPassEvent accounts.
+        # Fault-free streams have exactly one such run, so this is the
+        # historical strict check there.
+        zeros = [i for i, c in enumerate(chunks) if c.chunk_index == 0]
+        if zeros:
+            chunks = chunks[zeros[-1]:]
         assert [c.chunk_index for c in chunks] == list(range(e.chunks)), (
             f"pass {e.pass_index}: chunk indices out of order"
         )

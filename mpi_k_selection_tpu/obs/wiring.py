@@ -15,7 +15,34 @@ cycle.
 
 from __future__ import annotations
 
-from mpi_k_selection_tpu.obs.events import ChunkEvent
+from mpi_k_selection_tpu.obs.events import ChunkEvent, FaultEvent
+
+
+def fault_event(
+    obs, site: str, action: str, *, exc=None, fault_kind=None, index=None,
+    attempt: int = 0, counter=None, labels=None,
+):
+    """The ONE FaultEvent emission shape (docs/ROBUSTNESS.md), shared by
+    the injector (`action="inject"`), the retry policies, the descent's
+    recovery ladder, and the serving layer — so the error-rendering
+    convention (``"TypeName: message"``, empty for injections/sheds) and
+    the event/metric pairing cannot drift between call sites. ``counter``
+    (with optional ``labels``) names the metric to bump alongside the
+    event; pure host observation, no-op when ``obs`` is None."""
+    if obs is None:
+        return
+    obs.emit(
+        FaultEvent(
+            site=site,
+            action=action,
+            fault_kind=fault_kind,
+            index=index,
+            attempt=attempt,
+            error="" if exc is None else f"{type(exc).__name__}: {exc}",
+        )
+    )
+    if counter is not None and obs.metrics is not None:
+        obs.metrics.counter(counter, labels=labels).inc()
 
 
 def staged_slot(keys, devs):

@@ -32,9 +32,12 @@ from mpi_k_selection_tpu.serve.batcher import (
 from mpi_k_selection_tpu.serve.errors import (
     DatasetExistsError,
     DatasetNotFoundError,
+    DeadlineExceededError,
+    DispatchCrashedError,
     QueryError,
     ServeError,
     ServerClosedError,
+    ServerOverloadedError,
 )
 from mpi_k_selection_tpu.serve.http import (
     KSelectHTTPServer,
@@ -52,6 +55,8 @@ __all__ = [
     "DatasetExistsError",
     "DatasetNotFoundError",
     "DatasetRegistry",
+    "DeadlineExceededError",
+    "DispatchCrashedError",
     "KSelectHTTPServer",
     "KSelectServer",
     "PendingQuery",
@@ -63,6 +68,7 @@ __all__ = [
     "SERVE_THREAD_PREFIX",
     "ServeError",
     "ServerClosedError",
+    "ServerOverloadedError",
     "TIERS",
     "start_http_server",
 ]

@@ -29,6 +29,28 @@ that shape:
   one at a time, still on the dispatch thread. Arrival order is
   preserved within and across groups.
 
+Resilience (docs/ROBUSTNESS.md):
+
+- **Deadlines**: a request may carry a
+  :class:`~mpi_k_selection_tpu.utils.timing.Deadline`; the waiter times
+  out with a typed :class:`DeadlineExceededError` (HTTP 504), and the
+  dispatch thread drops already-expired queries BEFORE executing their
+  group — a dead client's walk must not delay live ones.
+- **Admission control**: ``max_depth`` bounds the dispatch queue;
+  arrivals past it are shed with :class:`ServerOverloadedError` (HTTP
+  503 + ``Retry-After``) instead of queueing unboundedly — under
+  sustained overload, bounded latency for admitted queries beats
+  unbounded latency for all.
+- **Supervision**: the dispatch loop runs under a supervisor — a crash
+  in the loop machinery (NOT per-group execution errors, which are
+  already isolated) fails ONLY the in-flight batch with
+  :class:`DispatchCrashedError`, increments the restart counter
+  (``serve.dispatch_restarts``), and resumes the loop; queued and
+  future queries are unaffected.
+- **Graceful drain**: ``close()`` stops admissions, lets the dispatch
+  thread finish everything already queued, joins it, and fails only
+  stragglers that raced the shutdown.
+
 The thread is joined on ``close()`` on every exit path — the conftest
 leaked-thread fixture enforces the same discipline as for
 ``ksel-pipeline-*`` producers.
@@ -41,7 +63,13 @@ import itertools
 import queue
 import threading
 
-from mpi_k_selection_tpu.serve.errors import ServerClosedError
+from mpi_k_selection_tpu.faults.inject import maybe_fault as _maybe_fault
+from mpi_k_selection_tpu.serve.errors import (
+    DeadlineExceededError,
+    DispatchCrashedError,
+    ServerClosedError,
+    ServerOverloadedError,
+)
 
 #: Every serving-layer thread (dispatch, HTTP serve loop, HTTP request
 #: handlers) carries this prefix; tests assert none outlives its server.
@@ -70,14 +98,41 @@ class PendingQuery:
     ks: tuple = ()
     ds: object = None
     run: object = None
+    #: optional utils/timing.Deadline — the waiter times out against it,
+    #: and the dispatch thread drops the query once it expires
+    deadline: object = None
     done: threading.Event = dataclasses.field(default_factory=threading.Event)
     result: object = None
     error: BaseException | None = None
+    #: set by a timed-out waiter, so the dispatch thread's expiry drop
+    #: does not count the SAME query's deadline twice in the metrics;
+    #: ``_dl_lock`` makes abandon-vs-drop a real test-and-set (the two
+    #: threads race on exactly this decision)
+    abandoned: bool = False
+    _dl_lock: threading.Lock = dataclasses.field(
+        default_factory=threading.Lock
+    )
 
     def wait(self):
-        """Block until dispatched; re-raise the dispatch error here (on
-        the REQUEST thread) or return the result."""
-        self.done.wait()
+        """Block until dispatched (bounded by ``deadline`` when set);
+        re-raise the dispatch error here (on the REQUEST thread), raise
+        the typed :class:`DeadlineExceededError` on timeout, or return
+        the result."""
+        if self.deadline is None:
+            self.done.wait()
+        elif not self.done.wait(timeout=self.deadline.remaining()):
+            # the dispatch thread may still execute this query (its
+            # result is discarded); its own expiry check drops it when
+            # the group has not started yet. Decide atomically who
+            # accounts the expiry: if the dispatch thread completed/
+            # dropped the query between our timeout and here, fall
+            # through to ITS outcome (one count, on its side)
+            with self._dl_lock:
+                if not self.done.is_set():
+                    self.abandoned = True
+                    raise DeadlineExceededError(
+                        "query deadline expired before dispatch completed"
+                    )
         if self.error is not None:
             raise self.error
         return self.result
@@ -95,7 +150,10 @@ class QueryBatcher:
     (server-provided) runs one coalesced rank group — all items share
     one resolved dataset object — and must fill every item's
     ``result``; ``observe`` hooks (queue depth at submit, batch width
-    at dispatch) are optional metrics callbacks."""
+    at dispatch, shed/expired/restart counts) are optional metrics
+    callbacks. ``max_depth`` bounds the queue (None = unbounded, the
+    historical behavior); arrivals past it are shed with
+    :class:`ServerOverloadedError` carrying ``retry_after``."""
 
     _ids = itertools.count()
 
@@ -105,14 +163,27 @@ class QueryBatcher:
         *,
         window: float = 0.0,
         max_batch: int = DEFAULT_MAX_BATCH,
+        max_depth: int | None = None,
+        retry_after: float = 1.0,
         observe_depth=None,
         observe_width=None,
+        observe_shed=None,
+        observe_expired=None,
+        observe_restart=None,
     ):
         self._execute_ranks = execute_ranks
         self.window = validate_window(window)
         self.max_batch = max(1, int(max_batch))
+        self.max_depth = None if max_depth is None else max(1, int(max_depth))
+        self.retry_after = float(retry_after)
         self._observe_depth = observe_depth
         self._observe_width = observe_width
+        self._observe_shed = observe_shed
+        self._observe_expired = observe_expired
+        self._observe_restart = observe_restart
+        #: dispatch-loop supervisor restarts (serve.dispatch_restarts)
+        self.restarts = 0
+        self._inflight: list = []  # the batch being dispatched right now
         self._q: queue.Queue = queue.Queue()
         # serializes submit's check+put against close's final drain, so a
         # submit racing close() either raises or its item is seen by the
@@ -132,14 +203,52 @@ class QueryBatcher:
         with self._submit_lock:
             if self._stop.is_set():
                 raise ServerClosedError("server is closed; query rejected")
+            depth = self._q.qsize()
+            if self.max_depth is not None and depth >= self.max_depth:
+                # shed instead of queueing unboundedly: under sustained
+                # overload a bounded queue keeps admitted-query latency
+                # bounded; the client backs off and retries
+                if self._observe_shed is not None:
+                    self._observe_shed()
+                raise ServerOverloadedError(
+                    f"dispatch queue at its depth bound ({self.max_depth}); "
+                    "query shed — retry after backoff",
+                    retry_after=self.retry_after,
+                )
             if self._observe_depth is not None:
-                self._observe_depth(self._q.qsize())
+                self._observe_depth(depth)
             self._q.put(item)
         return item
 
     # -- dispatch thread ---------------------------------------------------
 
     def _run(self) -> None:
+        """Supervisor shell around the serve loop: a crash in the loop
+        machinery fails ONLY the batch in flight (each unanswered item
+        gets a typed :class:`DispatchCrashedError`), counts a restart,
+        and resumes — the thread itself never dies of an exception, so
+        queued and future queries keep being served."""
+        while True:
+            try:
+                self._serve_loop()
+                return
+            except BaseException as e:
+                inflight, self._inflight = self._inflight, []
+                for item in inflight:
+                    if not item.done.is_set():
+                        item.error = DispatchCrashedError(
+                            f"dispatch loop crashed while this query was in "
+                            f"flight ({type(e).__name__}: {e}); the loop was "
+                            "restarted"
+                        )
+                        item.done.set()
+                self.restarts += 1
+                if self._observe_restart is not None:
+                    self._observe_restart(e)
+                if self._stop.is_set():
+                    return
+
+    def _serve_loop(self) -> None:
         while True:
             try:
                 first = self._q.get(timeout=0.05)
@@ -157,16 +266,49 @@ class QueryBatcher:
                         batch.append(self._q.get_nowait())
                     except queue.Empty:
                         break
+            # the supervisor fails exactly this list on a loop crash
+            self._inflight = batch
+            # chaos hook: the i-th dispatch round — OUTSIDE the per-group
+            # isolation below, so an injected raise exercises the
+            # supervisor-restart path (faults/inject.py)
+            _maybe_fault("serve.dispatch")
             self._dispatch(batch)
+            self._inflight = []
             if self._stop.is_set() and self._q.empty():
                 return
 
+    def _drop_expired(self, items) -> list:
+        """Fail every already-expired query with the typed error and
+        return the live remainder. Expired queries never execute: their
+        waiters already gave up, and running their walk would only delay
+        the live queries behind them."""
+        live = []
+        for item in items:
+            if item.deadline is not None and item.deadline.expired:
+                # decide atomically against the waiter's own timeout: a
+                # waiter that already abandoned counted this query's
+                # deadline itself — observe only the drops it didn't
+                with item._dl_lock:
+                    abandoned = item.abandoned
+                    item.error = DeadlineExceededError(
+                        "query deadline expired before dispatch; dropped unrun"
+                    )
+                    item.done.set()
+                if self._observe_expired is not None and not abandoned:
+                    self._observe_expired()
+                continue
+            live.append(item)
+        return live
+
     def _dispatch(self, batch) -> None:
         """Group a drained batch by (dataset, kind) preserving arrival
-        order, execute each group, and wake every request exactly once."""
+        order, execute each group, and wake every request exactly once.
+        Expired queries are dropped without execution — re-checked per
+        GROUP, not only at batch start, so a deadline that expires while
+        an earlier group's slow walk runs still fails fast."""
         groups: dict = {}
         order = []
-        for item in batch:
+        for item in self._drop_expired(batch):
             # identity includes the dataset OBJECT: two requests that
             # resolved the same id across a drop+re-add must not share
             # one walk over whichever dataset happens to be current
@@ -177,7 +319,11 @@ class QueryBatcher:
             groups[key].append(item)
         for key in order:
             kind = key[1]
-            items = groups[key]
+            # an earlier group's slow walk may have outlived this
+            # group's deadlines: re-check before spending device time
+            items = self._drop_expired(groups[key])
+            if not items:
+                continue
             try:
                 if kind == "rank":
                     if self._observe_width is not None:

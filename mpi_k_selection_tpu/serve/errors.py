@@ -3,9 +3,10 @@
 The serving layer fronts many concurrent clients, so its failures must be
 distinguishable without string matching: the HTTP front maps each class to
 a status code (registry misses are 404s, malformed queries 400s, a closed
-server 503) and the in-process API lets callers catch exactly the case
-they can handle. All inherit :class:`ServeError` so "anything the server
-raised" is one except clause.
+server 503, an expired deadline 504, a shed query 503 + ``Retry-After``)
+and the in-process API lets callers catch exactly the case they can
+handle. All inherit :class:`ServeError` so "anything the server raised"
+is one except clause.
 """
 
 from __future__ import annotations
@@ -34,3 +35,29 @@ class QueryError(ServeError, ValueError):
 class ServerClosedError(ServeError):
     """The server (or its dispatch thread) has been closed; no further
     queries are accepted and queued ones are failed with this (HTTP 503)."""
+
+
+class DeadlineExceededError(ServeError):
+    """The request's deadline expired before its answer materialized
+    (HTTP 504). Raised on the REQUEST thread by ``PendingQuery.wait``
+    when the wait times out, and set by the dispatch thread when it drops
+    an already-expired query before executing it (fail fast: a dead
+    client's walk would only delay live ones)."""
+
+
+class ServerOverloadedError(ServeError):
+    """Admission control shed this query: the dispatch queue is at its
+    configured depth bound, so queueing would only grow latency without
+    bound (HTTP 503 with a ``Retry-After`` header). ``retry_after`` is
+    the suggested client backoff in seconds."""
+
+    def __init__(self, message: str, *, retry_after: float = 1.0):
+        super().__init__(message)
+        self.retry_after = float(retry_after)
+
+
+class DispatchCrashedError(ServeError):
+    """The batcher's dispatch loop crashed while this query was in
+    flight; the supervisor restarted the loop (``serve.dispatch_restarts``
+    counts it) and failed ONLY the in-flight batch with this — queued and
+    future queries are unaffected (HTTP 500)."""

@@ -31,22 +31,32 @@ the loop on the main thread instead.
 
 Error mapping: :class:`DatasetNotFoundError` -> 404,
 :class:`QueryError`/``ValueError`` -> 400, :class:`ServerClosedError`
--> 503, anything else -> 500 (message included — this is an internal
+-> 503, :class:`ServerOverloadedError` -> 503 with a ``Retry-After``
+header (admission control shed the query — back off and retry),
+:class:`DeadlineExceededError` -> 504 (the request's ``deadline_ms``
+expired), anything else -> 500 (message included — this is an internal
 service, not a hardened edge).
+
+Deadlines over the wire: a ``/v1/query`` body may carry ``deadline_ms``
+(milliseconds, this request only); the server's ``default_deadline``
+applies otherwise. See docs/ROBUSTNESS.md.
 """
 
 from __future__ import annotations
 
 import itertools
 import json
+import math
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from mpi_k_selection_tpu.serve.batcher import SERVE_THREAD_PREFIX
 from mpi_k_selection_tpu.serve.errors import (
     DatasetNotFoundError,
+    DeadlineExceededError,
     QueryError,
     ServerClosedError,
+    ServerOverloadedError,
 )
 
 #: Request-body ceiling: queries are tiny JSON; a megabyte is a client bug.
@@ -74,7 +84,10 @@ class _Handler(BaseHTTPRequestHandler):
 
     # -- plumbing ----------------------------------------------------------
 
-    def _send(self, code: int, payload, *, content_type="application/json"):
+    def _send(
+        self, code: int, payload, *, content_type="application/json",
+        headers=None,
+    ):
         body = (
             payload
             if isinstance(payload, (bytes, bytearray))
@@ -83,11 +96,13 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 
-    def _send_error_json(self, code: int, message: str):
-        self._send(code, {"error": message})
+    def _send_error_json(self, code: int, message: str, headers=None):
+        self._send(code, {"error": message}, headers=headers)
 
     def _read_json(self):
         length = int(self.headers.get("Content-Length", 0) or 0)
@@ -112,6 +127,15 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_error_json(404, str(e))
         except (QueryError, ValueError, TypeError) as e:
             self._send_error_json(400, str(e))
+        except DeadlineExceededError as e:
+            self._send_error_json(504, str(e))
+        except ServerOverloadedError as e:
+            # shed by admission control: tell the client how long to back
+            # off (integer ceiling — Retry-After is delta-seconds)
+            self._send_error_json(
+                503, str(e),
+                headers={"Retry-After": str(max(1, int(-(-e.retry_after // 1))))},
+            )
         except ServerClosedError as e:
             self._send_error_json(503, str(e))
         except Exception as e:  # internal service: surface, don't hide
@@ -152,12 +176,29 @@ class _Handler(BaseHTTPRequestHandler):
             raise QueryError("query needs a string 'dataset' id")
         op = req.get("op", "kselect")
         tier = req.get("tier", "auto")
+        deadline = None
+        if "deadline_ms" in req:
+            raw_dl = req["deadline_ms"]
+            try:
+                if isinstance(raw_dl, bool):  # json true/false float()s to 1/0
+                    raise TypeError("bool is not a duration")
+                deadline = float(raw_dl) / 1000.0
+            except (TypeError, ValueError) as e:
+                raise QueryError(
+                    f"deadline_ms must be a number of milliseconds, got "
+                    f"{req['deadline_ms']!r}"
+                ) from e
+            # stdlib json parses NaN/Infinity: NaN would dodge the <= 0
+            # guard and expire instantly, Infinity would never expire —
+            # both are malformed requests, not deadlines
+            if not math.isfinite(deadline) or deadline <= 0:
+                raise QueryError("deadline_ms must be a finite number > 0")
         srv = self.kserver
         if op == "kselect":
             ks = req["ks"] if "ks" in req else [req["k"]] if "k" in req else None
             if ks is None:
                 raise QueryError("kselect needs 'k' or 'ks'")
-            answers = srv.kselect_many(dataset, ks, tier=tier)
+            answers = srv.kselect_many(dataset, ks, tier=tier, deadline=deadline)
             self._send(
                 200,
                 {
@@ -169,7 +210,7 @@ class _Handler(BaseHTTPRequestHandler):
         elif op == "quantiles":
             if "qs" not in req:
                 raise QueryError("quantiles needs 'qs'")
-            answers = srv.quantiles(dataset, req["qs"], tier=tier)
+            answers = srv.quantiles(dataset, req["qs"], tier=tier, deadline=deadline)
             self._send(
                 200,
                 {
@@ -182,7 +223,8 @@ class _Handler(BaseHTTPRequestHandler):
             if "k" not in req:
                 raise QueryError("topk needs 'k'")
             values, indices = srv.topk(
-                dataset, int(req["k"]), largest=bool(req.get("largest", True))
+                dataset, int(req["k"]), largest=bool(req.get("largest", True)),
+                deadline=deadline,
             )
             self._send(
                 200,
@@ -196,7 +238,9 @@ class _Handler(BaseHTTPRequestHandler):
         elif op == "rank_certificate":
             if "value" not in req:
                 raise QueryError("rank_certificate needs 'value'")
-            less, leq = srv.rank_certificate(dataset, req["value"])
+            less, leq = srv.rank_certificate(
+                dataset, req["value"], deadline=deadline
+            )
             self._send(
                 200,
                 {"dataset": dataset, "op": op, "less": int(less), "leq": int(leq)},

@@ -46,9 +46,14 @@ from mpi_k_selection_tpu.serve.batcher import (
     PendingQuery,
     QueryBatcher,
 )
-from mpi_k_selection_tpu.serve.errors import QueryError, ServerClosedError
+from mpi_k_selection_tpu.serve.errors import (
+    DeadlineExceededError,
+    QueryError,
+    ServerClosedError,
+)
 from mpi_k_selection_tpu.serve.registry import DatasetRegistry
 from mpi_k_selection_tpu.serve.tiers import RankAnswer
+from mpi_k_selection_tpu.utils.timing import Deadline
 
 #: Latency-histogram bucket bounds (seconds) — sub-ms sketch reads up to
 #: multi-second out-of-core descents.
@@ -87,13 +92,28 @@ class KSelectServer:
     """Long-lived serving facade: register datasets once, answer
     kselect / quantile / top-k / rank-certificate queries from many
     concurrent clients. ``window`` is the batcher's coalescing window in
-    seconds (0 = dispatch every request alone)."""
+    seconds (0 = dispatch every request alone).
+
+    Resilience knobs (docs/ROBUSTNESS.md): ``max_queue_depth`` bounds
+    the dispatch queue — arrivals past it are shed with
+    :class:`~mpi_k_selection_tpu.serve.errors.ServerOverloadedError`
+    (HTTP 503 + ``Retry-After``, ``retry_after`` seconds, counted in
+    ``serve.load_shed``) instead of queueing unboundedly;
+    ``default_deadline`` (seconds) applies to every query that names
+    none — expired queries fail fast with
+    :class:`~mpi_k_selection_tpu.serve.errors.DeadlineExceededError`
+    (HTTP 504, ``serve.deadline_exceeded``); the dispatch loop runs
+    supervised — a crash fails only the in-flight batch and restarts the
+    loop (``serve.dispatch_restarts``)."""
 
     def __init__(
         self,
         *,
         window: float = 0.0,
         max_batch: int = DEFAULT_MAX_BATCH,
+        max_queue_depth: int | None = None,
+        retry_after: float = 1.0,
+        default_deadline: float | None = None,
         obs=None,
         registry: DatasetRegistry | None = None,
     ):
@@ -102,6 +122,9 @@ class KSelectServer:
         self.obs = obs
         self.metrics = None if obs is None else obs.metrics
         self.registry = registry if registry is not None else DatasetRegistry()
+        self.default_deadline = (
+            None if default_deadline is None else float(default_deadline)
+        )
         self.timer = PhaseTimer(
             recorder=_LatencyRecorder(
                 self.metrics, None if obs is None else obs.trace
@@ -111,8 +134,13 @@ class KSelectServer:
             self._execute_ranks,
             window=window,
             max_batch=max_batch,
+            max_depth=max_queue_depth,
+            retry_after=retry_after,
             observe_depth=self._observe_depth,
             observe_width=self._observe_width,
+            observe_shed=self._observe_shed,
+            observe_expired=self._observe_expired,
+            observe_restart=self._observe_restart,
         )
 
     # -- dataset lifecycle -------------------------------------------------
@@ -144,19 +172,29 @@ class KSelectServer:
 
     # -- queries (request threads) -----------------------------------------
 
-    def kselect(self, dataset_id: str, k, *, tier: str = "auto") -> RankAnswer:
+    def kselect(
+        self, dataset_id: str, k, *, tier: str = "auto", deadline=None
+    ) -> RankAnswer:
         """Exact-or-bounded k-th smallest (1-indexed). Returns one
-        :class:`RankAnswer`; ``tier`` per serve/tiers.py."""
+        :class:`RankAnswer`; ``tier`` per serve/tiers.py. ``deadline``
+        (seconds, or a :class:`~mpi_k_selection_tpu.utils.timing.
+        Deadline`) bounds the whole request — expiry raises the typed
+        :class:`~mpi_k_selection_tpu.serve.errors.
+        DeadlineExceededError` (HTTP 504)."""
         ds = self.registry.get(dataset_id)
-        return self._rank_query(ds, [k], tier, "kselect")[0]
+        return self._rank_query(ds, [k], tier, "kselect", deadline)[0]
 
-    def kselect_many(self, dataset_id: str, ks, *, tier: str = "auto"):
+    def kselect_many(
+        self, dataset_id: str, ks, *, tier: str = "auto", deadline=None
+    ):
         """One :class:`RankAnswer` per rank in ``ks``, in order — the
         whole request rides one dispatch (and one shared walk)."""
         ds = self.registry.get(dataset_id)
-        return self._rank_query(ds, list(ks), tier, "kselect")
+        return self._rank_query(ds, list(ks), tier, "kselect", deadline)
 
-    def quantiles(self, dataset_id: str, qs, *, tier: str = "auto"):
+    def quantiles(
+        self, dataset_id: str, qs, *, tier: str = "auto", deadline=None
+    ):
         """Nearest-rank quantile answers (``api.quantile_ranks``
         conversion, so exact-tier values are bit-identical to
         ``api.quantiles`` over the same resident bits)."""
@@ -167,26 +205,30 @@ class KSelectServer:
             ks = quantile_ranks(qs, ds.n)
         except ValueError as e:
             raise QueryError(str(e)) from e
-        return self._rank_query(ds, ks, tier, "quantiles")
+        return self._rank_query(ds, ks, tier, "quantiles", deadline)
 
-    def topk(self, dataset_id: str, k: int, *, largest: bool = True):
+    def topk(
+        self, dataset_id: str, k: int, *, largest: bool = True, deadline=None
+    ):
         """Exact top-k ``(values, indices)`` over a resident dataset
         (earliest-position tie break, matching ``lax.top_k``)."""
         ds = self.registry.get(dataset_id)
         result = self._run_single(
             ds, "topk",
             lambda: self.registry.topk(ds, k, largest=largest),
+            deadline,
         )
         self._account(ds, "topk", None, "exact", 1, False)
         return result
 
-    def rank_certificate(self, dataset_id: str, value):
+    def rank_certificate(self, dataset_id: str, value, *, deadline=None):
         """Exact ``(#<, #<=)`` counts for ``value`` — the O(n) proof a
         served answer is the true order statistic."""
         ds = self.registry.get(dataset_id)
         result = self._run_single(
             ds, "rank_certificate",
             lambda: self.registry.rank_certificate(ds, value),
+            deadline,
         )
         self._account(ds, "rank_certificate", None, "exact", 1, False)
         return result
@@ -197,12 +239,34 @@ class KSelectServer:
         if self.batcher.closed:
             raise ServerClosedError("server is closed")
 
-    def _rank_query(self, ds, ks, tier, op) -> list[RankAnswer]:
+    def _resolve_deadline(self, deadline):
+        if deadline is None:
+            deadline = self.default_deadline
+        if deadline is None or isinstance(deadline, Deadline):
+            return deadline
+        return Deadline.after(float(deadline))
+
+    def _wait(self, pending):
+        """Wait for a dispatched query, accounting deadline expiry: the
+        waiter-side timeout is counted here; dispatch-side drops were
+        already counted by the expired hook (``pending.error`` carries
+        the same exception instance then — count once)."""
+        try:
+            return pending.wait()
+        except DeadlineExceededError as e:
+            if pending.error is not e:
+                self._fault_obs("serve.request", "deadline", e)
+                if self.metrics is not None:
+                    self.metrics.counter("serve.deadline_exceeded").inc()
+            raise
+
+    def _rank_query(self, ds, ks, tier, op, deadline=None) -> list[RankAnswer]:
         """``ds`` is the RESOLVED dataset (not an id): validation and
         execution must describe the same object even if the id is
         dropped and re-registered mid-request."""
         self._check_open()
         tier = _tiers.validate_tier(tier)
+        dl = self._resolve_deadline(deadline)
         ks = [int(k) for k in ks]
         for k in ks:
             if not 1 <= k <= ds.n:
@@ -216,9 +280,11 @@ class KSelectServer:
         escalated = tier == "auto"
         with self.timer.phase("serve.request.exact"):
             pending = self.batcher.submit(
-                PendingQuery(ds.dataset_id, "rank", ks=tuple(ks), ds=ds)
+                PendingQuery(
+                    ds.dataset_id, "rank", ks=tuple(ks), ds=ds, deadline=dl
+                )
             )
-            values = pending.wait()
+            values = self._wait(pending)
         answers = [
             RankAnswer(
                 k=k, value=values[i], tier="exact", exact=True,
@@ -229,14 +295,17 @@ class KSelectServer:
         self._account(ds, op, tier, "exact", len(ks), escalated)
         return answers
 
-    def _run_single(self, ds, kind, run):
+    def _run_single(self, ds, kind, run, deadline=None):
         """Route one non-rank op through the dispatch thread (all device
         work stays serialized there)."""
         self._check_open()
+        dl = self._resolve_deadline(deadline)
         with self.timer.phase("serve.request.exact"):
-            return self.batcher.submit(
-                PendingQuery(ds.dataset_id, kind, ds=ds, run=run)
-            ).wait()
+            return self._wait(
+                self.batcher.submit(
+                    PendingQuery(ds.dataset_id, kind, ds=ds, run=run, deadline=dl)
+                )
+            )
 
     def _execute_ranks(self, items) -> None:
         """Dispatch-thread executor: ONE shared-pass select over the
@@ -268,6 +337,34 @@ class KSelectServer:
     def _observe_width(self, width: int) -> None:
         if self.metrics is not None:
             self.metrics.histogram("serve.batch_width").observe(width)
+
+    def _fault_obs(self, site: str, action: str, exc=None) -> None:
+        """One serving-layer fault observation (shed, deadline, restart)
+        — a typed FaultEvent; the matching counters are kept next to the
+        call sites (some mirror pre-existing sources rather than inc)."""
+        from mpi_k_selection_tpu.obs.wiring import fault_event
+
+        fault_event(self.obs, site, action, exc=exc)
+
+    def _observe_shed(self) -> None:
+        self._fault_obs("serve.submit", "shed")
+        if self.metrics is not None:
+            self.metrics.counter("serve.load_shed").inc()
+
+    def _observe_expired(self) -> None:
+        self._fault_obs("serve.dispatch", "deadline")
+        if self.metrics is not None:
+            self.metrics.counter("serve.deadline_exceeded").inc()
+
+    def _observe_restart(self, exc) -> None:
+        self._fault_obs("serve.dispatch", "restart", exc)
+        if self.metrics is not None:
+            # mirror of the batcher's own counter (set, not inc: the
+            # batcher increments BEFORE this hook runs, and collect_
+            # metrics re-mirrors it idempotently)
+            self.metrics.counter("serve.dispatch_restarts").set(
+                int(self.batcher.restarts)
+            )
 
     def _account(self, ds, op, tier_requested, tier_answered, queries, escalated):
         """Per-request accounting: one ``serve.query`` event plus the
@@ -312,6 +409,9 @@ class KSelectServer:
             len(self.registry.programs)
         )
         self.metrics.gauge("serve.datasets").set(len(self.registry))
+        self.metrics.counter("serve.dispatch_restarts").set(
+            int(self.batcher.restarts)
+        )
         collect_runtime(self.metrics, timer=self.timer)
         return self.metrics
 

@@ -71,9 +71,13 @@ device filter is about to discard).
 from __future__ import annotations
 
 import contextlib
+import errno as _errno
+import warnings
 
 import numpy as np
 
+from mpi_k_selection_tpu import errors as _err
+from mpi_k_selection_tpu.faults import policy as _fp
 from mpi_k_selection_tpu.obs import events as _ev
 from mpi_k_selection_tpu.obs import metrics as _om
 from mpi_k_selection_tpu.obs import wiring as _wr
@@ -258,7 +262,7 @@ def _iter_key_chunks(src, dtype=None, spill=None):
 @contextlib.contextmanager
 def _key_chunk_stream(
     src, dtype=None, *, pipeline_depth=0, hist_method=None, timer=None,
-    devices=None, spill=None,
+    devices=None, spill=None, retry=None, obs=None,
 ):
     """Context-managed ``(keys, chunk)`` iterator: the synchronous
     generator at depth 0, a :class:`~mpi_k_selection_tpu.streaming.
@@ -268,14 +272,17 @@ def _key_chunk_stream(
     joined on EVERY exit path — normal exhaustion, early exit, and
     consumer-side raises like the replay-stability check. ``spill`` tees
     every chunk's encoded keys to a SpillWriter (on the producer thread
-    when pipelined); the caller owns commit/abort."""
+    when pipelined); the caller owns commit/abort. ``retry`` (a
+    faults/policy.py RetryPolicy, or None) governs in-place retries of
+    the producer's staging transfers; ``obs`` receives their retry
+    events."""
     depth = _pl.validate_pipeline_depth(pipeline_depth)
     if depth == 0:
         yield _iter_key_chunks(src, dtype, spill=spill)
         return
     pipe = _pl.ChunkPipeline(
         src, dtype, depth=depth, hist_method=hist_method, timer=timer,
-        devices=devices, spill=spill,
+        devices=devices, spill=spill, retry=retry, obs=obs,
     )
     try:
         yield iter(pipe)
@@ -338,9 +345,97 @@ def _hist_summary(hists) -> tuple[int, int, int]:
     return total, bucket_max, nonzero
 
 
+def _emit_fault(obs, site, action, exc=None) -> None:
+    """One recovery observation: a typed FaultEvent plus the
+    ``faults.recovered{site,action}`` counter. Pure host observation."""
+    _wr.fault_event(
+        obs, site, action, exc=exc,
+        counter="faults.recovered", labels={"site": site, "action": action},
+    )
+
+
+def _recover_pass(
+    run, *, policy, reading_spill, fallback, on_enospc, obs, site,
+):
+    """Run ONE streamed pass under the resilience ladder. ``run(src, tee)``
+    is a re-invocable pass body: ``src=None`` means "the pass's default
+    read source", ``tee=False`` suppresses the spill generation write; it
+    must unwind completely on raise (executor aborted, writer aborted,
+    staged chunk released — the existing except paths do exactly that),
+    so every retry starts from clean state.
+
+    The ladder, in order of specificity:
+
+    - ``SpillRecordError`` while reading a generation: re-read ONCE (a
+      transient bad read heals), then re-run the pass from ``fallback``
+      (the replayable source, or a one-shot run's protected gen-0 tee) —
+      the pass's own prefix filters make the superset read bit-identical
+      by construction. No fallback (or the fallback itself failing) ->
+      the typed error propagates.
+    - ``OSError(ENOSPC)`` while teeing the next generation:
+      ``on_enospc`` decides — ``spill="auto"`` descents disable the tee
+      and re-run the pass reading the last good generation (a warning
+      FaultEvent marks the downgrade); explicit spill modes raise
+      :class:`~mpi_k_selection_tpu.errors.SpillCapacityError`.
+    - transient errors (``policy.retryable``): re-run the whole pass from
+      the same read source, bounded by ``policy.max_attempts`` with the
+      policy's backoff — "failed passes re-run from the previous spill
+      generation". Exhaustion raises the typed
+      :class:`~mpi_k_selection_tpu.errors.RetryExhaustedError`.
+
+    Everything else propagates untouched: retrying a logic error repeats
+    it."""
+    transient = 0
+    reread = False
+    src = None
+    tee = True
+    while True:
+        try:
+            return run(src, tee)
+        except _err.SpillRecordError as e:
+            if not reading_spill or src is not None:
+                raise
+            if not reread:
+                reread = True
+                _emit_fault(obs, "spill.read", "reread", e)
+                continue
+            if fallback is None:
+                raise
+            _emit_fault(obs, "spill.read", "rebuild", e)
+            src = fallback
+            continue
+        except BaseException as e:
+            # ENOSPC first — it is an OSError, but so are the RETRYABLE
+            # ConnectionError/TimeoutError: dispatch on errno, not on the
+            # class, so a transient network/timeout failure falls through
+            # to the pass-level retry below instead of being re-raised
+            if (
+                isinstance(e, OSError)
+                and e.errno == _errno.ENOSPC
+                and tee
+                and on_enospc is not None
+            ):
+                on_enospc(e)  # raises SpillCapacityError unless degrade is legal
+                tee = False
+                continue
+            if policy is None or not policy.is_retryable(e):
+                raise
+            transient += 1
+            if transient >= policy.max_attempts:
+                raise _err.RetryExhaustedError(
+                    f"{site}: still failing after {policy.max_attempts} "
+                    f"attempts ({type(e).__name__}: {e})",
+                    site=site,
+                    attempts=policy.max_attempts,
+                ) from e
+            _emit_fault(obs, site, "retry", e)
+            policy.sleep(transient)
+            continue
+
+
 def _collect_survivors(
     src, dtype, specs, *, pipeline_depth=0, timer=None, devices=None,
-    hist_method=None, obs=None, read_from="source", deferred=True,
+    hist_method=None, obs=None, read_from="source", deferred=True, retry=None,
 ):
     """One streamed pass collecting survivors for EVERY ``(resolved_bits,
     prefix) -> expected population`` spec at once — the shared finish of
@@ -379,7 +474,7 @@ def _collect_survivors(
         with _pl._phase(timer, "descent.collect"), _key_chunk_stream(
             src, dtype, pipeline_depth=pipeline_depth, timer=timer,
             hist_method=hist_method if multi else None,
-            devices=devs if multi else None,
+            devices=devs if multi else None, retry=retry, obs=obs,
         ) as kc:
             for keys, _ in kc:
                 if obs is not None:
@@ -477,6 +572,7 @@ def streaming_kselect(
     spill=DEFAULT_SPILL,
     spill_dir=None,
     deferred=DEFAULT_DEFERRED,
+    retry=None,
     obs=None,
 ):
     """Exact k-th smallest (1-indexed) over a chunked stream.
@@ -541,6 +637,13 @@ def streaming_kselect(
     the host-exact routes (64-bit-no-x64, f64-on-TPU) never stage and so
     bypass deferral by construction.
 
+    ``retry`` configures the resilience policies (see
+    :func:`streaming_kselect_many` and docs/ROBUSTNESS.md): ``None`` =
+    the bounded-retry default, ``"off"`` = fail on the first transient,
+    a :class:`~mpi_k_selection_tpu.faults.RetryPolicy` customizes
+    attempts/backoff. Recovered runs are bit-identical to fault-free
+    runs.
+
     ``obs`` (an :class:`~mpi_k_selection_tpu.obs.Observability`) turns on
     the descent telemetry: one typed event per streamed pass and per
     consumed chunk, metrics (StagingPool hits/misses, stall seconds,
@@ -561,6 +664,7 @@ def streaming_kselect(
         spill=spill,
         spill_dir=spill_dir,
         deferred=deferred,
+        retry=retry,
         obs=obs,
     )[0]
 
@@ -579,6 +683,7 @@ def streaming_kselect_many(
     spill=DEFAULT_SPILL,
     spill_dir=None,
     deferred=DEFAULT_DEFERRED,
+    retry=None,
     obs=None,
 ):
     """Exact k-th smallest for EVERY (1-indexed) rank in ``ks``, sharing
@@ -603,10 +708,30 @@ def streaming_kselect_many(
     histogram dispatches (one device-side compaction per staged chunk,
     record written at FIFO-finish time), so the spill pass no longer
     serializes on per-chunk gathers.
+
+    ``retry`` governs the resilience policies (faults/policy.py;
+    docs/ROBUSTNESS.md): ``None`` = the package default
+    (:data:`~mpi_k_selection_tpu.faults.DEFAULT_RETRY`: 3 total attempts,
+    bounded exponential backoff through the injectable sleeper), a
+    :class:`~mpi_k_selection_tpu.faults.RetryPolicy` customizes it,
+    ``"off"`` restores the fail-on-first-transient behavior. With a
+    policy active: transient chunk-source errors re-pull mid-pass
+    (replayable sources), transient staging failures retry in place,
+    failed passes re-run from the previous spill generation, corrupt or
+    truncated spill records re-read once and then rebuild from the
+    source (one-shot sources fall back to the protected gen-0 tee), and
+    ENOSPC under ``spill="auto"`` degrades to the replay of the last
+    good generation with a warning instead of raising — except while
+    teeing generation 0 itself, where no prior generation exists to
+    degrade to and the typed ``SpillCapacityError`` is raised. Recovered
+    runs are bit-identical to fault-free runs; exhausted policies raise
+    typed errors (``RetryExhaustedError``, ``SpillCapacityError``,
+    ``SpillRecordError``).
     """
     pipeline_depth = _pl.validate_pipeline_depth(pipeline_depth)
     devs = _pl.resolve_stream_devices(devices)
     defer = _ex.resolve_deferred(deferred)
+    policy = _fp.resolve_retry(retry)
     timer, _restore_recorder = _wr.attach_timer(obs, timer)
     occupancy = _wr.window_occupancy(obs, phase="descent")
     # one in-flight bundle slot per ingest device; the synchronous
@@ -617,33 +742,56 @@ def streaming_kselect_many(
     stream_kw = dict(
         pipeline_depth=pipeline_depth, timer=timer,
         devices=None if devices is None else devs,
+        retry=policy, obs=obs,
     )
     ks = [int(k) for k in ks]
     if not ks:
         return []
 
     store, own_store, read_gen = _resolve_spill(source, spill, spill_dir)
+    one_shot = _is_one_shot_source(source)
     src = as_chunk_source(source, one_shot_ok=store is not None, mmap=defer)
+    if policy is not None and not one_shot:
+        # mid-pass re-pull for transient source errors (replayable
+        # sources only — a consumed generator cannot be re-invoked; its
+        # recovery path is the spill store instead)
+        src = _fp.resilient_source(src, policy, obs=obs)
+    # ENOSPC can downgrade to the replay of the last good generation only
+    # when the caller did not ask for spilling explicitly
+    degrade_ok = spill == "auto"
+    spill_disabled = False
     created = []  # generations this call wrote — its cleanup set
-    keep_gen0 = None  # the pass-0 tee, preserved in caller-owned stores
+    # a generation never dropped mid-descent: a caller-owned store's
+    # pass-0 tee (kept for later calls), or a one-shot run's gen-0
+    # recovery anchor (the only rebuild source a consumed stream has —
+    # raises the one-shot disk bound to ~3·N·key_bytes worst case)
+    protected = None
 
     def _gen_src():
         return read_gen.as_source(mmap=defer) if read_gen is not None else src
 
-    def _log_pass(label, wrote=None):
+    def _fallback_src():
+        """The rebuild source when the generation being read is corrupt:
+        the replayable original, or a one-shot run's protected gen-0 tee
+        (None = unrecoverable; the typed SpillRecordError propagates)."""
+        if not one_shot:
+            return src
+        if protected is not None and not protected.dropped:
+            return protected.as_source(mmap=defer)
+        return None  # pragma: no cover - one-shot descents always anchor gen 0
+
+    def _log_pass(label, wrote=None, *, keys_read=None, read=None):
         if store is None:
             return
-        if read_gen is not None:
-            entry = {
-                "pass": label, "read": "spill",
-                "keys_read": int(read_gen.keys),
-                "bytes_read": int(read_gen.nbytes),
-            }
-        else:
-            entry = {
-                "pass": label, "read": "source",
-                "keys_read": int(n), "bytes_read": int(n) * kdt.itemsize,
-            }
+        if read is None:
+            read = "spill" if read_gen is not None else "source"
+        if keys_read is None:
+            keys_read = int(read_gen.keys) if read_gen is not None else int(n)
+        entry = {
+            "pass": label, "read": read,
+            "keys_read": int(keys_read),
+            "bytes_read": int(keys_read) * kdt.itemsize,
+        }
         if wrote is not None:
             entry["keys_written"] = int(wrote.keys)
             entry["bytes_written"] = int(wrote.nbytes)
@@ -652,19 +800,38 @@ def streaming_kselect_many(
     def _rotate(gen):
         """Make the just-committed survivor generation the next read
         source and drop the one it replaces — at most two generations
-        ever coexist on disk (a caller-owned store keeps its pass-0 tee
-        for later calls)."""
+        (plus the protected anchor) ever coexist on disk."""
         nonlocal read_gen
         created.append(gen)
         prev = read_gen
         read_gen = gen
-        if (
-            prev is not None
-            and prev in created
-            and (own_store or prev is not keep_gen0)
-        ):
+        if prev is not None and prev in created and prev is not protected:
             store.drop_generation(prev)
             created.remove(prev)
+
+    def _on_enospc(e):
+        """The ENOSPC rung of the pass-recovery ladder: degrade
+        ``spill="auto"`` (disable the tee, keep replaying the last good
+        generation), raise typed for explicit spill modes."""
+        nonlocal spill_disabled
+        if not degrade_ok:
+            raise _err.SpillCapacityError(
+                "spill store out of disk while writing the next survivor "
+                "generation; spilling was requested explicitly "
+                f"(spill={spill!r}), so there is no silent fallback — "
+                "free disk space, point spill_dir elsewhere, or run "
+                "spill='auto'/'off'"
+            ) from e
+        spill_disabled = True
+        _emit_fault(obs, "spill.write", "degrade", e)
+        warnings.warn(
+            "spill store out of disk (ENOSPC); degrading spill='auto' to "
+            "the replay of the last good generation — spilling is "
+            "disabled for the rest of this descent and later passes "
+            "re-read that generation whole",
+            RuntimeWarning,
+            stacklevel=2,
+        )
 
     try:
         # per-rank descent state: [prefix, rebased_k, resolved_bits, population]
@@ -690,62 +857,91 @@ def streaming_kselect_many(
             # pipelined), so no later pass touches the source again.
             dtype = None
             n = 0
-            chunk_i0 = 0
+            kdt = total_bits = method = None
             pass0_gen = read_gen  # what pass 0 actually read from
-            writer = (
-                store.new_generation()
-                if store is not None and read_gen is None
-                else None
-            )
-            hist_c = ex = keys = None
-            try:
-                with _pl._phase(timer, "descent.pass"), _key_chunk_stream(
-                    _gen_src(), hist_method=hist_method, spill=writer,
-                    **stream_kw,
-                ) as kc:
-                    for keys, chunk in kc:
-                        if dtype is None:
-                            dtype = np.dtype(chunk.dtype)
-                            kdt = np.dtype(_dt.key_dtype(dtype))
-                            total_bits = _dt.key_bits(dtype)
-                            if total_bits % radix_bits:
-                                raise ValueError(
-                                    f"radix_bits={radix_bits} must divide "
-                                    f"key bits {total_bits}"
+
+            def _pass0(src_override, tee):
+                nonlocal dtype, n, kdt, total_bits, method
+                dtype = None  # fresh per attempt: the probe re-runs whole
+                n = 0
+                chunk_i0 = 0
+                writer = (
+                    store.new_generation()
+                    if tee and store is not None and read_gen is None
+                    else None
+                )
+                hist_c = ex = keys = None
+                try:
+                    with _pl._phase(timer, "descent.pass"), _key_chunk_stream(
+                        src_override if src_override is not None else _gen_src(),
+                        hist_method=hist_method, spill=writer,
+                        **stream_kw,
+                    ) as kc:
+                        for keys, chunk in kc:
+                            if dtype is None:
+                                dtype = np.dtype(chunk.dtype)
+                                kdt = np.dtype(_dt.key_dtype(dtype))
+                                total_bits = _dt.key_bits(dtype)
+                                if total_bits % radix_bits:
+                                    raise ValueError(
+                                        f"radix_bits={radix_bits} must divide "
+                                        f"key bits {total_bits}"
+                                    )
+                                method = resolve_stream_hist(hist_method, dtype)
+                                shift0 = total_bits - radix_bits
+                                hist_c = _ex.HistogramConsumer(
+                                    shift0, radix_bits, [None], method, kdt
                                 )
-                            method = resolve_stream_hist(hist_method, dtype)
-                            shift0 = total_bits - radix_bits
-                            hist_c = _ex.HistogramConsumer(
-                                shift0, radix_bits, [None], method, kdt
-                            )
-                            ex = _ex.StreamExecutor(
-                                [hist_c], window=window, occupancy=occupancy
-                            )
-                        if obs is not None:
-                            _wr.chunk_event(obs, 0, chunk_i0, keys, kdt, devs)
-                        chunk_i0 += 1
-                        n += int(keys.size)
-                        ex.push(keys)
+                                ex = _ex.StreamExecutor(
+                                    [hist_c], window=window, occupancy=occupancy
+                                )
+                            if obs is not None:
+                                _wr.chunk_event(obs, 0, chunk_i0, keys, kdt, devs)
+                            chunk_i0 += 1
+                            n += int(keys.size)
+                            ex.push(keys)
+                        if ex is not None:
+                            ex.drain()
+                    if n == 0:
+                        raise ValueError(
+                            "streaming selection requires a non-empty stream"
+                        )
+                    hist0 = hist_c.hists[None]
+                except BaseException:
                     if ex is not None:
-                        ex.drain()
-                if n == 0:
-                    raise ValueError(
-                        "streaming selection requires a non-empty stream"
-                    )
-                hist = hist_c.hists[None]
-            except BaseException:
-                if ex is not None:
-                    ex.abort()
-                _ex.release_staged(keys)  # the chunk in hand (idempotent)
-                if writer is not None:
-                    writer.abort()
-                raise
-            gen0 = None
-            if writer is not None:
-                gen0 = writer.commit()
+                        ex.abort()
+                    _ex.release_staged(keys)  # the chunk in hand (idempotent)
+                    if writer is not None:
+                        writer.abort()
+                    raise
+                gen = writer.commit() if writer is not None else None
+                return hist0, gen, chunk_i0
+
+            def _enospc_pass0(e):
+                raise _err.SpillCapacityError(
+                    "spill store out of disk while teeing generation 0 — "
+                    "no prior generation exists to degrade to; free disk "
+                    "space, point spill_dir elsewhere, or use spill='off' "
+                    "with a replayable source"
+                ) from e
+
+            # pass 0 of a ONE-SHOT source consumes the stream as it tees:
+            # no re-run is possible mid-stream, so its ladder is disabled
+            # (failures propagate typed, writer aborted, threads joined);
+            # replayable sources get the full transient-retry ladder
+            hist, gen0, chunk_i0 = _recover_pass(
+                _pass0,
+                policy=None if one_shot else policy,
+                reading_spill=read_gen is not None,
+                fallback=None,
+                on_enospc=_enospc_pass0,
+                obs=obs,
+                site="pass 0",
+            )
+            if gen0 is not None:
                 created.append(gen0)
-                if not own_store:
-                    keep_gen0 = gen0
+                if not own_store or one_shot:
+                    protected = gen0
                 _log_pass(0, gen0)
                 read_gen = gen0
             else:
@@ -801,8 +997,8 @@ def streaming_kselect_many(
             shift = total_bits - resolved - radix_bits
             prefixes = sorted({st[0] for st in states if _active(st)})
             expected = {st[0]: st[3] for st in states if _active(st)}
-            writer = filter_specs = None
-            if store is not None:
+            filter_specs = None
+            if store is not None and not spill_disabled:
                 # survivors this pass must carry forward: the active
                 # prefixes at this depth, plus parked ranks (population
                 # already <= collect_budget) still awaiting the collect —
@@ -815,66 +1011,116 @@ def streaming_kselect_many(
                         if not _active(st) and st[2] < total_bits
                     }
                 )
-                writer = store.new_generation()
             pass_label = resolved // radix_bits
             pass_read_gen = read_gen  # what this pass reads from
-            chunk_i = 0
-            # ONE executor bundle per chunk: the spill tee (first, so its
-            # eager form writes before the histogram handle can finish)
-            # and the histogram dispatch share the FIFO window, and the
-            # staged buffer is released when the LAST of the two results
-            # materializes — not before
-            hist_c = _ex.HistogramConsumer(shift, radix_bits, prefixes, method, kdt)
-            consumers = [hist_c]
-            if writer is not None:
-                consumers.insert(
-                    0,
-                    _ex.SpillTeeConsumer(
-                        writer, filter_specs, dtype, kdt, total_bits, devs,
-                        deferred=defer,
-                    ),
+
+            def _run_pass(
+                src_override, tee,
+                shift=shift, prefixes=prefixes, expected=expected,
+                filter_specs=filter_specs, pass_label=pass_label,
+                pass_read_gen=pass_read_gen,
+            ):
+                writer = (
+                    store.new_generation()
+                    if tee and filter_specs is not None
+                    else None
                 )
-            ex = _ex.StreamExecutor(consumers, window=window, occupancy=occupancy)
-            keys = None
-            try:
-                with _pl._phase(timer, "descent.pass"), _key_chunk_stream(
-                    _gen_src(), dtype, hist_method=method, **stream_kw
-                ) as kc:
-                    for keys, _ in kc:
-                        if obs is not None:
-                            _wr.chunk_event(obs, pass_label, chunk_i, keys, kdt, devs)
-                        chunk_i += 1
-                        ex.push(keys)
-                    ex.drain()
-            except BaseException:
-                ex.abort()
-                _ex.release_staged(keys)  # the chunk in hand (idempotent)
+                chunk_i = 0
+                pass_keys = 0
+                # what THIS attempt actually reads: the pass's default
+                # (the previous generation, or the source), or the
+                # recovery ladder's fallback (the source; gen 0 for
+                # one-shot runs) — the obs/pass_log accounting must
+                # describe the attempt that succeeded, not the schedule
+                read_from = (
+                    "spill"
+                    if (src_override is None and pass_read_gen is not None)
+                    or (src_override is not None and one_shot)
+                    else "source"
+                )
+                # ONE executor bundle per chunk: the spill tee (first, so
+                # its eager form writes before the histogram handle can
+                # finish) and the histogram dispatch share the FIFO
+                # window, and the staged buffer is released when the LAST
+                # of the two results materializes — not before
+                hist_c = _ex.HistogramConsumer(
+                    shift, radix_bits, prefixes, method, kdt
+                )
+                consumers = [hist_c]
                 if writer is not None:
-                    writer.abort()
-                raise
-            hists = hist_c.hists
-            for p in prefixes:
-                # replay-stability check, mirroring _collect_survivors':
-                # this pass's population under each surviving prefix must
-                # equal the bucket count the PREVIOUS pass (or the seeding
-                # sketch) established — a drifting source fails loudly here
-                # instead of walking a corrupt histogram to a wrong answer.
-                # On the spill path the read is a checksummed generation,
-                # so this is unreachable short of a store bug; it stays as
-                # the belt to the spill records' braces.
-                if int(hists[p].sum()) != expected[p]:
-                    raise RuntimeError(
-                        f"chunk source is not replay-stable: prefix {p:#x} "
-                        f"holds {int(hists[p].sum())} elements this pass, "
-                        f"previous pass counted {expected[p]}. The source "
-                        "callable must yield identical data on every "
-                        "invocation."
+                    consumers.insert(
+                        0,
+                        _ex.SpillTeeConsumer(
+                            writer, filter_specs, dtype, kdt, total_bits,
+                            devs, deferred=defer,
+                        ),
                     )
-            gen = None
-            if writer is not None:
-                gen = writer.commit()
-                _log_pass(pass_label, gen)
+                ex = _ex.StreamExecutor(
+                    consumers, window=window, occupancy=occupancy
+                )
+                keys = None
+                try:
+                    with _pl._phase(timer, "descent.pass"), _key_chunk_stream(
+                        src_override if src_override is not None else _gen_src(),
+                        dtype, hist_method=method, **stream_kw
+                    ) as kc:
+                        for keys, _ in kc:
+                            if obs is not None:
+                                _wr.chunk_event(
+                                    obs, pass_label, chunk_i, keys, kdt, devs
+                                )
+                            chunk_i += 1
+                            pass_keys += int(keys.size)
+                            ex.push(keys)
+                        ex.drain()
+                except BaseException:
+                    ex.abort()
+                    _ex.release_staged(keys)  # the chunk in hand (idempotent)
+                    if writer is not None:
+                        writer.abort()
+                    raise
+                hists = hist_c.hists
+                for p in prefixes:
+                    # replay-stability check, mirroring _collect_survivors':
+                    # this pass's population under each surviving prefix must
+                    # equal the bucket count the PREVIOUS pass (or the seeding
+                    # sketch) established — a drifting source fails loudly here
+                    # instead of walking a corrupt histogram to a wrong answer.
+                    # On the spill path the read is a checksummed generation,
+                    # so this is unreachable short of a store bug; it stays as
+                    # the belt to the spill records' braces (and holds the
+                    # recovery ladder's REBUILT reads to the same books).
+                    if int(hists[p].sum()) != expected[p]:
+                        raise RuntimeError(
+                            f"chunk source is not replay-stable: prefix {p:#x} "
+                            f"holds {int(hists[p].sum())} elements this pass, "
+                            f"previous pass counted {expected[p]}. The source "
+                            "callable must yield identical data on every "
+                            "invocation."
+                        )
+                gen = writer.commit() if writer is not None else None
+                return hists, gen, chunk_i, pass_keys, read_from
+
+            hists, gen, chunk_i, pass_keys, pass_read_from = _recover_pass(
+                _run_pass,
+                policy=policy,
+                reading_spill=read_gen is not None,
+                fallback=_fallback_src(),
+                on_enospc=_on_enospc,
+                obs=obs,
+                site=f"pass {pass_label}",
+            )
+            if gen is not None:
+                _log_pass(
+                    pass_label, gen, keys_read=pass_keys, read=pass_read_from
+                )
                 _rotate(gen)
+            elif store is not None:
+                # degraded (writer-less) passes still log their read, so
+                # the pass_log keeps its one-entry-per-pass accounting —
+                # and stays consistent with the StreamPassEvents — after
+                # an ENOSPC downgrade
+                _log_pass(pass_label, keys_read=pass_keys, read=pass_read_from)
             for st in states:
                 if _active(st):
                     st[0], st[1], st[3] = _np_walk(
@@ -898,19 +1144,11 @@ def streaming_kselect_many(
                         resolved_bits=resolved,
                         prefixes=tuple(int(p) for p in prefixes),
                         chunks=chunk_i,
-                        keys_read=(
-                            int(pass_read_gen.keys)
-                            if pass_read_gen is not None
-                            else n
-                        ),
-                        bytes_read=(
-                            int(pass_read_gen.nbytes)
-                            if pass_read_gen is not None
-                            else n * kdt.itemsize
-                        ),
-                        read_from=(
-                            "spill" if pass_read_gen is not None else "source"
-                        ),
+                        # the SUCCESSFUL attempt's actual read (a recovered
+                        # pass may have rebuilt from the ladder's fallback)
+                        keys_read=pass_keys,
+                        bytes_read=pass_keys * kdt.itemsize,
+                        read_from=pass_read_from,
                         bucket_total=totalp,
                         bucket_max=maxp,
                         bucket_nonzero=nzp,
@@ -926,14 +1164,41 @@ def streaming_kselect_many(
                 specs[(resolved, int(prefix))] = pop
         collected = {}
         if specs:
-            collected = _collect_survivors(
-                _gen_src(), dtype, specs, pipeline_depth=pipeline_depth,
-                timer=timer, devices=None if devices is None else devs,
-                hist_method=method, obs=obs,
-                read_from="spill" if read_gen is not None else "source",
-                deferred=defer,
+
+            def _run_collect(src_override, tee):
+                # the SUCCESSFUL attempt's actual read, for the event AND
+                # the pass_log (a rebuilt collect reads the source — or a
+                # one-shot run's gen-0 anchor — not the scheduled gen)
+                if src_override is None:
+                    read_from = "spill" if read_gen is not None else "source"
+                    kr = read_gen.keys if read_gen is not None else n
+                elif one_shot:
+                    read_from, kr = "spill", protected.keys
+                else:
+                    read_from, kr = "source", n
+                return (
+                    _collect_survivors(
+                        src_override if src_override is not None else _gen_src(),
+                        dtype, specs, pipeline_depth=pipeline_depth,
+                        timer=timer, devices=None if devices is None else devs,
+                        hist_method=method, obs=obs,
+                        read_from=read_from,
+                        deferred=defer, retry=policy,
+                    ),
+                    read_from,
+                    int(kr),
+                )
+
+            collected, coll_read, coll_keys = _recover_pass(
+                _run_collect,
+                policy=policy,
+                reading_spill=read_gen is not None,
+                fallback=_fallback_src(),
+                on_enospc=None,
+                obs=obs,
+                site="collect",
             )
-            _log_pass("collect")
+            _log_pass("collect", keys_read=coll_keys, read=coll_read)
 
         if obs is not None and obs.metrics is not None:
             # snapshot the run's counters while the store is still open
@@ -964,13 +1229,13 @@ def streaming_kselect_many(
             # caller-owned store: drop descent-internal generations, keep
             # the pass-0 tee (it can serve refine/certificate/next calls)
             for g in created:
-                if g is not keep_gen0 and not g.dropped:
+                if g is not protected and not g.dropped:
                     store.drop_generation(g)
 
 
 def streaming_rank_certificate(
     source, value, *, pipeline_depth: int = DEFAULT_PIPELINE_DEPTH, timer=None,
-    devices=None, deferred=DEFAULT_DEFERRED, obs=None,
+    devices=None, deferred=DEFAULT_DEFERRED, retry=None, obs=None,
 ):
     """``(#elements < value, #elements <= value)`` streamed — the O(n)
     exactness proof of utils/debug.py:rank_certificate without residency:
@@ -991,9 +1256,15 @@ def streaming_rank_certificate(
     :class:`~mpi_k_selection_tpu.streaming.spill.SpillStore` with a
     committed generation: the single counting pass then replays the
     spilled keys instead of the original stream (certifying a one-shot
-    source's answer without re-reading it)."""
+    source's answer without re-reading it). ``retry`` (see
+    :func:`streaming_kselect_many`; None = the bounded default) gives
+    the counting pass mid-pass re-pull on transient source errors and
+    in-place staging retries — counts are bit-identical on recovery."""
     defer = _ex.resolve_deferred(deferred)
+    policy = _fp.resolve_retry(retry)
     src = as_chunk_source(source, mmap=defer)
+    if policy is not None:
+        src = _fp.resilient_source(src, policy, obs=obs)
     devs = _pl.resolve_stream_devices(devices)
     timer, _restore_recorder = _wr.attach_timer(obs, timer)
     multi = len(devs) > 1 and _pl.validate_pipeline_depth(pipeline_depth) > 0
@@ -1005,7 +1276,7 @@ def streaming_rank_certificate(
         with _pl._phase(timer, "certificate.pass"), _key_chunk_stream(
             src, pipeline_depth=pipeline_depth, timer=timer,
             hist_method="auto" if multi else None,
-            devices=devs if multi else None,
+            devices=devs if multi else None, retry=policy, obs=obs,
         ) as kc:
             for keys, chunk in kc:
                 if vkey is None:

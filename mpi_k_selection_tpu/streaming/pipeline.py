@@ -78,6 +78,9 @@ import threading
 
 import numpy as np
 
+from mpi_k_selection_tpu.faults import policy as _fpol
+from mpi_k_selection_tpu.faults.inject import maybe_fault as _maybe_fault
+
 #: Classic double buffering: chunk i+1 staged while chunk i computes.
 DEFAULT_PIPELINE_DEPTH = 2
 
@@ -388,7 +391,7 @@ class StagedKeys:
         if delete is not None:
             try:
                 delete()
-            except Exception:  # pragma: no cover - already consumed/donated
+            except Exception:  # pragma: no cover  # ksel: noqa[KSL012] -- release() is idempotent by contract: delete() of an already-consumed/donated buffer is the expected second-release path, and there is nothing to report or retry
                 pass
         if self.host_buf is not None and self.pool is not None:
             self.pool.release(self.host_buf, self.device)
@@ -409,7 +412,10 @@ def _bucket_elems(n: int) -> int:
     return n if bucket >= 1 << 31 else bucket
 
 
-def stage_keys(keys: np.ndarray, device=None, pool: StagingPool | None = None) -> StagedKeys:
+def stage_keys(
+    keys: np.ndarray, device=None, pool: StagingPool | None = None,
+    fault_index: int | None = None,
+) -> StagedKeys:
     """Pad host ``keys`` to their pow2 bucket and transfer to ``device``
     (``None`` = the caller's default device, uncommitted — the single-slot
     path; a concrete device commits the buffer there, the round-robin
@@ -421,6 +427,16 @@ def stage_keys(keys: np.ndarray, device=None, pool: StagingPool | None = None) -
     re-allocating every chunk."""
     import jax
 
+    # chaos hook (faults/inject.py; a no-op without an armed injector).
+    # Raising kinds fire BEFORE any buffer is acquired, so a retried
+    # stage re-runs this function whole — nothing to unwind.
+    # ``fault_index`` is the caller's STABLE occurrence key (the
+    # producer's staged-chunk counter): a retry of the same chunk must
+    # advance the (site, index) ATTEMPT counter, not land on a fresh
+    # index — that is what lets a plan schedule "chunk i fails attempt j
+    # then recovers" (None = auto-index by call order, for un-retried
+    # direct callers).
+    _maybe_fault("stage", fault_index)
     n = int(keys.shape[0])
     bucket = _bucket_elems(n)
     if bucket == n:
@@ -491,11 +507,16 @@ class ChunkPipeline:
 
     def __init__(
         self, src, dtype=None, *, depth: int, hist_method=None, timer=None,
-        devices=None, spill=None,
+        devices=None, spill=None, retry=None, obs=None,
     ):
         self._src = src
         self._dtype = None if dtype is None else np.dtype(dtype)
         self._depth = validate_pipeline_depth(depth)
+        # staging-transfer retry policy (faults/policy.py; None = fail on
+        # the first transient, the pre-resilience behavior) and the obs
+        # bundle its retry events go to
+        self._retry = retry
+        self._obs = obs
         if self._depth == 0:
             raise ValueError(
                 "ChunkPipeline requires pipeline_depth >= 1; depth 0 is "
@@ -558,6 +579,7 @@ class ChunkPipeline:
         dtype = self._dtype
         method = None
         slot = 0  # round-robin staging cursor over the resolved devices
+        staged_i = 0  # stable per-chunk fault key (retries share it)
         try:
             it = iter(self._src())
             while not self._stop.is_set():
@@ -595,13 +617,35 @@ class ChunkPipeline:
                             slot += 1
                         else:
                             staged_slot = replay_slot % len(self._devices)
-                        keys = stage_keys(keys, self._devices[staged_slot])
+                        # a transient device_put failure retries IN PLACE
+                        # (the host buffer is still in hand; re-issuing
+                        # the transfer is free) under the pass's policy —
+                        # exhaustion raises RetryExhaustedError through
+                        # the consumer like any other producer error
+                        dev = self._devices[staged_slot]
+                        keys = _fpol.retry_call(
+                            lambda hk=keys, d=dev, i=staged_i: stage_keys(
+                                hk, d, fault_index=i
+                            ),
+                            self._retry, site="stage", obs=self._obs,
+                        )
+                        staged_i += 1
                 if self._spill is not None:
-                    with _phase(self._timer, "pipeline.spill"):
-                        # device-chunk keys live on device: land them host-
-                        # side for the record (host chunks tee in place)
-                        hk = host_keys if host_keys is not None else np.asarray(keys)
-                        self._spill.append(hk, dtype, device_slot=staged_slot)
+                    try:
+                        with _phase(self._timer, "pipeline.spill"):
+                            # device-chunk keys live on device: land them
+                            # host-side for the record (host chunks tee in
+                            # place)
+                            hk = host_keys if host_keys is not None else np.asarray(keys)
+                            self._spill.append(hk, dtype, device_slot=staged_slot)
+                    except BaseException:
+                        # a failing tee write (ENOSPC, a transient disk
+                        # error) abandons the chunk in hand before it
+                        # reaches the consumer: release its staged ring
+                        # slot or the leak accounting never sees it
+                        if isinstance(keys, StagedKeys):
+                            keys.release()
+                        raise
                 # every consumer reads only `.dtype` off the companion (and
                 # only on the first chunk): a zero-length stand-in keeps the
                 # queue from pinning the full original chunk alongside its

@@ -66,6 +66,7 @@ import zlib
 import numpy as np
 
 from mpi_k_selection_tpu.errors import SpillError, SpillRecordError
+from mpi_k_selection_tpu.faults.inject import maybe_fault as _maybe_fault
 from mpi_k_selection_tpu.streaming.pipeline import _bucket_elems
 
 #: Temp-directory prefix for internally-created stores; tests assert none
@@ -163,6 +164,16 @@ class SpillWriter:
         replay validates against the stream like any other chunk)."""
         if self._done:
             raise SpillError("spill generation already committed/aborted")
+        # chaos hook, keyed by the record index WITHIN the generation
+        # (ENOSPC, transient raise) — stable across recovery re-runs: a
+        # re-run pass builds a fresh writer whose counts restart, so
+        # re-appending record i advances the (site, i) ATTEMPT counter
+        # instead of landing on a fresh index, which is what lets a plan
+        # schedule both one-shot and hard write faults. Fires BEFORE
+        # anything touches disk, so a recovered pass re-appends cleanly;
+        # a real mid-write ENOSPC surfaces from the open/write below as
+        # the same OSError class either way.
+        _maybe_fault("spill.write", index=self._count)
         keys = np.ascontiguousarray(keys)
         if keys.ndim != 1:  # pragma: no cover - callers always ravel
             keys = keys.ravel()
@@ -272,6 +283,13 @@ class SpillGeneration:
 
 
 def _read_record(rec: SpillRecord, mmap: bool = False) -> SpillChunk:
+    # chaos hook, keyed by the record's chunk index: transient raises and
+    # checksum blips fire here; the persistent kinds (corrupt_disk,
+    # truncate) damage the file on disk and fall through, so the REAL
+    # header/size/CRC validation below is what raises — the recovery
+    # ladder (streaming/chunked.py:_recover_pass) is exercised against
+    # the production error surface, not a simulated one.
+    _maybe_fault("spill.read", index=rec.chunk_index, path=rec.path)
     try:
         f = open(rec.path, "rb")
     except OSError as e:

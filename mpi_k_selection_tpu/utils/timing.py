@@ -45,6 +45,36 @@ def time_fn(fn: Callable[[], Any], *, repeats: int = 1, warmup: int = 0):
     return best, result
 
 
+class Deadline:
+    """A monotonic deadline: ``Deadline.after(seconds)`` captures the
+    clock ONCE here (KSL004: raw clocks live in utils/timing +
+    utils/profiling only) and everyone downstream asks ``remaining()``/
+    ``expired`` instead of reading clocks themselves. The serving layer
+    (serve/batcher.py) threads one per request so waiters time out and
+    the dispatch thread can fail expired queries fast without ever
+    touching ``time`` itself."""
+
+    __slots__ = ("_t1",)
+
+    def __init__(self, t1: float):
+        self._t1 = float(t1)
+
+    @classmethod
+    def after(cls, seconds: float) -> "Deadline":
+        s = float(seconds)
+        if s <= 0:
+            raise ValueError(f"deadline must be > 0 seconds, got {s}")
+        return cls(time.monotonic() + s)
+
+    def remaining(self) -> float:
+        """Seconds left (clamped at 0.0 once expired)."""
+        return max(0.0, self._t1 - time.monotonic())
+
+    @property
+    def expired(self) -> bool:
+        return time.monotonic() >= self._t1
+
+
 @dataclasses.dataclass
 class ResultRecord:
     """Structured run record (SURVEY.md §5 metrics/logging plan)."""

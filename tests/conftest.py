@@ -33,20 +33,27 @@ def rng():
 
 @pytest.fixture(autouse=True)
 def _no_leaked_pipeline_threads():
-    """Every streaming-pipeline producer thread (``ksel-pipeline-*``) AND
-    every query-server thread (``ksel-serve-*``: the batcher's dispatch
-    thread, the HTTP serve loop, per-request handler threads) must be
-    joined by the time its owner returns/closes — normally AND on every
-    raise path. A thread surviving a test is a shutdown bug in
-    streaming/pipeline.py or serve/, not test noise."""
+    """Every package-owned thread must be joined by the time its owner
+    returns/closes — normally AND on every raise/injected-fault path.
+    All such threads carry the ``ksel-`` name prefix (``ksel-pipeline-*``
+    producers, ``ksel-serve-*``: the batcher's SUPERVISED dispatch
+    thread — restarts reuse the same thread, so its name survives a
+    crash-recover cycle — the HTTP serve loop, per-request handlers, and
+    any future faults/-layer worker), so the fixture matches the prefix
+    family rather than an allowlist a new subsystem could silently fall
+    out of. A thread surviving a test is a shutdown bug in
+    streaming/pipeline.py, serve/, or faults/, not test noise."""
     yield
+    # the canonical prefixes both start with "ksel-"; assert that stays
+    # true so a renamed subsystem cannot dodge the generic match
     from mpi_k_selection_tpu.serve.batcher import SERVE_THREAD_PREFIX
     from mpi_k_selection_tpu.streaming.pipeline import THREAD_NAME_PREFIX
 
-    prefixes = (THREAD_NAME_PREFIX, SERVE_THREAD_PREFIX)
+    assert THREAD_NAME_PREFIX.startswith("ksel-")
+    assert SERVE_THREAD_PREFIX.startswith("ksel-")
     stragglers = [
         t for t in threading.enumerate()
-        if t.name.startswith(prefixes)
+        if t.name.startswith("ksel-")
     ]
     for t in stragglers:  # grace for a close() racing the fixture
         t.join(timeout=5.0)

@@ -1,0 +1,871 @@
+"""Fault-injection harness + resilience policies (mpi_k_selection_tpu/faults/).
+
+Three layers of coverage:
+
+- **harness units** — seeded plan determinism, spec validation, the
+  injectable sleeper, retry policy arithmetic, injector lifecycle;
+- **streaming recovery** — the seeded chaos grid (plans x devices x
+  spill x deferred, recovered answers bit-identical to fault-free runs),
+  the spill re-read/rebuild ladder, the one-shot gen-0 anchor, the
+  ENOSPC downgrade, and typed raises when policies are exhausted — with
+  the autouse conftest fixtures asserting no leaked threads, staged
+  buffers, or spill dirs on EVERY injected-fault path;
+- **serve hardening** — deadlines (waiter timeout + dispatch-side fast
+  fail, HTTP 504), queue-depth admission control (503 + Retry-After),
+  supervised dispatch restarts, and graceful drain.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+import warnings
+
+import numpy as np
+import pytest
+
+from mpi_k_selection_tpu import faults
+from mpi_k_selection_tpu import obs as obs_lib
+from mpi_k_selection_tpu.errors import (
+    RetryExhaustedError,
+    SpillCapacityError,
+    SpillRecordError,
+    TransientError,
+)
+from mpi_k_selection_tpu.streaming.chunked import (
+    streaming_kselect,
+    streaming_kselect_many,
+    streaming_rank_certificate,
+)
+
+
+def _chunks(sizes=(5000, 4096, 2048, 4096, 1024), dtype=np.int32, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        rng.integers(-(2**31), 2**31 - 1, size=m, dtype=np.int64).astype(dtype)
+        for m in sizes
+    ]
+
+
+CHUNKS = _chunks()
+X = np.concatenate(CHUNKS)
+K = X.size // 2
+WANT = int(np.sort(X, kind="stable")[K - 1])
+KW = dict(radix_bits=4, collect_budget=64)
+
+
+def _policy(**kw):
+    kw.setdefault("sleeper", faults.VirtualSleeper())
+    return faults.RetryPolicy(**kw)
+
+
+# ---------------------------------------------------------------------------
+# harness units
+
+
+def test_seeded_plan_deterministic():
+    a = faults.FaultPlan.seeded(42, n_chunks=6, faults=5)
+    b = faults.FaultPlan.seeded(42, n_chunks=6, faults=5)
+    assert a == b and a.seed == 42 and len(a.specs) == 5
+    c = faults.FaultPlan.seeded(43, n_chunks=6, faults=5)
+    assert a != c
+
+
+def test_seeded_plan_recoverable_vs_hard():
+    soft = faults.FaultPlan.seeded(1, recoverable=True)
+    assert all(s.attempts == (0,) for s in soft.specs)
+    hard = faults.FaultPlan.seeded(1, recoverable=False)
+    assert all(
+        s.attempts == (0,) if s.kind == "stall" else len(s.attempts) > 10
+        for s in hard.specs
+    )
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        dict(site="nope", index=0, kind="raise"),
+        dict(site="source", index=0, kind="nope"),
+        dict(site="source", index=0, kind="enospc"),  # kind/site mismatch
+        dict(site="spill.write", index=0, kind="corrupt"),
+        dict(site="source", index=-1, kind="raise"),
+        dict(site="source", index=0, kind="raise", attempts=()),
+        dict(site="source", index=0, kind="raise", attempts=(-1,)),
+    ],
+)
+def test_fault_spec_validation(bad):
+    with pytest.raises(ValueError):
+        faults.FaultSpec(**bad)
+
+
+def test_fault_plan_rejects_non_specs():
+    with pytest.raises(ValueError):
+        faults.FaultPlan(specs=("not a spec",))
+
+
+def test_virtual_sleeper_records_without_blocking():
+    vs = faults.VirtualSleeper()
+    vs.sleep(1000.0)  # would hang a real sleeper
+    vs.sleep(0.5)
+    assert vs.slept == [1000.0, 0.5] and vs.total == 1000.5
+
+
+def test_resolve_sleeper():
+    assert faults.resolve_sleeper(None) is faults.DEFAULT_SLEEPER
+    vs = faults.VirtualSleeper()
+    assert faults.resolve_sleeper(vs) is vs
+    with pytest.raises(ValueError):
+        faults.resolve_sleeper(42)
+
+
+def test_retry_policy_backoff_bounded():
+    p = faults.RetryPolicy(backoff_base=0.1, backoff_max=0.35)
+    assert p.backoff(1) == pytest.approx(0.1)
+    assert p.backoff(2) == pytest.approx(0.2)
+    assert p.backoff(3) == pytest.approx(0.35)  # capped
+    assert p.backoff(10) == pytest.approx(0.35)
+    with pytest.raises(ValueError):
+        faults.RetryPolicy(max_attempts=0)
+
+
+def test_resolve_retry_forms():
+    assert faults.resolve_retry(None) is faults.DEFAULT_RETRY
+    assert faults.resolve_retry("default") is faults.DEFAULT_RETRY
+    assert faults.resolve_retry("off") is None
+    assert faults.resolve_retry(False) is None
+    p = _policy()
+    assert faults.resolve_retry(p) is p
+    with pytest.raises(ValueError):
+        faults.resolve_retry("sometimes")
+
+
+def test_retry_call_recovers_then_exhausts():
+    vs = faults.VirtualSleeper()
+    p = faults.RetryPolicy(max_attempts=3, backoff_base=0.25, sleeper=vs)
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise TransientError("blip")
+        return "ok"
+
+    assert faults.retry_call(flaky, p, site="t") == "ok"
+    assert vs.slept == [0.25, 0.5]  # exponential, through the sleeper
+
+    def always():
+        raise TransientError("down")
+
+    with pytest.raises(RetryExhaustedError) as ei:
+        faults.retry_call(always, p, site="t")
+    assert ei.value.site == "t" and ei.value.attempts == 3
+    assert isinstance(ei.value.__cause__, TransientError)
+
+
+def test_retry_call_non_retryable_propagates():
+    def boom():
+        raise ValueError("logic bug")
+
+    with pytest.raises(ValueError):
+        faults.retry_call(boom, _policy(), site="t")
+
+
+def test_inject_rejects_rewiring_prebuilt_injector():
+    # silently dropping sleeper=/obs= would de-virtualize sleeps and
+    # lose every inject event — must fail loudly
+    inj = faults.FaultInjector(faults.FaultPlan())
+    with pytest.raises(ValueError, match="pre-built injector"):
+        with faults.inject(inj, sleeper=faults.VirtualSleeper()):
+            pass  # pragma: no cover
+    assert faults.active_injector() is None
+    with faults.inject(inj) as armed:  # no rewiring: fine
+        assert armed is inj
+
+
+def test_inject_lifecycle_and_nesting():
+    plan = faults.FaultPlan()
+    assert faults.active_injector() is None
+    with faults.inject(plan) as inj:
+        assert faults.active_injector() is inj
+        with pytest.raises(RuntimeError):
+            with faults.inject(plan):
+                pass  # pragma: no cover
+    assert faults.active_injector() is None
+    # disarmed on the raise path too
+    with pytest.raises(KeyError):
+        with faults.inject(plan):
+            raise KeyError("x")
+    assert faults.active_injector() is None
+
+
+def test_injector_stall_uses_sleeper():
+    vs = faults.VirtualSleeper()
+    plan = faults.FaultPlan(
+        (faults.FaultSpec("source", 0, "stall", arg=0.7),)
+    )
+    inj = faults.FaultInjector(plan, sleeper=vs)
+    assert inj.maybe_fault("source", 0) is not None
+    assert vs.slept == [0.7]
+    assert inj.maybe_fault("source", 0) is None  # attempt 1: clean
+    assert inj.fired == [
+        {"site": "source", "kind": "stall", "index": 0, "attempt": 0}
+    ]
+
+
+# ---------------------------------------------------------------------------
+# resilient source
+
+
+def test_resilient_source_mid_pass_repull():
+    vs = faults.VirtualSleeper()
+    p = faults.RetryPolicy(sleeper=vs)
+    plan = faults.FaultPlan((faults.FaultSpec("source", 2, "raise"),))
+    with faults.inject(plan, sleeper=vs) as inj:
+        src = faults.resilient_source(
+            inj.wrap_chunk_source(lambda: iter(CHUNKS)), p
+        )
+        got = list(src())
+    assert len(got) == len(CHUNKS)
+    assert all(np.array_equal(a, b) for a, b in zip(got, CHUNKS))
+    assert len(vs.slept) == 1  # one backoff for one transient
+
+
+def test_resilient_source_exhausts_typed():
+    p = _policy()
+    plan = faults.FaultPlan(
+        (faults.FaultSpec("source", 1, "raise", attempts=tuple(range(99))),)
+    )
+    with faults.inject(plan) as inj:
+        src = faults.resilient_source(
+            inj.wrap_chunk_source(lambda: iter(CHUNKS)), p
+        )
+        with pytest.raises(RetryExhaustedError) as ei:
+            list(src())
+    assert ei.value.site == "source"
+
+
+def test_resilient_source_non_retryable_propagates():
+    def bad():
+        yield CHUNKS[0]
+        raise KeyError("not transient")
+
+    src = faults.resilient_source(lambda: bad(), _policy())
+    with pytest.raises(KeyError):
+        list(src())
+
+
+def test_resilient_source_detects_shrunken_repull():
+    state = {"calls": 0}
+
+    def drifting():
+        state["calls"] += 1
+        if state["calls"] == 1:
+            yield CHUNKS[0]
+            yield CHUNKS[1]
+            raise TransientError("blip")
+        # the re-pull yields FEWER chunks than already consumed
+        yield CHUNKS[0]
+
+    src = faults.resilient_source(lambda: drifting(), _policy())
+    it = src()
+    assert np.array_equal(next(it), CHUNKS[0])
+    assert np.array_equal(next(it), CHUNKS[1])
+    with pytest.raises(RuntimeError, match="not replay-stable"):
+        next(it)
+
+
+# ---------------------------------------------------------------------------
+# the seeded chaos grid (ISSUE acceptance): recovered == fault-free bits
+
+
+@pytest.mark.parametrize("seed", [11, 22, 33])
+@pytest.mark.parametrize("devices", [1, 2])
+@pytest.mark.parametrize("spill", ["off", "force"])
+@pytest.mark.parametrize("deferred", ["on", "off"])
+def test_chaos_grid_bit_identical(seed, devices, spill, deferred):
+    plan = faults.FaultPlan.seeded(seed, n_chunks=len(CHUNKS), faults=3)
+    vs = faults.VirtualSleeper()
+    with faults.inject(plan, sleeper=vs) as inj:
+        src = inj.wrap_chunk_source(lambda: iter(CHUNKS))
+        got = streaming_kselect_many(
+            src, [K // 2, K], spill=spill, devices=devices,
+            deferred=deferred, retry=_policy(), **KW,
+        )
+    want = [
+        int(np.sort(X, kind="stable")[K // 2 - 1]),
+        WANT,
+    ]
+    assert [int(v) for v in got] == want, (
+        f"seed={seed} devices={devices} spill={spill} deferred={deferred} "
+        f"fired={inj.fired}"
+    )
+
+
+def test_chaos_grid_float32_leg():
+    fchunks = _chunks(dtype=np.float32, seed=3)
+    fx = np.concatenate(fchunks)
+    fk = fx.size // 3
+    want = np.sort(fx, kind="stable")[fk - 1]
+    plan = faults.FaultPlan.seeded(5, n_chunks=len(fchunks), faults=3)
+    with faults.inject(plan, sleeper=faults.VirtualSleeper()) as inj:
+        got = streaming_kselect(
+            inj.wrap_chunk_source(lambda: iter(fchunks)), fk,
+            spill="force", retry=_policy(), **KW,
+        )
+    assert np.asarray(got).tobytes() == np.asarray(want).tobytes()
+
+
+# ---------------------------------------------------------------------------
+# the spill recovery ladder
+
+
+def test_recover_pass_retries_retryable_oserror_subclasses():
+    # ConnectionError/TimeoutError ARE OSError subclasses: the ENOSPC
+    # rung must dispatch on errno, never intercept them away from the
+    # pass-level transient retry
+    from mpi_k_selection_tpu.streaming.chunked import _recover_pass
+
+    calls = []
+
+    def run(src, tee):
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionError("transient network failure")
+        return "ok"
+
+    got = _recover_pass(
+        run, policy=_policy(), reading_spill=False, fallback=None,
+        on_enospc=lambda e: (_ for _ in ()).throw(AssertionError("wrong rung")),
+        obs=None, site="t",
+    )
+    assert got == "ok" and len(calls) == 3
+
+
+def test_transient_record_error_rereads_once():
+    o = obs_lib.Observability.collecting()
+    plan = faults.FaultPlan((faults.FaultSpec("spill.read", 1, "corrupt"),))
+    with faults.inject(plan, obs=o) as inj:
+        got = int(
+            streaming_kselect(
+                CHUNKS, K, spill="force", retry=_policy(), obs=o, **KW
+            )
+        )
+    assert got == WANT
+    assert inj.fired and inj.fired[0]["kind"] == "corrupt"
+    actions = [e.action for e in o.events.of_kind("fault")]
+    assert "reread" in actions and "rebuild" not in actions
+
+
+def test_persistent_corruption_rebuilds_from_source():
+    o = obs_lib.Observability.collecting()
+    plan = faults.FaultPlan(
+        (faults.FaultSpec("spill.read", 0, "corrupt_disk"),)
+    )
+    with faults.inject(plan, obs=o):
+        got = int(
+            streaming_kselect(
+                CHUNKS, K, spill="force", retry=_policy(), obs=o, **KW
+            )
+        )
+    assert got == WANT
+    actions = [e.action for e in o.events.of_kind("fault")]
+    assert "reread" in actions and "rebuild" in actions
+    # the recovery counters rode the registry
+    assert (
+        o.metrics.counter(
+            "faults.recovered", labels={"site": "spill.read", "action": "rebuild"}
+        ).value
+        == 1
+    )
+
+
+def test_truncation_rebuilds_from_source():
+    plan = faults.FaultPlan((faults.FaultSpec("spill.read", 2, "truncate"),))
+    with faults.inject(plan):
+        got = int(
+            streaming_kselect(CHUNKS, K, spill="force", retry=_policy(), **KW)
+        )
+    assert got == WANT
+
+
+def test_one_shot_falls_back_to_gen0_anchor():
+    # attempt 1 of record-index 0 is the SECOND generation's read (record
+    # indices restart per generation): gen 1 corrupt, gen 0 intact —
+    # the consumed stream's only rebuild source is the gen-0 tee
+    plan = faults.FaultPlan(
+        (faults.FaultSpec("spill.read", 0, "corrupt_disk", attempts=(1,)),)
+    )
+    o = obs_lib.Observability.collecting()
+    with faults.inject(plan, obs=o):
+        got = int(
+            streaming_kselect(
+                iter(list(CHUNKS)), K, retry=_policy(), obs=o, **KW
+            )
+        )
+    assert got == WANT
+    assert "rebuild" in [e.action for e in o.events.of_kind("fault")]
+
+
+def test_one_shot_gen0_corruption_raises_typed():
+    # gen 0 is the consumed stream's ONLY copy: damage to it is
+    # unrecoverable and must raise the typed record error (never answer
+    # wrong) — with no leaked threads/buffers/dirs (autouse fixtures)
+    plan = faults.FaultPlan(
+        (faults.FaultSpec("spill.read", 1, "corrupt_disk"),)
+    )
+    with faults.inject(plan):
+        with pytest.raises(SpillRecordError):
+            streaming_kselect(iter(list(CHUNKS)), K, retry=_policy(), **KW)
+
+
+def test_record_error_without_retry_policy_still_ladders():
+    # the re-read/rebuild ladder is spill-shaped, not policy-shaped: it
+    # works even with retry="off" (only TRANSIENT re-runs need a policy)
+    plan = faults.FaultPlan(
+        (faults.FaultSpec("spill.read", 0, "corrupt_disk"),)
+    )
+    with faults.inject(plan):
+        got = int(
+            streaming_kselect(CHUNKS, K, spill="force", retry="off", **KW)
+        )
+    assert got == WANT
+
+
+def test_enospc_degrades_auto_spill():
+    o = obs_lib.Observability.collecting()
+    # record 0, attempt 1: the SECOND generation that writes its first
+    # record (gen 0 tees cleanly at attempt 0) — the degradable window
+    plan = faults.FaultPlan(
+        (faults.FaultSpec("spill.write", 0, "enospc", attempts=(1,)),)
+    )
+    with faults.inject(plan, obs=o), warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        got = int(
+            streaming_kselect(
+                iter(list(CHUNKS)), K, retry=_policy(), obs=o, **KW
+            )
+        )
+    assert got == WANT
+    assert any("ENOSPC" in str(x.message) for x in w)
+    assert "degrade" in [e.action for e in o.events.of_kind("fault")]
+    # the degraded (writer-less) passes still log: one pass_log entry per
+    # streamed pass, snapshotted into the registry while the store was open
+    assert o.metrics.counter("spill.passes").value == len(
+        o.events.of_kind("stream.pass")
+    )
+
+
+def test_enospc_in_force_mode_raises_typed():
+    plan = faults.FaultPlan(
+        (faults.FaultSpec("spill.write", 0, "enospc", attempts=(1,)),)
+    )
+    with faults.inject(plan):
+        with pytest.raises(SpillCapacityError):
+            streaming_kselect(CHUNKS, K, spill="force", retry=_policy(), **KW)
+
+
+def test_enospc_teeing_gen0_raises_typed():
+    plan = faults.FaultPlan((faults.FaultSpec("spill.write", 0, "enospc"),))
+    with faults.inject(plan):
+        with pytest.raises(SpillCapacityError, match="generation 0"):
+            streaming_kselect(CHUNKS, K, spill="force", retry=_policy(), **KW)
+
+
+def test_hard_spill_write_fault_exhausts_typed():
+    # attempts semantics at the spill.write site: record indices are
+    # per-generation, so a hard spec keeps firing across pass re-runs
+    # until the pass-level budget exhausts (never a silent recovery)
+    plan = faults.FaultPlan(
+        (
+            faults.FaultSpec(
+                "spill.write", 0, "raise", attempts=tuple(range(1, 99))
+            ),
+        )
+    )
+    pol = faults.RetryPolicy(max_attempts=2, sleeper=faults.VirtualSleeper())
+    with faults.inject(plan):
+        with pytest.raises(RetryExhaustedError):
+            streaming_kselect(CHUNKS, K, spill="force", retry=pol, **KW)
+
+
+def test_stage_fault_retries_in_place():
+    plan = faults.FaultPlan((faults.FaultSpec("stage", 1, "raise"),))
+    with faults.inject(plan) as inj:
+        got = int(streaming_kselect(CHUNKS, K, retry=_policy(), **KW))
+    assert got == WANT
+    assert inj.fired and inj.fired[0]["site"] == "stage"
+
+
+def test_stage_fault_exhausts_typed_without_leaks():
+    plan = faults.FaultPlan(
+        (faults.FaultSpec("stage", 1, "raise", attempts=tuple(range(99))),)
+    )
+    pol = faults.RetryPolicy(max_attempts=2, sleeper=faults.VirtualSleeper())
+    with faults.inject(plan):
+        with pytest.raises(RetryExhaustedError) as ei:
+            streaming_kselect(CHUNKS, K, retry=pol, **KW)
+    # the staging retry exhausts in place on the producer; the consumer
+    # may also re-run the pass under ITS transient budget before the
+    # final typed raise — either way the terminal error is typed
+    assert ei.value.site in ("stage", "pass 0")
+
+
+def test_retry_off_fails_on_first_transient():
+    plan = faults.FaultPlan((faults.FaultSpec("source", 1, "raise"),))
+    with faults.inject(plan) as inj:
+        with pytest.raises(TransientError):
+            streaming_kselect(
+                inj.wrap_chunk_source(lambda: iter(CHUNKS)), K,
+                retry="off", **KW,
+            )
+
+
+def test_consumer_raise_with_stalled_producer_leaks_nothing():
+    # a consumer-side raise while the producer is slowed by an injected
+    # stall: close() must join the thread and release the chunk
+    # abandoned mid-put — the autouse fixtures (threads, staged buffers,
+    # spill dirs) are the assertion here
+    from mpi_k_selection_tpu.streaming import chunked as _ck
+    from mpi_k_selection_tpu.streaming import executor as _ex
+
+    plan = faults.FaultPlan(
+        (faults.FaultSpec("source", 2, "stall", arg=0.05),)
+    )
+    with faults.inject(plan) as inj:  # REAL sleeper: the stall blocks
+        src = inj.wrap_chunk_source(lambda: iter(CHUNKS))
+        with pytest.raises(KeyError):
+            with _ck._key_chunk_stream(
+                src, pipeline_depth=2, hist_method="auto"
+            ) as kc:
+                keys = None
+                try:
+                    keys, _ = next(iter(kc))
+                    raise KeyError("consumer bug mid-stream")
+                finally:
+                    # the chunk IN HAND is the consumer's to release —
+                    # the same discipline every pass body follows in its
+                    # except path; everything still queued or mid-put is
+                    # the pipeline's close() sweep's job
+                    _ex.release_staged(keys)
+
+
+def test_certificate_recovers_transient_source_fault():
+    plan = faults.FaultPlan((faults.FaultSpec("source", 2, "raise"),))
+    clean_less, clean_leq = streaming_rank_certificate(CHUNKS, WANT)
+    with faults.inject(plan) as inj:
+        less, leq = streaming_rank_certificate(
+            inj.wrap_chunk_source(lambda: iter(CHUNKS)), WANT,
+            retry=_policy(),
+        )
+    assert (less, leq) == (clean_less, clean_leq)
+    assert less < K <= leq
+
+
+def test_stream_invariants_hold_through_recovery():
+    from mpi_k_selection_tpu.streaming.spill import SpillStore
+
+    o = obs_lib.Observability.collecting()
+    plan = faults.FaultPlan(
+        (
+            faults.FaultSpec("source", 1, "raise"),
+            faults.FaultSpec("spill.read", 0, "corrupt_disk"),
+        )
+    )
+    with SpillStore() as store:
+        with faults.inject(plan, obs=o) as inj:
+            got = int(
+                streaming_kselect(
+                    inj.wrap_chunk_source(lambda: iter(CHUNKS)), K,
+                    spill=store, retry=_policy(), obs=o, **KW,
+                )
+            )
+        log = list(store.pass_log)
+    assert got == WANT
+    # the event-stream contract holds with recovery attempts interleaved,
+    # INCLUDING the entry-for-entry pass_log bytes cross-check: rebuilt
+    # passes (and the collect) must log the successful attempt's ACTUAL
+    # read, not the scheduled generation's
+    obs_lib.check_stream_invariants(o.events.events, spill_pass_log=log)
+    assert any(e["pass"] == "collect" for e in log)
+    assert (
+        o.metrics.counter("faults.injected", labels={"site": "source"}).value
+        >= 1
+    )
+
+
+def test_resilient_source_budget_resets_per_incident():
+    # isolated recoverable transients on DIFFERENT chunks must never
+    # accumulate into an exhaustion: only consecutive failures around
+    # one incident share a budget
+    p = faults.RetryPolicy(max_attempts=2, sleeper=faults.VirtualSleeper())
+    plan = faults.FaultPlan(
+        (
+            faults.FaultSpec("source", 0, "raise"),
+            faults.FaultSpec("source", 2, "raise"),
+            faults.FaultSpec("source", 4, "raise"),
+        )
+    )
+    with faults.inject(plan) as inj:
+        src = faults.resilient_source(
+            inj.wrap_chunk_source(lambda: iter(CHUNKS)), p
+        )
+        got = list(src())
+    assert len(got) == len(CHUNKS)
+    assert all(np.array_equal(a, b) for a, b in zip(got, CHUNKS))
+    assert len(inj.fired) == 3  # every scheduled transient actually fired
+
+
+# ---------------------------------------------------------------------------
+# serve hardening: deadlines, admission control, supervision, drain
+
+
+from mpi_k_selection_tpu.serve import (  # noqa: E402 - grouped with their tests
+    DeadlineExceededError,
+    DispatchCrashedError,
+    KSelectServer,
+    ServerOverloadedError,
+    start_http_server,
+)
+from mpi_k_selection_tpu.serve.batcher import PendingQuery  # noqa: E402
+from mpi_k_selection_tpu.utils.timing import Deadline  # noqa: E402
+
+
+def test_deadline_unit():
+    d = Deadline.after(30.0)
+    assert not d.expired and 0.0 < d.remaining() <= 30.0
+    z = Deadline(0.0)  # epoch-past monotonic instant
+    assert z.expired and z.remaining() == 0.0
+    with pytest.raises(ValueError):
+        Deadline.after(0.0)
+
+
+class _Blocker:
+    """Parks the dispatch thread until released, so queue/deadline
+    behavior is observable deterministically."""
+
+    def __init__(self, srv, dataset="d"):
+        self.srv = srv
+        self.entered = threading.Event()
+        self.release = threading.Event()
+        ds = srv.registry.get(dataset)
+        self.pending = srv.batcher.submit(
+            PendingQuery(dataset, "op", ds=ds, run=self._run)
+        )
+
+    def _run(self):
+        self.entered.set()
+        self.release.wait(10.0)
+        return None
+
+    def done(self):
+        self.release.set()
+        self.pending.wait()
+
+
+@pytest.fixture
+def served():
+    o = obs_lib.Observability.collecting()
+    srv = KSelectServer(max_queue_depth=2, retry_after=0.25, obs=o)
+    srv.add_dataset("d", np.arange(1000, dtype=np.int32))
+    yield srv, o
+    srv.close()
+
+
+def test_deadline_waiter_times_out(served):
+    srv, o = served
+    b = _Blocker(srv)
+    b.entered.wait(5.0)
+    try:
+        with pytest.raises(DeadlineExceededError):
+            srv.kselect("d", 5, tier="exact", deadline=0.05)
+    finally:
+        b.done()
+    assert o.metrics.counter("serve.deadline_exceeded").value == 1
+    assert "deadline" in [e.action for e in o.events.of_kind("fault")]
+
+
+def test_default_deadline_applies():
+    srv = KSelectServer(default_deadline=0.05)
+    srv.add_dataset("d", np.arange(64, dtype=np.int32))
+    try:
+        b = _Blocker(srv)
+        b.entered.wait(5.0)
+        try:
+            with pytest.raises(DeadlineExceededError):
+                srv.kselect("d", 5, tier="exact")
+        finally:
+            b.done()
+        # an explicit generous deadline overrides the tight default
+        ans = srv.kselect("d", 5, tier="exact", deadline=30.0)
+        assert int(ans.value) == 4
+    finally:
+        srv.close()
+
+
+def test_dispatch_drops_expired_before_running(served):
+    srv, o = served
+    b = _Blocker(srv)
+    b.entered.wait(5.0)
+    ran = []
+    ds = srv.registry.get("d")
+    expired = srv.batcher.submit(
+        PendingQuery(
+            "d", "op", ds=ds, run=lambda: ran.append(1),
+            deadline=Deadline(0.0),
+        )
+    )
+    b.done()
+    with pytest.raises(DeadlineExceededError):
+        expired.wait()
+    assert ran == []  # never executed
+    assert o.metrics.counter("serve.deadline_exceeded").value == 1
+
+
+def test_admission_control_sheds_with_retry_after(served):
+    srv, o = served
+    b = _Blocker(srv)
+    b.entered.wait(5.0)
+    ds = srv.registry.get("d")
+    try:
+        admitted = []
+        with pytest.raises(ServerOverloadedError) as ei:
+            for _ in range(10):
+                admitted.append(
+                    srv.batcher.submit(
+                        PendingQuery("d", "op", ds=ds, run=lambda: 1)
+                    )
+                )
+        assert ei.value.retry_after == 0.25
+        assert len(admitted) == 2  # max_queue_depth
+    finally:
+        b.done()
+        for item in admitted:
+            item.wait()
+    srv.collect_metrics()
+    assert o.metrics.counter("serve.load_shed").value >= 1
+    assert "shed" in [e.action for e in o.events.of_kind("fault")]
+
+
+def test_supervisor_restarts_after_dispatch_crash(served):
+    srv, o = served
+    plan = faults.FaultPlan((faults.FaultSpec("serve.dispatch", 0, "raise"),))
+    with faults.inject(plan):
+        with pytest.raises(DispatchCrashedError):
+            srv.kselect("d", 5, tier="exact")
+    # the loop restarted in place: later queries answer normally
+    ans = srv.kselect("d", 5, tier="exact")
+    assert int(ans.value) == 4
+    assert srv.batcher.restarts == 1
+    srv.collect_metrics()
+    assert o.metrics.counter("serve.dispatch_restarts").value == 1
+    assert "restart" in [e.action for e in o.events.of_kind("fault")]
+
+
+def test_graceful_drain_on_close():
+    srv = KSelectServer()
+    srv.add_dataset("d", np.arange(128, dtype=np.int32))
+    results = []
+    ds = srv.registry.get("d")
+    pendings = [
+        srv.batcher.submit(
+            PendingQuery("d", "op", ds=ds, run=lambda i=i: results.append(i))
+        )
+        for i in range(8)
+    ]
+    srv.close()  # drain: queued work finishes before the join
+    for p in pendings:
+        p.wait()
+    assert sorted(results) == list(range(8))
+
+
+def test_http_deadline_and_shed_mapping(served):
+    srv, o = served
+    with start_http_server(srv) as h:
+        url = f"http://127.0.0.1:{h.port}/v1/query"
+
+        def post(body):
+            req = urllib.request.Request(
+                url, data=json.dumps(body).encode(), method="POST"
+            )
+            return urllib.request.urlopen(req, timeout=10)
+
+        # normal query with a generous deadline
+        r = post({"dataset": "d", "op": "kselect", "k": 3, "deadline_ms": 60000})
+        assert r.status == 200
+        assert json.loads(r.read())["answers"][0]["value"] == 2
+        # bad deadlines -> 400 (incl. the non-finite values stdlib json
+        # happily parses, and bools float() would accept as 1.0 ms)
+        for bad in (-5, float("nan"), float("inf"), True):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                post(
+                    {"dataset": "d", "op": "kselect", "k": 3, "deadline_ms": bad}
+                )
+            assert ei.value.code == 400, bad
+        # expired deadline -> 504 (block the dispatcher so it cannot win)
+        b = _Blocker(srv)
+        b.entered.wait(5.0)
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                post(
+                    {
+                        "dataset": "d",
+                        "op": "kselect",
+                        "k": 3,
+                        "tier": "exact",
+                        "deadline_ms": 30,
+                    }
+                )
+            assert ei.value.code == 504
+            # overload -> 503 with Retry-After: fill the queue to its
+            # bound (the expired query above may still occupy a slot)
+            ds = srv.registry.get("d")
+            for _ in range(2):
+                try:
+                    srv.batcher.submit(
+                        PendingQuery("d", "op", ds=ds, run=lambda: 1)
+                    )
+                except ServerOverloadedError:
+                    break
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                post({"dataset": "d", "op": "kselect", "k": 3, "tier": "exact"})
+            assert ei.value.code == 503
+            assert ei.value.headers.get("Retry-After") == "1"
+        finally:
+            b.done()
+
+
+# ---------------------------------------------------------------------------
+# CLI --chaos
+
+
+def test_cli_chaos_flag_end_to_end(capsys):
+    from mpi_k_selection_tpu.cli import main
+
+    rc = main(
+        [
+            "--streaming", "--n", "60000", "--chunk-elems", "8000",
+            "--chaos", "2", "--spill", "force", "--verify", "--check",
+            "--json",
+        ]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    rec = json.loads(out[-1])
+    assert rec["extra"]["exact_match"] is True
+    assert rec["extra"]["certificate_ok"] is True
+    assert rec["extra"]["chaos"]["seed"] == 2
+    assert rec["extra"]["chaos"]["plan"]
+
+
+def test_cli_retry_off_parses(capsys):
+    from mpi_k_selection_tpu.cli import main
+
+    rc = main(
+        [
+            "--streaming", "--n", "20000", "--chunk-elems", "5000",
+            "--retry", "off", "--json",
+        ]
+    )
+    assert rc == 0
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["extra"]["retry"] == "off"
