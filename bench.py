@@ -993,6 +993,121 @@ def bench_chaos(on_tpu: bool):
     return ok
 
 
+def bench_monitor(on_tpu: bool):
+    """Continuous windowed quantiles (ISSUE 10, monitor/): the two
+    claims the subsystem makes, measured.
+
+    - **O(1) amortized window advance**: per-epoch cost of
+      (fold one bucket of data, advance, full-window ``query()``) must
+      be FLAT in window length — the two-stack suffix aggregation does
+      ~2 sketch merges per epoch whether the ring holds 8 buckets or
+      256. The gate is ``advance_flat_ratio <= 1.5`` between window=8
+      and window=256 (a from-scratch re-merge would be ~32x).
+    - **Bit-identity of ring re-aggregation**: at several epochs (ring
+      not yet full, just full, wrapped several times) ``query()`` must
+      equal a from-scratch RadixSketch fold of the same live buckets —
+      and the decayed variant's fold must be grouping-invariant.
+      ``exact_match`` requires all of it.
+    """
+    import numpy as np
+
+    from mpi_k_selection_tpu.monitor import (
+        DecayedWindowedSketch,
+        WindowedSketch,
+    )
+    from mpi_k_selection_tpu.streaming.sketch import RadixSketch
+
+    windows = (8, 64, 256)
+    bucket_elems = 1 << 15 if on_tpu else 1 << 13
+    epochs = 640  # >= 2.5 full wraps of the largest ring
+    # 4 bits x 3 levels (~34 KB/bucket): the ring's merge count is the
+    # quantity under test, and the default 4x4 sketch's 0.56 MB buckets
+    # would let LLC pressure (256 live buckets = 143 MB) masquerade as
+    # a merge-count slope
+    skw = dict(radix_bits=4, levels=3)
+    rng = np.random.default_rng(55)
+    data = [
+        rng.integers(-(2**31), 2**31 - 1, size=bucket_elems, dtype=np.int32)
+        for _ in range(8)
+    ]  # 8 distinct buckets cycled — contents must not matter to the cost
+
+    exact = True
+    per_window = {}
+    for w in windows:
+        ws = WindowedSketch(np.int32, window=w, **skw)
+        # warm allocations / first-touch
+        for e in range(4):
+            ws.update(data[e % len(data)])
+            ws.query()
+            ws.advance()
+        ws = WindowedSketch(np.int32, window=w, **skw)
+        check_epochs = {0, w - 1, w, 2 * w + 3, epochs - 1}
+        t0 = time.perf_counter()
+        for e in range(epochs):
+            c = data[e % len(data)]
+            ws.update(c)
+            m = ws.query()
+            if e in check_epochs:
+                # from-scratch merge of the same live buckets — any
+                # grouping must be bitwise identical (pause the clock:
+                # the oracle fold is O(window), the thing under test is
+                # not allowed to be)
+                t_pause = time.perf_counter()
+                scratch = RadixSketch(np.int32, **skw)
+                for b in ws.live_buckets():
+                    scratch.fold_scaled(b, 1)
+                exact = exact and (m == scratch)
+                t0 += time.perf_counter() - t_pause
+            ws.advance()
+        per_window[w] = (time.perf_counter() - t0) / epochs
+    flat_ratio = per_window[windows[-1]] / per_window[windows[0]]
+
+    # decayed leg: fold-order invariance + the degenerate identity
+    dws = DecayedWindowedSketch(np.int32, window=8, decay=0.5)
+    base = WindowedSketch(np.int32, window=8)
+    for e in range(12):
+        dws.update(data[e % len(data)])
+        base.update(data[e % len(data)])
+        if e < 11:
+            dws.advance()
+            base.advance()
+    md = dws.query()
+    fwd = dws.query()  # two independent folds, same buckets/ages
+    exact = exact and (md == fwd)
+    d1 = DecayedWindowedSketch(np.int32, window=8, decay=1.0)
+    for e in range(12):
+        d1.update(data[e % len(data)])
+        if e < 11:
+            d1.advance()
+    m1, mb = d1.query(), base.query()
+    exact = exact and m1.quantiles([0.5, 0.9, 0.99]) == mb.quantiles(
+        [0.5, 0.9, 0.99]
+    )
+
+    gate = 1.5
+    ok = exact and flat_ratio <= gate
+    _emit(
+        {
+            "metric": "monitor_window_advance",
+            # headline: monitored elements per second at the largest ring
+            "value": (
+                round(bucket_elems / per_window[windows[-1]], 1) if exact else 0.0
+            ),
+            "unit": "elems/sec",
+            "bucket_elems": bucket_elems,
+            "epochs": epochs,
+            "seconds_per_advance": {
+                str(w): round(s, 7) for w, s in per_window.items()
+            },
+            "advance_flat_ratio": round(flat_ratio, 4),
+            "advance_flat_gate": gate,
+            "decayed_fold_invariant": bool(md == fwd),
+            "exact_match": bool(exact),
+        }
+    )
+    return ok
+
+
 def bench_cgm_native():
     """BASELINE config: CGM/MPI parity backend, 4 ranks, N=16M, k=N/2.
 
@@ -1082,6 +1197,7 @@ def main() -> int:
     ok &= bench_streaming_oc(on_tpu)
     ok &= bench_serve(on_tpu)
     ok &= bench_chaos(on_tpu)
+    ok &= bench_monitor(on_tpu)
     ok &= bench_cgm_native()
     ok &= bench_seq_oracle()
     return 0 if ok else 1

@@ -55,6 +55,7 @@ from mpi_k_selection_tpu.parallel import (
 from mpi_k_selection_tpu.obs import Observability
 from mpi_k_selection_tpu.serve import KSelectServer
 from mpi_k_selection_tpu.streaming import RadixSketch
+from mpi_k_selection_tpu.monitor import Monitor, WindowedSketch
 
 __all__ = [
     "__version__",
@@ -64,6 +65,8 @@ __all__ = [
     "kselect_streaming",
     "StreamingQuantiles",
     "RadixSketch",
+    "WindowedSketch",
+    "Monitor",
     "KSelectServer",
     "Observability",
     "quantiles",

@@ -1,4 +1,4 @@
-"""AST lint rules (KSL001-KSL012) — each encodes a bug class a human
+"""AST lint rules (KSL001-KSL013) — each encodes a bug class a human
 reviewer caught in this repository at least once. docs/ANALYSIS.md holds
 the catalog with the historical incident behind every rule.
 
@@ -877,3 +877,104 @@ class SilentSwallowOrRawSleep(Rule):
                 "and emit a FaultEvent — never drop a failure on the "
                 "floor (faults/, docs/ROBUSTNESS.md)"
             )
+
+
+# ---------------------------------------------------------------------------
+# KSL013 — unbounded metric label cardinality
+
+
+@register
+class UnboundedMetricLabels(Rule):
+    id = "KSL013"
+    title = (
+        "metric labels= value derived from a loop variable "
+        "(per-chunk/per-request cardinality)"
+    )
+    rationale = (
+        "A metrics-registry label whose VALUE comes from a loop variable "
+        "— a chunk index, a request id, a raw observation — mints one "
+        "fresh (name, labels) series per iteration: the registry (and "
+        "any Prometheus server scraping it) grows without bound, "
+        "exposition cost grows with it, and per-series aggregates "
+        "become meaningless (every series holds one point). Labels must "
+        "partition over CLOSED sets (a device slot, a tier, a phase, a "
+        "quantile); unbounded dimensions belong in the metric VALUE "
+        "(a counter/histogram observation) or the event stream "
+        "(obs/events.py), which is built for per-occurrence records. "
+        "Bounded-in-practice loop sources (PhaseTimer phase names) "
+        "carry a written noqa in the ledger."
+    )
+
+    _METRIC_METHODS = {"counter", "gauge", "histogram"}
+
+    def _loop_targets(self, node) -> set[str]:
+        """Names bound by a for-loop target or comprehension generator."""
+        names: set[str] = set()
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            for sub in ast.walk(node.target):
+                if isinstance(sub, ast.Name):
+                    names.add(sub.id)
+        elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                               ast.GeneratorExp)):
+            for gen in node.generators:
+                for sub in ast.walk(gen.target):
+                    if isinstance(sub, ast.Name):
+                        names.add(sub.id)
+        return names
+
+    def _label_value_exprs(self, call: ast.Call):
+        """The expressions that become label VALUES: the values of a
+        ``labels={...}`` dict literal. Non-literal labels arguments (a
+        name built elsewhere) are out of scope — tracing them needs
+        dataflow this rule does not attempt."""
+        for kw in call.keywords:
+            if kw.arg == "labels" and isinstance(kw.value, ast.Dict):
+                yield from kw.value.values
+
+    def _walk(self, node, loop_names: set[str]):
+        """Recursive walk tracking which names are loop-bound at each
+        point. Function/lambda boundaries RESET the set (a parameter is
+        the caller's choice, not an iteration — `phase=` style labels
+        stay legal); for-loops and comprehension generators extend it
+        for everything they enclose."""
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            loop_names = set()
+        else:
+            targets = self._loop_targets(node)
+            if targets:
+                loop_names = loop_names | targets
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in self._METRIC_METHODS
+        ):
+            for vexpr in self._label_value_exprs(node):
+                hit = sorted(
+                    {
+                        n.id
+                        for n in ast.walk(vexpr)
+                        if isinstance(n, ast.Name) and n.id in loop_names
+                    }
+                )
+                if hit:
+                    yield node.lineno, (
+                        f"metric label value derived from loop "
+                        f"variable(s) {', '.join(hit)} — one fresh "
+                        "series per iteration is unbounded label "
+                        "cardinality; partition labels over a closed "
+                        "set and put per-occurrence data in the "
+                        "metric value or the obs event stream"
+                    )
+        for child in ast.iter_child_nodes(node):
+            yield from self._walk(child, loop_names)
+
+    def check_module(self, mod: SourceModule):
+        p = pathlib.Path(mod.path).resolve().as_posix()
+        if "/mpi_k_selection_tpu/" not in p or _is_test_file(mod):
+            return
+        seen: set[tuple[int, str]] = set()
+        for lineno, msg in self._walk(mod.tree, set()):
+            key = (lineno, msg)
+            if key not in seen:
+                seen.add(key)
+                yield lineno, msg

@@ -24,6 +24,11 @@ Examples::
     # resident-dataset query server: load once, answer many clients
     # (POST /v1/query, GET /metrics; see docs/API.md "Serving")
     kselect serve --n 100000000 --dtype int32 --port 8080
+
+    # continuous telemetry quantiles over an unbounded stream: one
+    # exactly-bounded p50/p90/p99 sample per window advance
+    # (docs/OBSERVABILITY.md "Continuous monitoring")
+    kselect monitor --window 32 --emit-every 4 --buckets 100 --json
 """
 
 from __future__ import annotations
@@ -654,6 +659,21 @@ def build_serve_parser() -> argparse.ArgumentParser:
         help="serve N HTTP requests, then exit cleanly (smoke/testing; "
         "default: serve until interrupted)",
     )
+    p.add_argument(
+        "--latency-windows", type=int, default=0, metavar="BUCKETS",
+        help="back the per-tier serve.latency_seconds histograms with a "
+        "BUCKETS-deep sliding-window RadixSketch, so /metrics p50/p90/"
+        "p99 become windowed quantiles with EXACT rank/value bounds "
+        "(gauge series ksel_serve_latency_seconds_windowed{tier,"
+        "quantile}) instead of fixed-bucket interpolation (0 = off, the "
+        "default; see docs/OBSERVABILITY.md 'Continuous monitoring')",
+    )
+    p.add_argument(
+        "--latency-advance-every", type=int, default=256, metavar="OBS",
+        help="observations per latency window bucket (with "
+        "--latency-windows; the window advances on observation counts, "
+        "never clocks)",
+    )
     return p
 
 
@@ -668,9 +688,18 @@ def serve_main(argv=None) -> int:
 
     x64_needed = args.dtype in ("int64", "float64")
     obs = obs_lib.Observability(metrics=obs_lib.MetricsRegistry())
+    latency_windows = (
+        dict(
+            window=args.latency_windows,
+            advance_every=args.latency_advance_every,
+        )
+        if args.latency_windows
+        else None
+    )
     with maybe_x64(x64_needed):
         server = KSelectServer(
-            window=args.batch_window, max_batch=args.max_batch, obs=obs
+            window=args.batch_window, max_batch=args.max_batch, obs=obs,
+            latency_windows=latency_windows,
         )
         try:
             if args.streaming:
@@ -724,6 +753,174 @@ def serve_main(argv=None) -> int:
     return 0
 
 
+def build_monitor_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="kselect monitor",
+        description=(
+            "continuous telemetry quantiles over an unbounded stream "
+            "(mpi_k_selection_tpu/monitor/): a sliding ring of per-time-"
+            "bucket RadixSketches — O(1) amortized window advance — "
+            "emitting one multirank_p50_p90_p99 sample per advance, "
+            "every value carrying EXACT rank/value bounds; --decay "
+            "switches to the fixed-point exponential-decay aggregate"
+        ),
+    )
+    p.add_argument(
+        "--chunk-elems", type=int, default=1 << 16,
+        help="elements per stream chunk (one chunk = one monitor tick)",
+    )
+    p.add_argument("--gen", choices=datagen.PATTERNS, default="uniform")
+    p.add_argument("--dtype", choices=DTYPES, default="int32")
+    p.add_argument("--seed", type=int, default=config.DEFAULT_SEED)
+    p.add_argument(
+        "--drift", type=float, default=0.0,
+        help="per-chunk additive location drift of the synthetic stream "
+        "(chunk i is shifted by round(drift * i)) — the windowed "
+        "quantiles visibly track it",
+    )
+    p.add_argument(
+        "--window", type=int, default=32,
+        help="ring length in time buckets (the open bucket included)",
+    )
+    p.add_argument(
+        "--emit-every", type=int, default=1, metavar="CHUNKS",
+        help="chunks per time bucket: the window advances and one "
+        "sample is emitted every this many chunks",
+    )
+    p.add_argument(
+        "--decay", type=float, default=None,
+        help="exponential decay per window advance, in (0, 1] "
+        "(fixed-point count scaling, monitor/decay.py; decay=1.0 is "
+        "bit-identical to the undecayed window; default: exact "
+        "sliding window)",
+    )
+    p.add_argument(
+        "--quantiles", default="0.5,0.9,0.99",
+        help="comma-separated quantiles of the emitted stream "
+        "(default p50/p90/p99)",
+    )
+    p.add_argument(
+        "--buckets", type=int, default=None, metavar="N",
+        help="stop after N emitted samples (default: run until "
+        "interrupted — the stream is unbounded)",
+    )
+    p.add_argument("--sketch-bits", type=int, default=4)
+    p.add_argument("--sketch-levels", type=int, default=4)
+    p.add_argument(
+        "--pipeline-depth", type=int, default=None,
+        help="ingest pipelining, as in --streaming (0 = synchronous; "
+        "answers bit-identical at every depth)",
+    )
+    p.add_argument(
+        "--devices", type=int, default=None,
+        help="round-robin staged ingest across this many chips, as in "
+        "--streaming (bit-identical at every count)",
+    )
+    p.add_argument(
+        "--metrics-json", default=None, metavar="PATH",
+        help="write the monitor's obs metrics registry (monitor.quantile"
+        "{q=} gauges, ingest counters, phase seconds) as JSON to PATH "
+        "at exit",
+    )
+    p.add_argument(
+        "--prometheus-port", type=int, default=None, metavar="PORT",
+        help="serve the registry's Prometheus text exposition on PORT "
+        "(GET /metrics; 0 = ephemeral — see --port-file) for the whole "
+        "run",
+    )
+    p.add_argument(
+        "--port-file", default=None, metavar="PATH",
+        help="write the bound Prometheus port here (for "
+        "--prometheus-port 0 callers)",
+    )
+    p.add_argument(
+        "--json", action="store_true",
+        help="emit one JSON object per sample (JSONL) instead of the "
+        "human-readable line",
+    )
+    return p
+
+
+def monitor_main(argv=None) -> int:
+    """``kselect monitor ...`` — run the continuous quantile monitor
+    over a synthetic (optionally drifting) chunk stream, one sample line
+    per window advance, until the stream ends (``--buckets``) or the
+    user interrupts. Exit 0 on clean shutdown (Ctrl-C included)."""
+    import json as _json
+
+    args = build_monitor_parser().parse_args(argv)
+    if args.chunk_elems < 1:
+        raise SystemExit("error: --chunk-elems must be >= 1")
+    try:
+        qs = [float(s) for s in args.quantiles.split(",") if s.strip()]
+    except ValueError as e:
+        raise SystemExit(f"error: bad --quantiles value: {e}") from e
+    from mpi_k_selection_tpu import obs as obs_lib
+    from mpi_k_selection_tpu.monitor import Monitor, start_metrics_server
+
+    dtype = np.dtype(args.dtype)
+    max_chunks = (
+        None if args.buckets is None else args.buckets * args.emit_every
+    )
+
+    def source():
+        i = 0
+        while max_chunks is None or i < max_chunks:
+            c = datagen.generate(
+                args.chunk_elems, pattern=args.gen, seed=args.seed + i,
+                dtype=args.dtype,
+            )
+            if args.drift:
+                off = args.drift * i
+                if np.issubdtype(dtype, np.integer):
+                    off = int(round(off))
+                c = (c + dtype.type(off)).astype(dtype, copy=False)
+            yield c
+            i += 1
+
+    obs = None
+    if args.metrics_json or args.prometheus_port is not None:
+        obs = obs_lib.Observability(metrics=obs_lib.MetricsRegistry())
+    x64_needed = args.dtype in ("int64", "float64")
+    exporter = None
+    try:
+        with maybe_x64(x64_needed):
+            mon = Monitor(
+                qs=qs, window=args.window, emit_every=args.emit_every,
+                decay=args.decay, radix_bits=args.sketch_bits,
+                levels=args.sketch_levels,
+                pipeline_depth=args.pipeline_depth, devices=args.devices,
+                obs=obs,
+            )
+            if args.prometheus_port is not None:
+                exporter = start_metrics_server(
+                    obs.metrics, port=args.prometheus_port
+                )
+                if args.port_file:
+                    with open(args.port_file, "w") as f:
+                        f.write(str(exporter.port))
+            try:
+                for s in mon.run(
+                    source(), dtype, max_samples=args.buckets
+                ):
+                    line = (
+                        _json.dumps(s.as_dict()) if args.json
+                        else s.format_line()
+                    )
+                    print(line, flush=True)
+            except KeyboardInterrupt:
+                pass
+    except (ValueError, RuntimeError, TypeError) as e:
+        raise SystemExit(f"error: {e}") from e
+    finally:
+        if exporter is not None:
+            exporter.close()
+        if obs is not None and args.metrics_json:
+            with open(args.metrics_json, "w") as f:
+                f.write(obs.metrics.to_json(indent=2))
+    return 0
+
+
 def main(argv=None) -> int:
     # Honor JAX_PLATFORMS even on hosts whose site customization pins
     # jax_platforms at interpreter startup (config wins over the env var):
@@ -753,6 +950,9 @@ def main(argv=None) -> int:
     if argv and argv[0] == "serve":
         # subcommand: the long-lived query server (serve/), its own parser
         return serve_main(argv[1:])
+    if argv and argv[0] == "monitor":
+        # subcommand: continuous telemetry quantiles (monitor/)
+        return monitor_main(argv[1:])
     args = build_parser().parse_args(argv)
     if args.batch and args.topk is None:
         raise SystemExit("error: --batch only applies to --topk mode")

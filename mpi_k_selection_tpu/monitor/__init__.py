@@ -1,0 +1,58 @@
+"""Continuous telemetry quantiles — sliding-window & decayed sketch
+monitoring over unbounded streams.
+
+The reference's second operating point already ran exact medians
+(``k = N/2`` in the ``.c~`` backups) — quantiles, not just top-k, were
+always the workload. This package turns the repo's mergeable
+:class:`~mpi_k_selection_tpu.streaming.sketch.RadixSketch` (exact
+rank/value bounds, associative+commutative merge) into a *continuous*
+monitoring surface: p50/p90/p99 — any rank set — over a stream that
+never ends, with every answer still carrying the sketch's exact bounds.
+
+- :mod:`windows` — :class:`WindowedSketch`: a ring of per-time-bucket
+  sketches whose two-stack (subtract-free) suffix aggregation gives O(1)
+  amortized sketch merges per window advance and bit-identical
+  re-aggregation over any suffix of live buckets.
+- :mod:`decay` — :class:`DecayedWindowedSketch` /
+  :class:`DecayedSketch`: the exponential-decay variant. Counts scale by
+  integer fixed-point weights BEFORE the fold, so decayed merges stay
+  associative/commutative and ``decay=1.0`` degenerates bit-identically
+  to the undecayed ring.
+- :mod:`monitor` — :class:`Monitor`: drives any replayable-or-one-shot
+  chunk source through the existing ingest pipeline + async executor
+  (unchanged underneath) and yields a continuous
+  ``multirank_p50_p90_p99`` sample stream.
+
+Surfaced as the CLI ``monitor`` subcommand (``kselect monitor``), the
+windowed-histogram metrics bridge (obs/windows.py — backs
+``serve.latency_seconds{tier}`` with exactly-bounded windowed quantiles
+via ``KSelectServer(latency_windows=...)``), and ``bench.py:
+bench_monitor`` (the O(1)-advance proof). See docs/OBSERVABILITY.md
+"Continuous monitoring".
+"""
+
+from __future__ import annotations
+
+from mpi_k_selection_tpu.monitor.decay import (
+    DECAY_SHIFT,
+    DecayedSketch,
+    DecayedWindowedSketch,
+    decay_weight,
+)
+from mpi_k_selection_tpu.monitor.monitor import (
+    Monitor,
+    MonitorSample,
+    start_metrics_server,
+)
+from mpi_k_selection_tpu.monitor.windows import WindowedSketch
+
+__all__ = [
+    "DECAY_SHIFT",
+    "DecayedSketch",
+    "DecayedWindowedSketch",
+    "Monitor",
+    "MonitorSample",
+    "WindowedSketch",
+    "decay_weight",
+    "start_metrics_server",
+]

@@ -1,0 +1,129 @@
+"""Exponential-decay windowed quantiles — fixed-point count scaling so
+decayed merges stay exact, associative and commutative.
+
+Floating-point decayed counters (``acc = acc * d + x``) are neither
+mergeable nor order-invariant. This variant keeps every guarantee of the
+undecayed ring by scaling counts with INTEGER weights before the fold:
+
+- the weight of a bucket of age ``a`` (buckets before the current one)
+  is ``decay_weight(decay, a) = round(decay**a * 2**DECAY_SHIFT)`` — a
+  fixed-point integer on a ``2**DECAY_SHIFT`` scale, computed ONCE per
+  (decay, age) pair;
+- a decayed aggregate is ``sum_a bucket_a.counts * weight(a)`` — every
+  term an exact int64 product, so ANY grouping or ordering of the folds
+  yields a bitwise-identical accumulator (``RadixSketch.fold_scaled``;
+  associativity/commutativity test-enforced across split points in
+  tests/test_monitor.py);
+- ``decay=1.0`` gives ``weight(a) == 2**DECAY_SHIFT`` exactly for every
+  age, so the decayed aggregate is the undecayed one with every count
+  shifted left by ``DECAY_SHIFT`` — rank queries resolve the SAME bucket
+  (``ceil(ceil(q*n*S)/S) == ceil(q*n)`` for any integer scale ``S``),
+  i.e. the degenerate case is bit-identical to the undecayed sketch's
+  answers (test-enforced).
+
+Width contract (the host int64 accumulator discipline, KSC102): scaled
+counts live in the same int64 pyramid, so the window's total UNWEIGHTED
+count must stay below ``2**(63 - DECAY_SHIFT)`` (~2^43 at the default
+shift of 20) — ``fold_scaled`` refuses loudly past it. Buckets whose
+weight rounds to 0 (age beyond ~``log(2**-DECAY_SHIFT)/log(decay)``)
+contribute nothing and are skipped — exponential decay's natural
+horizon.
+"""
+
+from __future__ import annotations
+
+from mpi_k_selection_tpu.monitor.windows import WindowedSketch
+from mpi_k_selection_tpu.streaming.sketch import RadixSketch
+
+#: Fixed-point scale of the decay weights: weight(age) is an integer on
+#: a 2**DECAY_SHIFT scale. 20 bits leaves 2**43 unweighted counts of
+#: int64 headroom per window — far beyond any telemetry window.
+DECAY_SHIFT = 20
+
+
+def decay_weight(decay: float, age: int, *, shift: int = DECAY_SHIFT) -> int:
+    """Fixed-point weight of a bucket ``age`` advances old:
+    ``round(decay**age * 2**shift)``. ``decay=1.0`` returns exactly
+    ``2**shift`` for every age; weights reaching 0 mean the bucket has
+    fully decayed out."""
+    decay = float(decay)
+    if not 0.0 < decay <= 1.0:
+        raise ValueError(f"decay must be in (0, 1], got {decay}")
+    age = int(age)
+    if age < 0:
+        raise ValueError(f"bucket age must be >= 0, got {age}")
+    return int(round(decay**age * (1 << shift)))
+
+
+class DecayedSketch(RadixSketch):
+    """A decay-weighted RadixSketch: the same pyramid, extremes and query
+    machinery, with every count on the ``2**shift`` fixed-point scale
+    (``n`` is the total WEIGHTED count). Rank arguments to ``query`` /
+    ``rank_bounds`` / ``value_bounds`` / ``pin`` are weighted ranks in
+    ``[1, n]``; ``quantile``/``quantiles`` already convert through
+    nearest-rank on ``n``, so they need no caller-side scaling. Exactness
+    is preserved: ``rank_bounds`` are true WEIGHTED ranks of the resolved
+    interval boundaries, and ``value_bounds`` brackets the true weighted
+    order statistic."""
+
+    def __init__(self, dtype, *, radix_bits: int = 4, levels: int = 4,
+                 decay: float = 1.0, shift: int = DECAY_SHIFT):
+        super().__init__(dtype, radix_bits=radix_bits, levels=levels)
+        self.decay = float(decay)
+        self.shift = int(shift)
+        #: the fixed-point scale every count is multiplied by at age 0
+        self.scale = 1 << self.shift
+
+    @property
+    def weighted_n(self) -> int:
+        """Alias for ``n`` making the scale explicit at call sites."""
+        return self.n
+
+    def fold_bucket(self, bucket: RadixSketch, age: int) -> "DecayedSketch":
+        """Count-scaled fold of one time bucket at ``age`` advances old
+        (weight ``decay_weight(self.decay, age, shift=self.shift)``;
+        zero-weight buckets are skipped). Returns ``self``."""
+        self.fold_scaled(
+            bucket, decay_weight(self.decay, age, shift=self.shift)
+        )
+        return self
+
+
+class DecayedWindowedSketch(WindowedSketch):
+    """The exponential-decay sliding window: the same bucket ring and
+    O(1) advance as :class:`WindowedSketch` (advance never touches
+    weights — ages are assigned at QUERY time, newest bucket age 0), with
+    ``query`` returning a :class:`DecayedSketch` whose counts are the
+    live buckets' scaled by their age weights. Cached suffix aggregates
+    cannot serve decayed queries (weights change every advance), so the
+    ring skips aggregate maintenance entirely
+    (``_maintain_aggregates``) and a decayed query folds its O(window)
+    raw buckets — the window advance itself stays O(1): a ring append
+    and at most one eviction."""
+
+    _maintain_aggregates = False
+
+    def __init__(self, dtype, *, window: int, decay: float,
+                 radix_bits: int = 4, levels: int = 4,
+                 shift: int = DECAY_SHIFT):
+        super().__init__(dtype, window=window, radix_bits=radix_bits,
+                         levels=levels)
+        self.decay = float(decay)
+        self.shift = int(shift)
+        decay_weight(self.decay, 0, shift=self.shift)  # validates decay
+
+    def query(self, window: int | None = None) -> DecayedSketch:
+        """Decay-weighted merge of the newest ``window`` live buckets:
+        ``sum_a bucket_a * weight(age a)``, the current bucket at age 0.
+        Bit-identical to folding the same (bucket, age) pairs in any
+        order or grouping (each weight depends only on the bucket's own
+        age)."""
+        w = self._resolve_window(window)
+        out = DecayedSketch(
+            self.dtype, radix_bits=self.radix_bits, levels=self.levels,
+            decay=self.decay, shift=self.shift,
+        )
+        newest_first = list(reversed(self.live_buckets()))[:w]
+        for age, bucket in enumerate(newest_first):
+            out.fold_bucket(bucket, age)
+        return out

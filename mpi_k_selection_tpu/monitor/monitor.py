@@ -1,0 +1,431 @@
+"""Monitor — continuous multirank quantiles over an unbounded chunk
+stream.
+
+The driver consumes any chunk source the streaming subsystem accepts —
+replayable callables, chunk lists, AND bare one-shot iterators (a
+monitor reads its stream exactly once, so one-shot is first-class here)
+— through the SAME ingest machinery as the descent:
+``as_chunk_source`` -> the pipelined ``_key_chunk_stream`` (background
+produce/encode/stage, round-robin ``devices`` staging) -> a
+:class:`~mpi_k_selection_tpu.streaming.executor.StreamExecutor`
+consumer folding each chunk's deepest-level histogram into the open
+window bucket (on the chunk's own device when staged, exactly like
+``RadixSketch.update_stream``). Nothing underneath changed.
+
+Every ``emit_every`` chunks the window advances and one
+:class:`MonitorSample` is yielded: the requested quantiles (default
+p50/p90/p99 — the ``multirank_p50_p90_p99`` stream) over the live
+window, each value carrying the merged sketch's EXACT
+``rank_bounds``/``value_bounds``/``rank_error_bound``. With ``decay``
+set, the sample is the fixed-point decayed aggregate
+(monitor/decay.py). CLI surface: ``kselect monitor`` (cli.py);
+Prometheus surface: :func:`start_metrics_server` (text exposition of
+the obs registry the samples mirror into).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from mpi_k_selection_tpu.monitor.decay import DecayedWindowedSketch
+from mpi_k_selection_tpu.monitor.windows import WindowedSketch
+
+DEFAULT_QS = (0.5, 0.9, 0.99)
+
+#: Thread-name prefix of the metrics exporter (the ``ksel-`` family the
+#: leaked-thread fixture tracks — every thread is joined at close()).
+MONITOR_THREAD_PREFIX = "ksel-monitor"
+
+
+def q_label(q: float) -> str:
+    """Percentile label of a quantile: ``0.5 -> "p50"``,
+    ``0.99 -> "p99"``, ``0.999 -> "p99_9"``."""
+    s = format(float(q) * 100, "g").replace(".", "_")
+    return f"p{s}"
+
+
+def _jsonable(v):
+    item = getattr(v, "item", None)
+    return item() if item is not None else v
+
+
+@dataclasses.dataclass(frozen=True)
+class MonitorSample:
+    """One window advance's quantile readout. ``n`` is the merged
+    window's count — WEIGHTED (on the ``scale`` fixed point) when
+    decayed, raw otherwise; bounds are the sketch's exact guarantees
+    over that count space."""
+
+    epoch: int
+    buckets: int
+    n: int
+    scale: int
+    qs: tuple
+    ranks: tuple
+    values: tuple
+    rank_bounds: tuple
+    value_bounds: tuple
+    rank_error_bounds: tuple
+    chunks: int
+    keys_read: int
+
+    @property
+    def metric_name(self) -> str:
+        """``multirank_p50_p90_p99`` for the default quantile set."""
+        return "multirank_" + "_".join(q_label(q) for q in self.qs)
+
+    def as_dict(self) -> dict:
+        return {
+            "metric": self.metric_name,
+            "epoch": self.epoch,
+            "buckets": self.buckets,
+            "n": int(self.n),
+            "scale": int(self.scale),
+            "qs": [float(q) for q in self.qs],
+            "ranks": [int(k) for k in self.ranks],
+            "values": [_jsonable(v) for v in self.values],
+            "rank_bounds": [[int(a), int(b)] for a, b in self.rank_bounds],
+            "value_bounds": [
+                [_jsonable(a), _jsonable(b)] for a, b in self.value_bounds
+            ],
+            "rank_error_bounds": [int(e) for e in self.rank_error_bounds],
+            "chunks": self.chunks,
+            "keys_read": self.keys_read,
+        }
+
+    def format_line(self) -> str:
+        """One human-readable stream line (the CLI's non-JSON mode)."""
+        parts = [
+            f"{self.metric_name} epoch={self.epoch} buckets={self.buckets} "
+            f"n={self.n}"
+        ]
+        for q, v, (vlo, vhi), err in zip(
+            self.qs, self.values, self.value_bounds, self.rank_error_bounds
+        ):
+            parts.append(
+                f"{q_label(q)}={_jsonable(v)} in [{_jsonable(vlo)}, "
+                f"{_jsonable(vhi)}] rank_err<={err}"
+            )
+        return "  ".join(parts)
+
+
+class _BucketFoldConsumer:
+    """StreamExecutor consumer folding chunks into the window's OPEN
+    bucket: staged chunks dispatch their deepest-level histogram +
+    extremes on their own device (``RadixSketch._dispatch_staged``) and
+    fold at FIFO-pop time INTO THE BUCKET THAT DISPATCHED THEM (the
+    handle pins it, and the Monitor drains the window before every
+    advance, so a bucket boundary can never split a dispatch/fold
+    pair); host/device-resident chunks fold inline."""
+
+    def __init__(self, ws: WindowedSketch):
+        self._ws = ws
+        self.staged_chunks = 0
+
+    def dispatch(self, keys, kv):
+        from mpi_k_selection_tpu.streaming import pipeline as _pl
+
+        cur = self._ws.current
+        if isinstance(keys, _pl.StagedKeys):
+            self.staged_chunks += 1
+            return cur, cur._dispatch_staged(keys)
+        if not isinstance(kv, np.ndarray):
+            kv = np.asarray(kv)
+        cur._update_keys(kv)
+        return None
+
+    def finish(self, handle) -> None:
+        cur, h = handle
+        cur._fold_staged(h)
+
+
+class Monitor:
+    """Continuous quantile monitoring over an unbounded stream.
+
+    Configuration: ``qs`` (any rank set — the default is the
+    p50/p90/p99 triple), ``window`` (ring length, buckets),
+    ``emit_every`` (chunks per bucket: the window advances and a sample
+    is emitted every that many chunks), ``decay`` (None = the exact
+    sliding window; a float in (0, 1] = the fixed-point exponential
+    decay of monitor/decay.py), plus the streaming ingest knobs
+    (``pipeline_depth``, ``devices``) and ``obs``. Answers are
+    bit-identical at every depth/devices combination (the same contract
+    as ``RadixSketch.update_stream``); ``obs`` mirrors each sample into
+    ``monitor.quantile{q=}`` gauges and never changes a count bit."""
+
+    def __init__(
+        self, *, qs=DEFAULT_QS, window: int = 32, emit_every: int = 1,
+        decay: float | None = None, radix_bits: int = 4, levels: int = 4,
+        pipeline_depth=None, devices=None, obs=None,
+    ):
+        self.qs = tuple(float(q) for q in qs)
+        if not self.qs:
+            raise ValueError("monitor needs at least one quantile")
+        self.window = int(window)
+        self.emit_every = int(emit_every)
+        if self.emit_every < 1:
+            raise ValueError(f"emit_every must be >= 1, got {emit_every}")
+        self.decay = None if decay is None else float(decay)
+        self.radix_bits = int(radix_bits)
+        self.levels = int(levels)
+        self.pipeline_depth = pipeline_depth
+        self.devices = devices
+        self.obs = obs
+        # label dicts built once: the metric label set is the monitor's
+        # fixed configuration, not per-sample data (KSL013's class)
+        self._q_labels = tuple({"q": q_label(q)} for q in self.qs)
+        self.ws: WindowedSketch | None = None
+
+    def _make_window(self, dtype) -> WindowedSketch:
+        if self.decay is None:
+            return WindowedSketch(
+                dtype, window=self.window, radix_bits=self.radix_bits,
+                levels=self.levels,
+            )
+        return DecayedWindowedSketch(
+            dtype, window=self.window, decay=self.decay,
+            radix_bits=self.radix_bits, levels=self.levels,
+        )
+
+    def sample(self, chunks: int = 0, keys_read: int = 0) -> MonitorSample | None:
+        """One readout of the CURRENT window state (None while empty) —
+        the per-advance emission, also callable standalone."""
+        ws = self.ws
+        if ws is None:
+            return None
+        m = ws.query()
+        if m.n == 0:
+            return None
+        from mpi_k_selection_tpu.api import quantile_ranks
+
+        ranks = quantile_ranks(self.qs, m.n)
+        values, rbounds, vbounds, rerrs = [], [], [], []
+        for k in ranks:
+            lo, hi = m.rank_bounds(k)
+            vlo, vhi = m.value_bounds(k)
+            values.append(m.query(k))
+            rbounds.append((lo, hi))
+            vbounds.append((vlo, vhi))
+            rerrs.append(hi - lo)
+        out = MonitorSample(
+            epoch=ws.epoch,
+            buckets=ws.n_live,
+            n=m.n,
+            scale=getattr(m, "scale", 1),
+            qs=self.qs,
+            ranks=tuple(int(k) for k in ranks),
+            values=tuple(values),
+            rank_bounds=tuple(rbounds),
+            value_bounds=tuple(vbounds),
+            rank_error_bounds=tuple(rerrs),
+            chunks=chunks,
+            keys_read=keys_read,
+        )
+        if self.obs is not None and self.obs.metrics is not None:
+            reg = self.obs.metrics
+            for lab, v in zip(self._q_labels, values):
+                reg.gauge("monitor.quantile", labels=lab).set(_jsonable(v))
+            reg.gauge("monitor.window_n").set(int(m.n))
+            reg.gauge("monitor.epoch").set(int(ws.epoch))
+            reg.counter("monitor.samples").inc()
+        return out
+
+    def run(self, source, dtype=None, *, max_samples=None, timer=None):
+        """Generator of :class:`MonitorSample`s — one per window advance
+        (plus a final partial-bucket sample at stream end), until the
+        source exhausts or ``max_samples`` is reached. ``dtype`` is the
+        stream dtype (inferred from a list/array source; required for
+        generators/callables — a monitor never replays, so it cannot
+        probe). The ingest pipeline is torn down on EVERY exit path,
+        including an abandoned generator."""
+        from mpi_k_selection_tpu.obs import wiring as _wr
+        from mpi_k_selection_tpu.streaming import executor as _exec
+        from mpi_k_selection_tpu.streaming import pipeline as _pl
+        from mpi_k_selection_tpu.streaming.chunked import (
+            _key_chunk_stream,
+            as_chunk_source,
+        )
+        from mpi_k_selection_tpu.utils import dtypes as _dt
+
+        if dtype is None:
+            if isinstance(source, (list, tuple)) and len(source):
+                dtype = np.asarray(source[0]).dtype
+            elif isinstance(source, np.ndarray):
+                dtype = source.dtype
+            else:
+                raise TypeError(
+                    "pass dtype= for generator/callable sources: the "
+                    "monitor folds chunks as they arrive and cannot "
+                    "replay the stream to probe its dtype"
+                )
+        dtype = np.dtype(dtype)
+        kdt = np.dtype(_dt.key_dtype(dtype))
+        depth = _pl.validate_pipeline_depth(self.pipeline_depth)
+        devs = _pl.resolve_stream_devices(self.devices)
+        multi = len(devs) > 1 and depth > 0
+        self.ws = self._make_window(dtype)
+        src = as_chunk_source(source, one_shot_ok=True)
+        timer, _restore = _wr.attach_timer(self.obs, timer)
+        consumer = _BucketFoldConsumer(self.ws)
+        ex = _exec.StreamExecutor(
+            [consumer], window=len(devs),
+            occupancy=_wr.window_occupancy(self.obs, phase="monitor"),
+        )
+        chunk_i = keys_read = emitted = in_bucket = 0
+        keys = None
+        try:
+            with _pl._phase(timer, "monitor.pass"), _key_chunk_stream(
+                src, dtype, pipeline_depth=depth, timer=timer,
+                hist_method="scatter" if multi else None,
+                devices=devs if multi else None,
+            ) as kc:
+                for keys, _ in kc:
+                    if self.obs is not None:
+                        _wr.chunk_event(
+                            self.obs, "monitor", chunk_i, keys, kdt, devs
+                        )
+                    chunk_i += 1
+                    keys_read += int(keys.size)
+                    in_bucket += 1
+                    ex.push(keys)
+                    if in_bucket >= self.emit_every:
+                        ex.drain()
+                        s = self.sample(chunk_i, keys_read)
+                        if s is not None:
+                            emitted += 1
+                            yield s
+                        self.ws.advance()
+                        in_bucket = 0
+                        if max_samples is not None and emitted >= max_samples:
+                            break
+                else:
+                    ex.drain()
+                    if in_bucket:
+                        s = self.sample(chunk_i, keys_read)
+                        if s is not None:
+                            yield s
+        except BaseException:
+            ex.abort()
+            _exec.release_staged(keys)  # the chunk in hand (idempotent)
+            raise
+        finally:
+            _restore()
+        if self.obs is not None and self.obs.metrics is not None:
+            from mpi_k_selection_tpu.obs.metrics import collect_runtime
+
+            collect_runtime(
+                self.obs.metrics, staging_pool=_pl.STAGING_POOL, timer=timer
+            )
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition on a port (the CLI monitor's pull surface)
+
+
+class _MetricsHandler(BaseHTTPRequestHandler):
+    server_version = "ksel-monitor"
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, format, *args):  # noqa: A002 - stdlib signature
+        pass  # the registry IS the telemetry channel; no stderr chatter
+
+    def do_GET(self):
+        if self.path == "/metrics":
+            body = self.server.registry.render_prometheus().encode()
+            ctype = "text/plain; version=0.0.4; charset=utf-8"
+        elif self.path == "/healthz":
+            body = b'{"status": "ok"}'
+            ctype = "application/json"
+        else:
+            body = b"not found; GET /metrics or /healthz"
+            self.send_response(404)
+            self.send_header("Content-Type", "text/plain")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+class MetricsHTTPServer(ThreadingHTTPServer):
+    """Prometheus exposition for a MetricsRegistry: GET /metrics renders
+    the registry live. Request threads are named + tracked + joined
+    (``ksel-monitor-req-*``; the accept loop runs on
+    ``ksel-monitor-http-*``) — the same no-thread-outlives-its-owner
+    discipline as serve/http.py, conftest-enforced."""
+
+    daemon_threads = False
+    allow_reuse_address = True
+
+    _ids = itertools.count()
+
+    def __init__(self, address, registry):
+        super().__init__(address, _MetricsHandler)
+        self.registry = registry
+        self._req_lock = threading.Lock()
+        self._req_threads: list[threading.Thread] = []
+        self._serve_thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self.server_address[1]
+
+    def process_request(self, request, client_address):
+        t = threading.Thread(
+            target=self.process_request_thread,
+            args=(request, client_address),
+            name=f"{MONITOR_THREAD_PREFIX}-req-{next(self._ids)}",
+            daemon=False,
+        )
+        with self._req_lock:
+            self._req_threads = [x for x in self._req_threads if x.is_alive()]
+            self._req_threads.append(t)
+        t.start()
+
+    def server_close(self):
+        super().server_close()
+        with self._req_lock:
+            threads, self._req_threads = self._req_threads, []
+        for t in threads:
+            t.join(timeout=10.0)
+
+    def close(self):
+        """Stop the accept loop, close the socket, join every thread."""
+        self.shutdown()
+        self.server_close()
+        if self._serve_thread is not None:
+            self._serve_thread.join(timeout=10.0)
+            self._serve_thread = None
+
+    def __enter__(self) -> "MetricsHTTPServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def start_metrics_server(
+    registry, *, host: str = "127.0.0.1", port: int = 0
+) -> MetricsHTTPServer:
+    """Serve ``registry``'s Prometheus text exposition in the background
+    (``port=0`` binds an ephemeral port — read ``handle.port``).
+    ``handle.close()`` tears everything down."""
+    httpd = MetricsHTTPServer((host, port), registry)
+    t = threading.Thread(
+        target=httpd.serve_forever,
+        kwargs={"poll_interval": 0.05},
+        name=f"{MONITOR_THREAD_PREFIX}-http-{next(MetricsHTTPServer._ids)}",
+        daemon=True,
+    )
+    httpd._serve_thread = t
+    t.start()
+    return httpd

@@ -1,0 +1,195 @@
+"""WindowedSketch — a sliding ring of per-time-bucket RadixSketches with
+O(1) amortized window advance.
+
+The core observation: RadixSketch merges are *elementwise int64 sums* —
+associative AND commutative — so a sliding-window aggregate never needs
+subtraction (which histogram counts would support, but min/max extremes
+would not) or a full re-merge of the ring. The classic two-stack queue
+aggregation applies verbatim:
+
+- the **back** half collects freshly closed buckets with one running
+  prefix aggregate (``fold_scaled(bucket, 1)`` per advance — one in-place
+  merge);
+- the **front** half holds older buckets with PRE-COMPUTED suffix
+  aggregates (each entry stores the merge of itself and every younger
+  front bucket), so evicting the oldest bucket is a pop;
+- when the front empties, the back **flips** into it, computing the
+  suffix aggregates in one linear sweep — amortized one merge per
+  advance.
+
+A full-window ``query()`` is then ``front_suffix + back_prefix +
+current`` — two merges, independent of window length. Any narrower
+suffix (``query(window=w)``) re-merges the newest ``w`` raw buckets
+(O(w) merges — "arbitrary window re-aggregation"); either way the result
+is a plain :class:`~mpi_k_selection_tpu.streaming.sketch.RadixSketch`,
+so every answer carries the sketch's EXACT ``rank_bounds`` /
+``value_bounds`` / ``rank_error_bound``, and — merge order being
+bitwise-invariant — is bit-identical to a from-scratch merge of the same
+live buckets (test-gridded in tests/test_monitor.py; re-proven by
+``bench.py:bench_monitor``).
+
+Time is whatever the caller advances on: the Monitor driver
+(monitor/monitor.py) advances every ``emit_every`` chunks; the
+windowed-histogram bridge (obs/windows.py) every ``advance_every``
+observations. The sketch itself never reads a clock (KSL004).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from mpi_k_selection_tpu.streaming.sketch import RadixSketch
+
+
+class WindowedSketch:
+    """Sliding window of the last ``window`` time buckets (the open
+    ``current`` bucket included), each an exact mergeable
+    :class:`RadixSketch` over one dtype's stream.
+
+    ``update``/``update_value`` fold into the current bucket;
+    ``advance()`` closes it (evicting the oldest bucket once the ring is
+    full — O(1) amortized sketch merges, see the module docstring) and
+    opens a fresh one; ``query(window=w)`` returns the merged sketch of
+    the newest ``w`` live buckets (default: all of them)."""
+
+    #: Subclasses whose query() cannot use cached aggregates (the
+    #: decayed window: weights shift every advance) set this False and
+    #: advance() skips the two-stack maintenance entirely — the ring
+    #: rotation alone is already O(1).
+    _maintain_aggregates = True
+
+    def __init__(self, dtype, *, window: int, radix_bits: int = 4, levels: int = 4):
+        window = int(window)
+        if window < 1:
+            raise ValueError(f"window must be >= 1 bucket, got {window}")
+        self.dtype = np.dtype(dtype)
+        self.window = window
+        self.radix_bits = int(radix_bits)
+        self.levels = int(levels)
+        #: completed window advances (the current bucket's epoch index)
+        self.epoch = 0
+        self.current = self._fresh()
+        # two-stack queue over CLOSED buckets:
+        # _front: [(bucket, suffix_aggregate)] — index 0 is the YOUNGEST
+        #   front bucket, the END is the OLDEST (the stack top, popped at
+        #   eviction); suffix_aggregate merges the entry with every
+        #   younger front bucket.
+        # _back: young closed buckets, oldest..newest; _back_agg is their
+        #   running merge (None when empty).
+        self._front: list[tuple[RadixSketch, RadixSketch]] = []
+        self._back: list[RadixSketch] = []
+        self._back_agg: RadixSketch | None = None
+
+    def _fresh(self) -> RadixSketch:
+        return RadixSketch(
+            self.dtype, radix_bits=self.radix_bits, levels=self.levels
+        )
+
+    # -- accumulation ------------------------------------------------------
+
+    def update(self, chunk) -> "WindowedSketch":
+        """Fold one chunk into the current bucket."""
+        self.current.update(chunk)
+        return self
+
+    def update_value(self, value) -> "WindowedSketch":
+        """Fold one observation into the current bucket (the O(levels)
+        scalar path — see :meth:`RadixSketch.update_value`)."""
+        self.current.update_value(value)
+        return self
+
+    def advance(self) -> "WindowedSketch":
+        """Close the current bucket and open a new one, evicting the
+        oldest bucket once more than ``window - 1`` closed buckets are
+        live. Amortized cost: O(1) sketch merges (one back fold, plus the
+        amortized share of a front flip), independent of ``window``."""
+        self._back.append(self.current)
+        if self._maintain_aggregates:
+            if self._back_agg is None:
+                self._back_agg = self.current.copy()
+            else:
+                self._back_agg.fold_scaled(self.current, 1)
+        while len(self._front) + len(self._back) > self.window - 1:
+            self._evict_oldest()
+        self.current = self._fresh()
+        self.epoch += 1
+        return self
+
+    def _evict_oldest(self) -> None:
+        if not self._front:
+            # flip: back becomes the front, suffix aggregates computed in
+            # one newest-to-oldest sweep (each entry's aggregate = itself
+            # merged with the previous — younger — entry's aggregate)
+            agg = None
+            for b in reversed(self._back):
+                if self._maintain_aggregates:
+                    agg = b.copy() if agg is None else agg.merge(b)
+                self._front.append((b, agg))
+            self._back = []
+            self._back_agg = None
+        if self._front:
+            self._front.pop()
+
+    # -- queries -----------------------------------------------------------
+
+    @property
+    def n_live(self) -> int:
+        """Live bucket count, the open current bucket included."""
+        return len(self._front) + len(self._back) + 1
+
+    def live_buckets(self) -> list[RadixSketch]:
+        """The live buckets, oldest..newest (current last) — the raw
+        operands a from-scratch merge of :meth:`query` would fold; the
+        bit-identity tests and ``bench_monitor`` merge exactly these."""
+        oldest_first = [b for b, _ in reversed(self._front)]
+        return oldest_first + list(self._back) + [self.current]
+
+    def _resolve_window(self, window) -> int:
+        if window is None:
+            return self.n_live
+        window = int(window)
+        if not 1 <= window <= self.window:
+            raise ValueError(
+                f"query window must be in [1, {self.window}] buckets, "
+                f"got {window}"
+            )
+        return min(window, self.n_live)
+
+    def query(self, window: int | None = None) -> RadixSketch:
+        """Merged sketch of the newest ``window`` live buckets (default
+        all) — a plain :class:`RadixSketch`, so ``quantile`` /
+        ``rank_bounds`` / ``value_bounds`` / ``pin`` all apply with their
+        exact-bound guarantees. Full-window queries cost O(1) merges (the
+        cached two-stack aggregates); narrower suffixes re-merge their
+        O(window) raw buckets. Bit-identical to a from-scratch fold of
+        the same buckets in any order."""
+        w = self._resolve_window(window)
+        closed_needed = w - 1
+        if self._maintain_aggregates and (
+            closed_needed >= len(self._front) + len(self._back)
+        ):
+            # the full closed set: cached aggregates, O(1) merges
+            out = self.current.copy()
+            if self._back_agg is not None:
+                out.fold_scaled(self._back_agg, 1)
+            if self._front:
+                out.fold_scaled(self._front[-1][1], 1)
+            return out
+        out = self.current.copy()
+        take_back = min(closed_needed, len(self._back))
+        for b in self._back[len(self._back) - take_back:]:
+            out.fold_scaled(b, 1)
+        for b, _ in self._front[: closed_needed - take_back]:
+            out.fold_scaled(b, 1)
+        return out
+
+    def quantiles(self, qs, window: int | None = None):
+        """Nearest-rank quantile values over the queried window (the
+        merged sketch's :meth:`RadixSketch.quantiles`)."""
+        return self.query(window).quantiles(qs)
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (
+            f"{type(self).__name__}(dtype={self.dtype}, window={self.window}, "
+            f"epoch={self.epoch}, n_live={self.n_live})"
+        )

@@ -60,6 +60,7 @@ from mpi_k_selection_tpu.obs.metrics import (
     collect_runtime,
 )
 from mpi_k_selection_tpu.obs.trace import Span, TraceRecorder
+from mpi_k_selection_tpu.obs.windows import WindowedHistogram
 
 __all__ = [
     "CallbackSink",
@@ -83,6 +84,7 @@ __all__ = [
     "SpillGenerationEvent",
     "StreamPassEvent",
     "TraceRecorder",
+    "WindowedHistogram",
     "check_stream_invariants",
     "collect_runtime",
 ]

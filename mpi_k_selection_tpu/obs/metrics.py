@@ -39,6 +39,56 @@ DEFAULT_BUCKETS = (0.0, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0)
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
 
+#: ``# HELP`` one-liners for the catalog metrics (docs/OBSERVABILITY.md);
+#: exposition emits HELP only for names listed here — an unlisted name
+#: still renders conformant TYPE + sample lines.
+HELP_TEXTS = {
+    "ingest.chunks": "Chunks consumed per round-robin ingest slot",
+    "ingest.bytes": "Key bytes consumed per round-robin ingest slot",
+    "inflight.occupancy": "In-flight executor bundles at every windowed push",
+    "staging_pool.hits": "StagingPool buffer reuse hits",
+    "staging_pool.misses": "StagingPool buffer allocations",
+    "staging_pool.resident_bytes": "Free-list bytes currently pooled",
+    "spill.passes": "Spill store pass_log entries",
+    "phase.seconds": "Wall seconds per PhaseTimer phase",
+    "phase.calls": "Calls per PhaseTimer phase",
+    "serve.queries": "Requests answered, by answering tier and op",
+    "serve.latency_seconds": "Per-request wall latency by answering tier",
+    "serve.queue_depth": "Dispatch-queue depth sampled at every submit",
+    "serve.batch_width": "Total rank width of each coalesced dispatch",
+    "monitor.quantile": "Continuous windowed quantile stream (monitor/)",
+    "monitor.window_n": "Merged live-window count of the monitor",
+    "monitor.epoch": "Window advances completed by the monitor",
+    "monitor.samples": "Samples the monitor has emitted",
+}
+
+
+def _escape_label_value(v) -> str:
+    """Prometheus text-format label-value escaping: backslash, double
+    quote and newline must be escaped (the grammar the conformance test
+    in tests/test_prometheus.py parses)."""
+    return (
+        str(v)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _escape_help(text: str) -> str:
+    """HELP-text escaping: backslash and newline only (quotes are legal)."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _render_labels(pairs) -> str:
+    """``{k="v",...}`` with escaped values, '' for no labels."""
+    if not pairs:
+        return ""
+    inner = ",".join(
+        f'{k}="{_escape_label_value(v)}"' for k, v in sorted(pairs)
+    )
+    return "{" + inner + "}"
+
 
 class _Metric:
     """Shared plumbing: identity (name + sorted label pairs) and the
@@ -52,10 +102,7 @@ class _Metric:
         self._lock = lock
 
     def label_str(self) -> str:
-        if not self.labels:
-            return ""
-        inner = ",".join(f'{k}="{v}"' for k, v in self.labels)
-        return "{" + inner + "}"
+        return _render_labels(self.labels)
 
 
 class Counter(_Metric):
@@ -119,17 +166,23 @@ class Histogram(_Metric):
 
     def observe(self, value) -> None:
         with self._lock:
-            self.count += 1
-            self.sum += value
-            if self.min is None or value < self.min:
-                self.min = value
-            if self.max is None or value > self.max:
-                self.max = value
-            for i, bound in enumerate(self.bounds):
-                if value <= bound:
-                    self.bucket_counts[i] += 1
-                    return
-            self.bucket_counts[-1] += 1
+            self._observe_locked(value)
+
+    def _observe_locked(self, value) -> None:
+        """Bookkeeping under the registry lock — the override point of
+        the windowed-histogram bridge (obs/windows.py), which adds its
+        sketch fold to the SAME critical section."""
+        self.count += 1
+        self.sum += value
+        if self.min is None or value < self.min:
+            self.min = value
+        if self.max is None or value > self.max:
+            self.max = value
+        for i, bound in enumerate(self.bounds):
+            if value <= bound:
+                self.bucket_counts[i] += 1
+                return
+        self.bucket_counts[-1] += 1
 
     def cumulative(self) -> list[int]:
         """Cumulative counts per ``le`` bound (+Inf last) — the
@@ -172,6 +225,7 @@ class MetricsRegistry:
     def __init__(self):
         self._lock = threading.Lock()
         self._metrics: dict = {}
+        self._window_specs: dict = {}
 
     @staticmethod
     def _key(name: str, labels):
@@ -198,7 +252,41 @@ class MetricsRegistry:
         return self._get_or_create(Gauge, name, labels)
 
     def histogram(self, name: str, labels=None, buckets=DEFAULT_BUCKETS) -> Histogram:
+        spec = self._window_specs.get(name)
+        if spec is not None:
+            from mpi_k_selection_tpu.obs.windows import WindowedHistogram
+
+            return self._get_or_create(
+                WindowedHistogram, name, labels, buckets=buckets, **spec
+            )
         return self._get_or_create(Histogram, name, labels, buckets=buckets)
+
+    def enable_windowed(
+        self, name: str, *, window: int = 8, advance_every: int = 256,
+        radix_bits: int = 4, levels: int = 4, decay: float | None = None,
+        quantiles=(0.5, 0.9, 0.99),
+    ) -> None:
+        """Back every future labeled series of histogram ``name`` with a
+        sliding-window RadixSketch (obs/windows.py): observations fold
+        into per-``advance_every``-observation window buckets, and the
+        exposition gains exactly-bounded ``<name>_windowed`` quantile
+        gauges next to the unchanged fixed-bucket series. Must run
+        BEFORE the metric's first creation — an already-created plain
+        histogram cannot be upgraded retroactively (its past
+        observations are gone), so that raises instead of silently
+        serving a half-empty window."""
+        with self._lock:
+            existing = [k for k in self._metrics if k[0] == name]
+            if existing:
+                raise TypeError(
+                    f"metric {name!r} already has {len(existing)} series; "
+                    "enable_windowed must run before the first observation"
+                )
+            self._window_specs[name] = dict(
+                window=window, advance_every=advance_every,
+                radix_bits=radix_bits, levels=levels, decay=decay,
+                quantiles=tuple(quantiles),
+            )
 
     def metrics(self) -> list[_Metric]:
         with self._lock:
@@ -219,36 +307,86 @@ class MetricsRegistry:
 
     def render_prometheus(self) -> str:
         """Prometheus text exposition (format 0.0.4): names sanitized to
-        ``ksel_<name_with_underscores>``, histograms as
-        ``_bucket{le=...}``/``_sum``/``_count`` series."""
+        ``ksel_<name_with_underscores>``, HELP lines for cataloged
+        names, label values escaped per the grammar, histograms as
+        ``_bucket{le=...}``/``_sum``/``_count`` series — plus, for
+        windowed histograms (obs/windows.py), the exactly-bounded
+        ``_windowed``/``_windowed_rank_error``/``_windowed_count``
+        quantile gauges. Conformance is test-enforced
+        (tests/test_prometheus.py)."""
         by_name: dict = {}
         for m in self.metrics():
             by_name.setdefault(m.name, []).append(m)
         lines = []
         for name in sorted(by_name):
-            group = by_name[name]
+            group = sorted(by_name[name], key=lambda g: g.labels)
             pname = "ksel_" + _NAME_RE.sub("_", name.replace(".", "_"))
+            if name in HELP_TEXTS:
+                lines.append(f"# HELP {pname} {_escape_help(HELP_TEXTS[name])}")
             lines.append(f"# TYPE {pname} {group[0].type_name}")
-            for m in sorted(group, key=lambda g: g.labels):
+            windowed = []  # (labels, snapshot) per windowed member
+            for m in group:
                 if isinstance(m, Histogram):
-                    for bound, c in zip(m.bounds, m.cumulative()):
+                    # one consistent snapshot under the lock: the +Inf
+                    # bucket and _count lines MUST agree (the histogram
+                    # invariant tests/test_prometheus.py enforces), and
+                    # a scrape racing a live observe() would otherwise
+                    # read m.count twice across the interleaving
+                    with m._lock:
+                        cum = m.cumulative()
+                        count, total = m.count, m.sum
+                    for bound, c in zip(m.bounds, cum):
                         lab = dict(m.labels)
                         lab["le"] = _format_float(bound)
-                        inner = ",".join(
-                            f'{k}="{v}"' for k, v in sorted(lab.items())
+                        lines.append(
+                            f"{pname}_bucket{_render_labels(lab.items())} {c}"
                         )
-                        lines.append(f"{pname}_bucket{{{inner}}} {c}")
                     inf_lab = dict(m.labels)
                     inf_lab["le"] = "+Inf"
-                    inner = ",".join(
-                        f'{k}="{v}"' for k, v in sorted(inf_lab.items())
+                    lines.append(
+                        f"{pname}_bucket{_render_labels(inf_lab.items())} "
+                        f"{count}"
                     )
-                    lines.append(f"{pname}_bucket{{{inner}}} {m.count}")
-                    lines.append(f"{pname}_sum{m.label_str()} {_format_float(m.sum)}")
-                    lines.append(f"{pname}_count{m.label_str()} {m.count}")
+                    lines.append(f"{pname}_sum{m.label_str()} {_format_float(total)}")
+                    lines.append(f"{pname}_count{m.label_str()} {count}")
+                    snapshot = getattr(m, "windowed_snapshot", None)
+                    if snapshot is not None:
+                        snap = snapshot()
+                        if snap is not None:
+                            windowed.append((m.labels, snap))
                 else:
                     lines.append(
                         f"{pname}{m.label_str()} {_format_float(m.value)}"
+                    )
+            if windowed:
+                lines.append(
+                    f"# HELP {pname}_windowed Sliding-window quantile with "
+                    "exact rank/value bounds (obs/windows.py)"
+                )
+                lines.append(f"# TYPE {pname}_windowed gauge")
+                for labels, snap in windowed:
+                    for e in snap["quantiles"]:
+                        lab = dict(labels)
+                        lab["quantile"] = _format_float(e["q"])
+                        lines.append(
+                            f"{pname}_windowed{_render_labels(lab.items())} "
+                            f"{_format_float(e['value'])}"
+                        )
+                lines.append(f"# TYPE {pname}_windowed_rank_error gauge")
+                for labels, snap in windowed:
+                    for e in snap["quantiles"]:
+                        lab = dict(labels)
+                        lab["quantile"] = _format_float(e["q"])
+                        lines.append(
+                            f"{pname}_windowed_rank_error"
+                            f"{_render_labels(lab.items())} "
+                            f"{e['rank_error']}"
+                        )
+                lines.append(f"# TYPE {pname}_windowed_count gauge")
+                for labels, snap in windowed:
+                    lines.append(
+                        f"{pname}_windowed_count{_render_labels(labels)} "
+                        f"{snap['n']}"
                     )
         return "\n".join(lines) + ("\n" if lines else "")
 
@@ -320,8 +458,8 @@ def collect_runtime(
         )
     if timer is not None:
         for name, d in timer.as_dict().items():
-            registry.gauge("phase.seconds", labels={"phase": name}).set(
+            registry.gauge("phase.seconds", labels={"phase": name}).set(  # ksel: noqa[KSL013] -- phase names are a closed, code-defined set (PhaseTimer phases), not per-request data
                 d["seconds"]
             )
-            registry.gauge("phase.calls", labels={"phase": name}).set(d["calls"])
+            registry.gauge("phase.calls", labels={"phase": name}).set(d["calls"])  # ksel: noqa[KSL013] -- phase names are a closed, code-defined set (PhaseTimer phases), not per-request data
     return registry

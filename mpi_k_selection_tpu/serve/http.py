@@ -18,7 +18,12 @@ Endpoints:
   topk, ``{"less": L, "leq": E}`` for certificates.
 - ``GET /v1/datasets`` — registered-dataset listing.
 - ``GET /metrics`` — Prometheus text exposition of the server metric
-  namespace (the ``--metrics-json`` registry, rendered live).
+  namespace (the ``--metrics-json`` registry, rendered live). With the
+  server's ``latency_windows`` knob on, the per-tier
+  ``serve.latency_seconds`` histograms additionally expose
+  sliding-window quantile gauges with exact bounds
+  (``ksel_serve_latency_seconds_windowed{tier=,quantile=}`` — see
+  obs/windows.py and docs/OBSERVABILITY.md "Continuous monitoring").
 - ``GET /healthz`` — liveness + dataset count.
 
 Threading: ``ThreadingHTTPServer`` with NAMED request threads

@@ -114,6 +114,7 @@ class KSelectServer:
         max_queue_depth: int | None = None,
         retry_after: float = 1.0,
         default_deadline: float | None = None,
+        latency_windows=None,
         obs=None,
         registry: DatasetRegistry | None = None,
     ):
@@ -121,6 +122,28 @@ class KSelectServer:
 
         self.obs = obs
         self.metrics = None if obs is None else obs.metrics
+        # latency_windows (off by default): back serve.latency_seconds
+        # with a sliding-window RadixSketch (obs/windows.py), so /metrics
+        # p50/p90/p99 become windowed, EXACTLY-bounded quantiles instead
+        # of fixed-bucket interpolation. True = defaults (8 buckets x 256
+        # observations); an int = that many window buckets; a dict
+        # forwards to MetricsRegistry.enable_windowed (window/
+        # advance_every/decay/quantiles). Purely observational — answers
+        # are bit-identical with the knob on (tests/test_monitor.py).
+        if latency_windows:
+            if self.metrics is None:
+                raise ValueError(
+                    "latency_windows needs a metrics registry: pass "
+                    "obs=Observability(metrics=MetricsRegistry()) — the "
+                    "windowed quantiles live in /metrics"
+                )
+            if latency_windows is True:
+                spec = {}
+            elif isinstance(latency_windows, int):
+                spec = {"window": latency_windows}
+            else:
+                spec = dict(latency_windows)
+            self.metrics.enable_windowed("serve.latency_seconds", **spec)
         self.registry = registry if registry is not None else DatasetRegistry()
         self.default_deadline = (
             None if default_deadline is None else float(default_deadline)

@@ -376,6 +376,80 @@ class RadixSketch:
 
     __add__ = merge
 
+    def copy(self) -> "RadixSketch":
+        """Independent deep copy (counts and extremes) — the suffix-merge
+        seed of the sliding-window ring (monitor/windows.py)."""
+        out = RadixSketch(self.dtype, radix_bits=self.radix_bits, levels=self.levels)
+        out.n = self.n
+        out.hists = [h.copy() for h in self.hists]
+        out._min_key = self._min_key
+        out._max_key = self._max_key
+        return out
+
+    def fold_scaled(self, other: "RadixSketch", weight: int) -> "RadixSketch":
+        """In-place count-scaled fold: every count of ``other`` enters
+        ``self`` multiplied by the non-negative integer ``weight``
+        (``weight=1`` is a plain in-place merge — the windowed ring's
+        subtract-free suffix aggregation; larger weights are the
+        fixed-point exponential decay of monitor/decay.py, where a bucket
+        of age ``a`` folds at ``round(decay**a * 2**DECAY_SHIFT)``).
+
+        Because each term is an exact ``int64`` product summed
+        elementwise, scaled folds stay associative AND commutative: any
+        grouping of buckets (each carrying its own fixed weight) yields a
+        bitwise-identical accumulator. The int64 accumulator discipline
+        (KSC102) bounds the width: this refuses loudly when
+        ``other.n * weight`` could push the total count past ``2**63 - 1``
+        instead of silently wrapping. ``weight=0`` folds nothing (a fully
+        decayed bucket) but is still a valid no-op. Returns ``self``."""
+        self._check_compatible(other)
+        weight = int(weight)
+        if weight < 0:
+            raise ValueError(f"fold weight must be >= 0, got {weight}")
+        if weight == 0 or other.n == 0:
+            return self
+        if other.n > ((1 << 63) - 1 - self.n) // weight:
+            raise OverflowError(
+                f"count-scaled fold of n={other.n} at weight={weight} would "
+                f"overflow the int64 accumulator (current n={self.n}); lower "
+                "DECAY_SHIFT or shorten the window (docs/OBSERVABILITY.md "
+                "'Continuous monitoring')"
+            )
+        for mine, theirs in zip(self.hists, other.hists):
+            if weight == 1:
+                mine += theirs
+            else:
+                mine += theirs * weight
+        self.n += other.n * weight
+        if other._min_key is not None and (
+            self._min_key is None or other._min_key < self._min_key
+        ):
+            self._min_key = self.kdt.type(other._min_key)
+        if other._max_key is not None and (
+            self._max_key is None or other._max_key > self._max_key
+        ):
+            self._max_key = self.kdt.type(other._max_key)
+        return self
+
+    def update_value(self, value) -> "RadixSketch":
+        """Fold ONE observation in — O(levels) counter increments, no
+        ``2**resolution_bits`` bincount allocation — the per-observe path
+        of the windowed-histogram bridge (obs/windows.py), where a sketch
+        sees one latency sample at a time. Bit-identical to
+        ``update([value])``."""
+        key = _dt.np_to_sortable_bits(
+            np.asarray([value], self.dtype)
+        )[0]
+        deep = int(key >> self.kdt.type(self.total_bits - self.resolution_bits))
+        for l in range(1, self.levels + 1):
+            self.hists[l - 1][deep >> ((self.levels - l) * self.radix_bits)] += 1
+        if self._min_key is None or key < self._min_key:
+            self._min_key = self.kdt.type(key)
+        if self._max_key is None or key > self._max_key:
+            self._max_key = self.kdt.type(key)
+        self.n += 1
+        return self
+
     def __eq__(self, other) -> bool:
         if not isinstance(other, RadixSketch):
             return NotImplemented

@@ -824,6 +824,85 @@ def test_ksl012_noqa(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# KSL013 — unbounded metric label cardinality
+
+
+KSL013_POSITIVE = """
+    def per_chunk(reg, chunks):
+        for i, chunk in enumerate(chunks):
+            reg.counter("ingest.chunks", labels={"chunk": i}).inc()
+            reg.gauge("chunk.bytes", labels={"idx": str(i)}).set(chunk.nbytes)
+
+    def per_request(reg, requests):
+        sizes = [
+            reg.histogram("req.size", labels={"rid": f"{r.id}"}).observe(r.n)
+            for r in requests
+        ]
+        return sizes
+"""
+
+KSL013_NEGATIVE = """
+    def bounded(reg, phase, requests):
+        # a function parameter is the CALLER's (closed) choice
+        reg.gauge("phase.seconds", labels={"phase": phase}).set(1.0)
+        # constant labels are the common case
+        reg.counter("ingest.chunks", labels={"device": "host"}).inc()
+        for r in requests:
+            # per-occurrence data in the VALUE, labels constant
+            reg.histogram("req.size", labels={"op": "kselect"}).observe(r.n)
+        lab = {"device": str(len(requests))}
+        # a labels= NAME built elsewhere is out of this rule's scope
+        reg.counter("ingest.bytes", labels=lab).inc()
+"""
+
+
+def test_ksl013_positive_in_package(tmp_path):
+    report = _lint_source(
+        tmp_path, KSL013_POSITIVE,
+        name="mpi_k_selection_tpu/obs/mod.py",
+    )
+    hits = [f for f in report.unsuppressed if f.rule == "KSL013"]
+    # the two for-loop labels + the comprehension label
+    assert len(hits) == 3
+    assert all("unbounded label cardinality" in f.message for f in hits)
+
+
+def test_ksl013_negative(tmp_path):
+    report = _lint_source(
+        tmp_path, KSL013_NEGATIVE,
+        name="mpi_k_selection_tpu/obs/mod.py",
+    )
+    assert "KSL013" not in _rules_hit(report)
+
+
+def test_ksl013_scope(tmp_path):
+    # outside the package: a user script may label however it wants
+    report = _lint_source(tmp_path, KSL013_POSITIVE, name="scripts/mod.py")
+    assert "KSL013" not in _rules_hit(report)
+    # tests simulate cardinality explosions on purpose
+    report = _lint_source(
+        tmp_path, KSL013_POSITIVE,
+        name="mpi_k_selection_tpu/obs/test_mod.py",
+    )
+    assert "KSL013" not in _rules_hit(report)
+
+
+def test_ksl013_noqa(tmp_path):
+    src = KSL013_POSITIVE.replace(
+        'reg.counter("ingest.chunks", labels={"chunk": i}).inc()',
+        'reg.counter("ingest.chunks", labels={"chunk": i}).inc()'
+        "  # ksel: noqa[KSL013] -- fixture justification",
+    )
+    report = _lint_source(
+        tmp_path, src, name="mpi_k_selection_tpu/obs/mod.py"
+    )
+    hits = [f for f in report.unsuppressed if f.rule == "KSL013"]
+    assert len(hits) == 2  # the gauge + the comprehension still fire
+    sup = [f for f in report.findings if f.rule == "KSL013" and f.suppressed]
+    assert sup and sup[0].justification == "fixture justification"
+
+
+# ---------------------------------------------------------------------------
 # jaxpr contract checks (KSC101-KSC103) self-tests
 
 

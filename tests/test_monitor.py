@@ -1,0 +1,561 @@
+"""Continuous monitoring (mpi_k_selection_tpu/monitor/): the windowed
+ring's bit-identity and O(1)-advance structure, the decayed fold's
+algebra (associativity/commutativity across split points, the
+``decay=1.0`` degenerate identity, int64 headroom), the Monitor driver
+over the real ingest pipeline (depth x devices bit-identity, drifting
+streams, exact bounds), the windowed-histogram metrics bridge, the
+serve ``latency_windows`` knob, and the CLI ``monitor`` subcommand.
+"""
+
+import json
+import math
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mpi_k_selection_tpu.monitor import (
+    DECAY_SHIFT,
+    DecayedSketch,
+    DecayedWindowedSketch,
+    Monitor,
+    WindowedSketch,
+    decay_weight,
+    start_metrics_server,
+)
+from mpi_k_selection_tpu.streaming.sketch import RadixSketch
+
+
+def _chunks(rng, sizes, dtype=np.int32, lo=-(2**31), hi=2**31 - 1):
+    if np.issubdtype(np.dtype(dtype), np.integer):
+        return [rng.integers(lo, hi, size=m, dtype=dtype) for m in sizes]
+    return [rng.standard_normal(m).astype(dtype) for m in sizes]
+
+
+def _scratch_merge(buckets, dtype, **kw):
+    out = RadixSketch(dtype, **kw)
+    for b in buckets:
+        out.fold_scaled(b, 1)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# WindowedSketch — ring re-aggregation bit-identity
+
+
+@pytest.mark.parametrize("window", [1, 2, 3, 8])
+def test_windowed_query_bit_identical_to_scratch(window, rng):
+    """Every (advance count, query window) over a 3x-wrap run: the
+    two-stack aggregates must equal a from-scratch merge of the same
+    live buckets, bit for bit."""
+    ws = WindowedSketch(np.int32, window=window)
+    raw = []
+    for epoch in range(3 * window + 2):
+        c = rng.integers(
+            -(2**31), 2**31 - 1, size=int(rng.integers(1, 400)),
+            dtype=np.int32,
+        )
+        ws.update(c)
+        raw.append(c)
+        for qw in [None] + list(range(1, window + 1)):
+            w_eff = min(qw or window, len(raw), window)
+            scratch = RadixSketch(np.int32)
+            for b in raw[len(raw) - w_eff:]:
+                scratch.update(b)
+            assert ws.query(qw) == scratch, (window, epoch, qw)
+        ws.advance()
+    assert ws.epoch == 3 * window + 2
+    assert ws.n_live == min(ws.epoch + 1, window)
+
+
+def test_windowed_float32_and_heterogeneous_chunks(rng):
+    ws = WindowedSketch(np.float32, window=3)
+    raw = []
+    for m in (7, 1, 300, 64, 2):
+        c = rng.standard_normal(m).astype(np.float32)
+        ws.update(c)
+        raw.append([c])
+        # several chunks per bucket
+        c2 = rng.standard_normal(m + 3).astype(np.float32)
+        ws.update(c2)
+        raw[-1].append(c2)
+        ws.advance()
+    # the current (empty) bucket counts toward the window, so only the
+    # newest window-1 = 2 closed buckets are live after the last advance
+    live = [b for bucket in raw[-2:] for b in bucket]
+    scratch = RadixSketch(np.float32)
+    for c in live:
+        scratch.update(c)
+    assert ws.query() == scratch
+
+
+def test_windowed_live_buckets_order(rng):
+    ws = WindowedSketch(np.int32, window=3)
+    cs = _chunks(rng, [5, 5, 5, 5])
+    for c in cs:
+        ws.update(c)
+        ws.advance()
+    live = ws.live_buckets()
+    assert len(live) == 3  # 2 closed + current (empty)
+    assert live[-1].n == 0
+    assert [b.n for b in live[:-1]] == [5, 5]
+
+
+def test_windowed_validation():
+    with pytest.raises(ValueError, match="window must be >= 1"):
+        WindowedSketch(np.int32, window=0)
+    ws = WindowedSketch(np.int32, window=4)
+    with pytest.raises(ValueError, match=r"query window must be in \[1, 4\]"):
+        ws.query(5)
+    with pytest.raises(ValueError, match=r"query window must be in \[1, 4\]"):
+        ws.query(0)
+
+
+def test_update_value_bit_identical_to_update(rng):
+    for dtype, vals in (
+        (np.int32, [-5, 0, 2**31 - 1, -(2**31)]),
+        (np.float64, [0.0, -0.0, 1e-9, 3.5, -2.25, float("inf")]),
+    ):
+        a = RadixSketch(dtype)
+        b = RadixSketch(dtype)
+        for v in vals:
+            a.update_value(v)
+            b.update(np.asarray([v], dtype))
+        assert a == b, dtype
+
+
+def test_copy_is_independent(rng):
+    a = RadixSketch(np.int32).update(_chunks(rng, [64])[0])
+    b = a.copy()
+    assert a == b
+    b.update(_chunks(rng, [8])[0])
+    assert a != b and a.n == 64
+
+
+# ---------------------------------------------------------------------------
+# count-scaled fold algebra (the decayed-merge satellite)
+
+
+def test_fold_scaled_weight_one_matches_merge(rng):
+    c1, c2 = _chunks(rng, [100, 37])
+    a = RadixSketch(np.int32).update(c1)
+    b = RadixSketch(np.int32).update(c2)
+    merged = a.merge(b)
+    folded = a.copy().fold_scaled(b, 1)
+    assert folded == merged
+
+
+def test_fold_scaled_validation(rng):
+    a = RadixSketch(np.int32).update(_chunks(rng, [10])[0])
+    b = RadixSketch(np.int32).update(_chunks(rng, [10])[0])
+    with pytest.raises(ValueError, match="weight must be >= 0"):
+        a.fold_scaled(b, -1)
+    before = a.copy()
+    a.fold_scaled(b, 0)  # zero weight: a no-op, not an error
+    assert a == before
+    with pytest.raises(ValueError, match="incompatible"):
+        a.fold_scaled(RadixSketch(np.int32, radix_bits=2), 1)
+
+
+def test_fold_scaled_associative_commutative_across_split_points(rng):
+    """The decayed aggregate is sum_a bucket_a * w_a; any grouping and
+    any order must produce a bitwise-identical accumulator."""
+    buckets = [
+        RadixSketch(np.int32).update(c)
+        for c in _chunks(rng, [50, 200, 3, 77, 128])
+    ]
+    weights = [decay_weight(0.7, a) for a in range(5)]
+    pairs = list(zip(buckets, weights))
+
+    def fold(ordering, splits):
+        acc = RadixSketch(np.int32)
+        # fold a first segment into one sub-accumulator, the rest into
+        # another, then combine — the "split point" shape
+        lo = RadixSketch(np.int32)
+        hi = RadixSketch(np.int32)
+        for i, (b, w) in enumerate(ordering):
+            (lo if i < splits else hi).fold_scaled(b, w)
+        acc.fold_scaled(lo, 1)
+        acc.fold_scaled(hi, 1)
+        return acc
+
+    want = fold(pairs, 0)
+    for splits in (1, 2, 4, 5):
+        assert fold(pairs, splits) == want, f"split at {splits}"
+    assert fold(list(reversed(pairs)), 2) == want  # commutativity
+    assert fold(pairs[2:] + pairs[:2], 3) == want  # rotation
+
+
+def test_decay_one_degenerates_bit_identically(rng):
+    """decay=1.0: every weight is exactly 2**DECAY_SHIFT, so the decayed
+    pyramid is the undecayed one left-shifted — and every VALUE answer
+    (quantiles, value_bounds, pin) is bit-identical."""
+    dws = DecayedWindowedSketch(np.int32, window=4, decay=1.0)
+    base = WindowedSketch(np.int32, window=4)
+    for c in _chunks(rng, [100, 40, 7, 300, 100, 64]):
+        dws.update(c)
+        base.update(c)
+        dws.advance()
+        base.advance()
+    md, mb = dws.query(), base.query()
+    S = 1 << DECAY_SHIFT
+    assert md.n == mb.n * S
+    assert all(np.array_equal(a, b * S) for a, b in zip(md.hists, mb.hists))
+    qs = [0.01, 0.5, 0.9, 0.99, 1.0]
+    assert md.quantiles(qs) == mb.quantiles(qs)
+    for q in qs:
+        kd = max(1, math.ceil(q * md.n))
+        kb = max(1, math.ceil(q * mb.n))
+        assert md.value_bounds(kd) == mb.value_bounds(kb)
+
+
+def test_decay_weight_contract():
+    S = 1 << DECAY_SHIFT
+    assert decay_weight(1.0, 0) == decay_weight(1.0, 99) == S
+    assert decay_weight(0.5, 1) == S // 2
+    assert decay_weight(0.5, DECAY_SHIFT + 1) == 0  # fully decayed out
+    with pytest.raises(ValueError, match="decay must be in"):
+        decay_weight(0.0, 1)
+    with pytest.raises(ValueError, match="decay must be in"):
+        decay_weight(1.5, 1)
+    with pytest.raises(ValueError, match="age must be >= 0"):
+        decay_weight(0.5, -1)
+
+
+def test_decayed_bounds_match_weighted_oracle(rng):
+    """The decayed sketch's value_bounds must bracket the TRUE weighted
+    order statistic: expand every element by its bucket's integer
+    weight and take the nearest-rank quantile of the expansion."""
+    sizes = [60, 25, 90, 40]
+    chunks = _chunks(rng, sizes, lo=-1000, hi=1000)
+    # window=5: the empty current bucket (age 0) plus all 4 closed ones
+    dws = DecayedWindowedSketch(np.int32, window=5, decay=0.5)
+    for c in chunks:
+        dws.update(c)
+        dws.advance()
+    # after 4 advances the current bucket is empty; ages of the closed
+    # buckets are 1..4 (newest closed = age 1)
+    m = dws.query()
+    vals = np.concatenate(chunks)
+    wts = np.concatenate(
+        [
+            np.full(c.size, decay_weight(0.5, age), np.int64)
+            for age, c in zip(range(4, 0, -1), chunks)
+        ]
+    )
+    order = np.argsort(vals, kind="stable")
+    sv, sw = vals[order], np.cumsum(wts[order])
+    assert m.n == int(sw[-1])
+    for q in (0.1, 0.5, 0.9, 0.99):
+        k = max(1, math.ceil(q * m.n))
+        true = sv[int(np.searchsorted(sw, k, side="left"))]
+        vlo, vhi = m.value_bounds(k)
+        assert vlo <= true <= vhi, (q, vlo, true, vhi)
+        lo, hi = m.rank_bounds(k)
+        assert lo < k <= hi
+
+
+def test_fold_scaled_headroom_refusal_at_max_scale():
+    """int64 headroom: at the maximum weight (2**DECAY_SHIFT) a window
+    whose unweighted count reaches 2**(63-DECAY_SHIFT) must refuse
+    loudly, not wrap."""
+    a = DecayedSketch(np.int32)
+    big = RadixSketch(np.int32)
+    big.n = 1 << (63 - DECAY_SHIFT)  # simulated giant bucket
+    big.hists[-1][0] = big.n
+    big._min_key = big._max_key = big.kdt.type(0)
+    with pytest.raises(OverflowError, match="int64 accumulator"):
+        a.fold_scaled(big, 1 << DECAY_SHIFT)
+    # one below the edge folds fine
+    big.n -= 1
+    big.hists[-1][0] = big.n
+    a.fold_scaled(big, 1 << DECAY_SHIFT)
+    assert a.n == big.n * (1 << DECAY_SHIFT)
+
+
+# ---------------------------------------------------------------------------
+# Monitor — the driver over the real ingest pipeline
+
+
+def _drifting_chunks(n_chunks, elems=2048, step=500, seed=7):
+    rng = np.random.default_rng(seed)
+    return [
+        (rng.integers(0, 1000, size=elems) + i * step).astype(np.int32)
+        for i in range(n_chunks)
+    ]
+
+
+def test_monitor_tracks_drift_with_exact_bounds():
+    chunks = _drifting_chunks(12)
+    mon = Monitor(window=4)
+    samples = list(mon.run(iter(chunks), np.int32))  # one-shot source
+    assert len(samples) == 12
+    p50 = [s.values[0] for s in samples]
+    assert p50[-1] > p50[0]  # the window follows the drift
+    last = samples[-1]
+    assert last.metric_name == "multirank_p50_p90_p99"
+    live = np.concatenate(chunks[-4:])
+    s_live = np.sort(live, kind="stable")
+    for q, (vlo, vhi), (rlo, rhi) in zip(
+        last.qs, last.value_bounds, last.rank_bounds
+    ):
+        k = max(1, math.ceil(q * live.size))
+        assert vlo <= s_live[k - 1] <= vhi
+        assert rlo < k <= rhi
+
+
+@pytest.mark.parametrize("depth,devices", [(0, None), (2, None), (2, 2)])
+def test_monitor_bit_identical_across_ingest_grid(depth, devices):
+    """The pipeline/devices knobs change scheduling, never a sample bit
+    — the update_stream contract inherited wholesale."""
+    chunks = _drifting_chunks(9, elems=1500)
+    want = [
+        s.as_dict()
+        for s in Monitor(window=3).run(list(chunks), np.int32)
+    ]
+    got = [
+        s.as_dict()
+        for s in Monitor(
+            window=3, pipeline_depth=depth, devices=devices
+        ).run(lambda: iter(chunks), np.int32)
+    ]
+    assert got == want
+
+
+def test_monitor_emit_every_and_max_samples():
+    chunks = _drifting_chunks(10, elems=256)
+    mon = Monitor(window=4, emit_every=2)
+    samples = list(mon.run(list(chunks), np.int32))
+    assert len(samples) == 5  # 10 chunks / 2 per bucket
+    assert samples[0].n == 512 and samples[-1].n == 4 * 512
+    capped = list(
+        Monitor(window=4, emit_every=2).run(
+            list(chunks), np.int32, max_samples=2
+        )
+    )
+    assert len(capped) == 2
+
+
+def test_monitor_final_partial_bucket_sample():
+    chunks = _drifting_chunks(5, elems=128)
+    samples = list(
+        Monitor(window=4, emit_every=2).run(list(chunks), np.int32)
+    )
+    # 2 full buckets + a trailing 1-chunk bucket
+    assert len(samples) == 3
+    assert samples[-1].chunks == 5 and samples[-1].n == 5 * 128
+
+
+def test_monitor_decayed_samples():
+    chunks = _drifting_chunks(8, elems=512)
+    samples = list(
+        Monitor(window=4, decay=0.5).run(list(chunks), np.int32)
+    )
+    assert all(s.scale == (1 << DECAY_SHIFT) for s in samples)
+    # later samples weight recent (larger) data up: p50 tracks drift
+    assert samples[-1].values[0] > samples[0].values[0]
+
+
+def test_monitor_dtype_inference_and_validation():
+    chunks = _drifting_chunks(3, elems=64)
+    samples = list(Monitor(window=2).run(list(chunks)))  # inferred
+    assert len(samples) == 3
+    with pytest.raises(TypeError, match="pass dtype="):
+        next(Monitor(window=2).run(iter(chunks)))
+    with pytest.raises(ValueError, match="emit_every"):
+        Monitor(emit_every=0)
+    with pytest.raises(ValueError, match="at least one quantile"):
+        Monitor(qs=())
+
+
+def test_monitor_abandoned_generator_cleans_up():
+    """Breaking out of the sample stream must tear the pipeline down
+    (no leaked ksel- threads / staged buffers — conftest-enforced)."""
+    chunks = _drifting_chunks(20, elems=256)
+    for s in Monitor(window=4, pipeline_depth=2).run(list(chunks), np.int32):
+        break  # abandon after the first sample
+
+
+def test_monitor_obs_bit_identity_and_metrics():
+    from mpi_k_selection_tpu import obs as obs_lib
+
+    chunks = _drifting_chunks(6, elems=512)
+    plain = [
+        s.as_dict() for s in Monitor(window=3).run(list(chunks), np.int32)
+    ]
+    o = obs_lib.Observability.collecting()
+    inst = [
+        s.as_dict()
+        for s in Monitor(window=3, obs=o).run(list(chunks), np.int32)
+    ]
+    assert inst == plain  # sinks on never change a sample bit
+    reg = o.metrics
+    assert reg.counter("monitor.samples").value == 6
+    labs = {
+        dict(m.labels)["q"]
+        for m in reg.metrics()
+        if m.name == "monitor.quantile"
+    }
+    assert labs == {"p50", "p90", "p99"}
+    assert reg.gauge("monitor.window_n").value == inst[-1]["n"]
+    # chunk events rode the monitor pass label
+    kinds = [e.kind for e in o.events.events]
+    assert kinds.count("stream.chunk") == 6
+
+
+# ---------------------------------------------------------------------------
+# windowed-histogram bridge + serve knob
+
+
+def test_windowed_histogram_advances_on_observation_count(rng):
+    from mpi_k_selection_tpu import obs as obs_lib
+
+    reg = obs_lib.MetricsRegistry()
+    reg.enable_windowed("serve.latency_seconds", window=2, advance_every=4)
+    h = reg.histogram("serve.latency_seconds", labels={"tier": "exact"})
+    for v in (1.0, 1.0, 1.0, 1.0):  # bucket 0
+        h.observe(v)
+    for v in (9.0, 9.0, 9.0, 9.0):  # bucket 1 — bucket 0 evicted (W=2)
+        h.observe(v)
+    assert h.window_sketch.epoch == 2
+    snap = h.windowed_snapshot()
+    # the live window holds only the second batch's observations
+    assert snap["n"] == 4
+    assert all(e["value"] == 9.0 for e in snap["quantiles"])
+    # histogram side is untouched: full cumulative count
+    assert h.count == 8
+    d = h.as_dict()
+    assert d["windowed"]["n"] == 4 and d["count"] == 8
+
+
+def test_serve_latency_windows_bit_identity_and_exposition(rng):
+    from mpi_k_selection_tpu import api, obs as obs_lib
+    from mpi_k_selection_tpu.serve import KSelectServer
+
+    from tests.test_prometheus import parse_exposition
+
+    x = rng.integers(-(2**31), 2**31 - 1, size=1 << 15, dtype=np.int32)
+    ks = [1, 7, 1 << 12, x.size]
+    want = [int(np.asarray(api.kselect(x, k))) for k in ks]
+    o = obs_lib.Observability(metrics=obs_lib.MetricsRegistry())
+    with KSelectServer(
+        obs=o, latency_windows=dict(window=4, advance_every=2)
+    ) as srv:
+        srv.add_dataset("d", x)
+        got = [int(srv.kselect("d", k, tier="exact").value) for k in ks]
+        text = srv.render_prometheus()
+    assert got == want  # monitoring on, answers bit-identical
+    types, _, samples = parse_exposition(text)
+    assert types["ksel_serve_latency_seconds_windowed"] == "gauge"
+    assert any(
+        n == "ksel_serve_latency_seconds_windowed" and l.get("tier") == "exact"
+        for n, l, _ in samples
+    )
+
+
+def test_serve_latency_windows_off_by_default(rng):
+    from mpi_k_selection_tpu import obs as obs_lib
+    from mpi_k_selection_tpu.serve import KSelectServer
+
+    x = rng.integers(0, 100, size=1 << 10, dtype=np.int32)
+    o = obs_lib.Observability(metrics=obs_lib.MetricsRegistry())
+    with KSelectServer(obs=o) as srv:
+        srv.add_dataset("d", x)
+        srv.kselect("d", 5, tier="exact")
+        assert "_windowed" not in srv.render_prometheus()
+
+
+def test_serve_latency_windows_knob_forms(rng):
+    from mpi_k_selection_tpu import obs as obs_lib
+    from mpi_k_selection_tpu.serve import KSelectServer
+
+    # an int is a bucket count (the CLI's --latency-windows shape)
+    o = obs_lib.Observability(metrics=obs_lib.MetricsRegistry())
+    with KSelectServer(obs=o, latency_windows=6) as srv:
+        h = o.metrics.histogram(
+            "serve.latency_seconds", labels={"tier": "exact"}
+        )
+        assert h.window_sketch.window == 6
+    # True takes the defaults
+    o2 = obs_lib.Observability(metrics=obs_lib.MetricsRegistry())
+    with KSelectServer(obs=o2, latency_windows=True):
+        pass
+    # requesting windows WITHOUT a metrics registry is a loud error,
+    # not a silent no-op
+    with pytest.raises(ValueError, match="metrics registry"):
+        KSelectServer(latency_windows=8)
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exporter + CLI
+
+
+def test_start_metrics_server_serves_registry():
+    from mpi_k_selection_tpu import obs as obs_lib
+
+    reg = obs_lib.MetricsRegistry()
+    reg.gauge("monitor.window_n").set(42)
+    with start_metrics_server(reg) as srv:
+        url = f"http://127.0.0.1:{srv.port}"
+        with urllib.request.urlopen(url + "/metrics", timeout=5) as r:
+            body = r.read().decode()
+            assert r.headers["Content-Type"].startswith("text/plain")
+        assert "ksel_monitor_window_n 42" in body
+        with urllib.request.urlopen(url + "/healthz", timeout=5) as r:
+            assert json.loads(r.read())["status"] == "ok"
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(url + "/nope", timeout=5)
+
+
+def test_cli_monitor_human_lines(capsys):
+    from mpi_k_selection_tpu.cli import main
+
+    rc = main(
+        [
+            "monitor", "--buckets", "3", "--window", "4",
+            "--chunk-elems", "1024", "--drift", "50",
+        ]
+    )
+    assert rc == 0
+    lines = [
+        l for l in capsys.readouterr().out.splitlines()
+        if l.startswith("multirank_p50_p90_p99")
+    ]
+    assert len(lines) == 3
+    assert "p99=" in lines[0] and "rank_err<=" in lines[0]
+
+
+def test_cli_monitor_jsonl_decay_and_metrics(tmp_path, capsys):
+    from mpi_k_selection_tpu.cli import main
+
+    mpath = tmp_path / "mon.json"
+    rc = main(
+        [
+            "monitor", "--buckets", "2", "--window", "3",
+            "--chunk-elems", "512", "--decay", "0.5", "--emit-every", "2",
+            "--quantiles", "0.5,0.95", "--json",
+            "--metrics-json", str(mpath),
+        ]
+    )
+    assert rc == 0
+    recs = [
+        json.loads(l) for l in capsys.readouterr().out.splitlines()
+        if l.startswith("{")
+    ]
+    assert len(recs) == 2
+    assert recs[0]["metric"] == "multirank_p50_p95"
+    assert recs[0]["scale"] == 1 << DECAY_SHIFT
+    assert recs[0]["chunks"] == 2  # --emit-every 2
+    saved = json.loads(mpath.read_text())
+    assert any(k.startswith("monitor.quantile") for k in saved)
+
+
+def test_cli_monitor_validation():
+    from mpi_k_selection_tpu.cli import main
+
+    with pytest.raises(SystemExit, match="chunk-elems"):
+        main(["monitor", "--chunk-elems", "0", "--buckets", "1"])
+    with pytest.raises(SystemExit, match="quantiles"):
+        main(["monitor", "--quantiles", "0.5,zap", "--buckets", "1"])
+    with pytest.raises(SystemExit):
+        main(["monitor", "--decay", "7.5", "--buckets", "1"])

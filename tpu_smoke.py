@@ -307,6 +307,95 @@ def main() -> int:
             f"{round(d1 / dp, 3) if dp else None}"
         )
 
+    # --- seeded chaos recovery (ISSUE 9 follow-on (c), ROADMAP): the
+    # spill descent under a seeded FaultPlan on real chips — CPU CI
+    # proves the recovered BITS; this leg records the recovery TIMING:
+    # the fault-free wall vs the chaos wall (stalls virtualized, so the
+    # delta is real recovery work: re-pulls, re-reads, pass rebuilds)
+    # plus the fault/recovery counters, alongside the obs snapshot below
+    print("streaming chaos recovery (seeded fault injection):")
+    from mpi_k_selection_tpu import faults as _faults
+    from mpi_k_selection_tpu import obs as _ch_obs_lib
+    from mpi_k_selection_tpu.utils.timing import time_fn as _ch_time_fn
+
+    ch_kw = dict(spill="force", devices=ndev if ndev > 1 else 1, **sp_kw)
+    clean_s, _ = _ch_time_fn(lambda: _sp_ksel(sp_chunks, sp_k, **ch_kw))
+    ch_vs = _faults.VirtualSleeper()
+    ch_obs = _ch_obs_lib.Observability.collecting()
+    ch_plan = _faults.FaultPlan(
+        (
+            _faults.FaultSpec("source", 2, "raise"),
+            _faults.FaultSpec("stage", 1, "raise"),
+            _faults.FaultSpec("spill.read", 0, "corrupt_disk"),
+            _faults.FaultSpec("source", 4, "stall", arg=0.001),
+        )
+    )
+    with _faults.inject(ch_plan, sleeper=ch_vs, obs=ch_obs) as ch_inj:
+        chaos_s, got_chaos = _ch_time_fn(
+            lambda: _sp_ksel(
+                ch_inj.wrap_chunk_source(lambda: iter(sp_chunks)), sp_k,
+                retry=_faults.RetryPolicy(sleeper=ch_vs), obs=ch_obs,
+                **ch_kw,
+            )
+        )
+    check("chaos recovered bit-identical", int(got_chaos), want_sp)
+    check("chaos plan fired >= 3 sites", len(ch_inj.fired) >= 3, True)
+    ch_counters = {
+        f"{m.name}{dict(m.labels) if m.labels else ''}": m.value
+        for m in ch_obs.metrics.metrics()
+        if m.name.startswith("faults.")
+    }
+    ch_actions = sorted(
+        {
+            e.action
+            for e in ch_obs.events.of_kind("fault")
+            if e.action != "inject"
+        }
+    )
+    print(
+        f"    recovery walls: fault-free {round(clean_s, 4)}s vs chaos "
+        f"{round(chaos_s, 4)}s (overhead "
+        f"{round(chaos_s / clean_s - 1, 3) if clean_s else None}, "
+        f"virtual backoff {round(ch_vs.total, 4)}s excluded); "
+        f"fired={list(ch_inj.fired)} actions={ch_actions}"
+    )
+    print(f"    fault counters: {ch_counters}")
+
+    # --- continuous monitoring (ISSUE 10): the windowed quantile ring
+    # over the spill chunks on real silicon — ring re-aggregation must
+    # stay bit-identical to a from-scratch merge with device-staged
+    # ingest underneath, and the exact bounds must bracket the true
+    # window quantiles
+    print("continuous monitoring (windowed quantiles):")
+    from mpi_k_selection_tpu.monitor import Monitor as _Monitor
+    from mpi_k_selection_tpu.streaming.sketch import (
+        RadixSketch as _MonSketch,
+    )
+
+    mon = _Monitor(
+        window=4, devices=ndev if ndev > 1 else None, pipeline_depth=2
+    )
+    mon_samples = list(mon.run(list(sp_chunks), np.int32))
+    check("monitor emitted one sample per chunk", len(mon_samples), 9)
+    mon_scratch = _MonSketch(np.int32)
+    for b in mon.ws.live_buckets():
+        mon_scratch.fold_scaled(b, 1)
+    check(
+        "monitor ring bit-identical to from-scratch merge",
+        mon.ws.query() == mon_scratch, True,
+    )
+    import math as _math
+
+    mon_live = np.sort(
+        np.concatenate(sp_chunks[-4:]), kind="stable"
+    )
+    last_mon = mon_samples[-1]
+    mon_ok = all(
+        vlo <= mon_live[max(1, _math.ceil(q * mon_live.size)) - 1] <= vhi
+        for q, (vlo, vhi) in zip(last_mon.qs, last_mon.value_bounds)
+    )
+    check("monitor bounds bracket true window quantiles", mon_ok, True)
+
     # --- obs snapshot (ISSUE 6): one instrumented pipelined streaming run
     # whose record carries the numbers the ROADMAP TPU-validation sweep
     # needs — in-flight window occupancy, ingest_hidden_frac, per-pass
