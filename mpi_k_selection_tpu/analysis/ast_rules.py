@@ -1,4 +1,4 @@
-"""AST lint rules (KSL001-KSL013) — each encodes a bug class a human
+"""AST lint rules (KSL001-KSL014) — each encodes a bug class a human
 reviewer caught in this repository at least once. docs/ANALYSIS.md holds
 the catalog with the historical incident behind every rule.
 
@@ -978,3 +978,105 @@ class UnboundedMetricLabels(Rule):
             if key not in seen:
                 seen.add(key)
                 yield lineno, msg
+
+
+# ---------------------------------------------------------------------------
+# KSL014 — multiple device programs consuming one staged bucket per pass
+
+
+@register
+class MultiProgramStagedConsume(Rule):
+    id = "KSL014"
+    title = (
+        "multiple ingest device programs dispatched against one staged "
+        "bucket in streaming/ outside executor.py's sanctioned bundle"
+    )
+    rationale = (
+        "The fused single-read ingest (ops/pallas/fused_ingest.py, "
+        "ISSUE 11) exists because a staged chunk that is swept by "
+        "SEVERAL device programs per pass — a histogram dispatch here, a "
+        "compaction there — multiplies the per-pass HBM traffic of every "
+        "staged key by the program count: each dispatch is its own read "
+        "of the same pow2 bucket. streaming/executor.py owns the ONE "
+        "sanctioned multi-program bundle (the fused=\"off\" oracle, plus "
+        "the FusedIngestConsumer that collapses it to one program); a "
+        "second ingest-program dispatch over the same staged buffer "
+        "anywhere else in the streaming layer quietly reintroduces the "
+        "read amplification the fusion retired. Route new per-chunk "
+        "device work through the executor's consumer bundle (fused when "
+        "possible) instead of dispatching beside it."
+    )
+
+    #: The ingest-program dispatch surface (matched on the last dotted
+    #: segment): the histogram primitives and the executor's dispatch
+    #: helpers. Two of these against one staged variable in one function
+    #: is the read-amplification class; unrelated device calls (e.g. the
+    #: sketch's extremes fold) are out of scope — they are not reads of
+    #: the radix-ingest program family this rule gates.
+    _DISPATCHERS = {
+        "dispatch_chunk_histograms",
+        "dispatch_compaction",
+        "dispatch_fused_ingest",
+        "masked_radix_histogram",
+        "multi_masked_radix_histogram",
+    }
+    _SANCTIONED = ("streaming/executor.py",)
+
+    @staticmethod
+    def _base_name(node: ast.AST):
+        """Root Name of a Name/Attribute chain (``staged`` from
+        ``staged.data``); None for anything without a stable base."""
+        while isinstance(node, ast.Attribute):
+            node = node.value
+        return node.id if isinstance(node, ast.Name) else None
+
+    @staticmethod
+    def _own_nodes(fn: ast.AST):
+        """The nodes belonging to ``fn``'s own scope — nested function
+        defs are their own scopes and are skipped (each is visited as its
+        own function by check_module)."""
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    def check_module(self, mod: SourceModule):
+        p = pathlib.Path(mod.path).resolve().as_posix()
+        if "/streaming/" not in p or _is_test_file(mod):
+            return
+        if _path_endswith(mod, *self._SANCTIONED):
+            return  # the executor owns the sanctioned bundle
+        for defs in _function_defs(mod.tree).values():
+            for fn in defs:
+                by_base: dict[str, list[tuple[int, str]]] = {}
+                for node in self._own_nodes(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    name = dotted_name(node.func).split(".")[-1]
+                    if name not in self._DISPATCHERS:
+                        continue
+                    for arg in list(node.args) + [
+                        kw.value for kw in node.keywords
+                    ]:
+                        base = self._base_name(arg)
+                        if base is not None:
+                            by_base.setdefault(base, []).append(
+                                (node.lineno, name)
+                            )
+                            break
+                for base, calls in by_base.items():
+                    for lineno, name in sorted(calls)[1:]:
+                        yield lineno, (
+                            f"`{name}` dispatches another ingest program "
+                            f"against staged chunk `{base}` "
+                            f"({len(calls)} programs in this function — "
+                            "each one re-reads the whole staged bucket); "
+                            "route the work through streaming/executor.py"
+                            "'s consumer bundle (FusedIngestConsumer "
+                            "fuses it into ONE program per bucket)"
+                        )

@@ -239,6 +239,53 @@ def _streaming_compaction_cases():
     ]
 
 
+def _streaming_fused_ingest_cases():
+    """The fused single-read ingest program (ops/pallas/fused_ingest.py:
+    fused_ingest_core) — ONE device program per staged bucket per pass,
+    producing the multi-prefix histogram, the per-spec survivor
+    compactions, and the spill-tee union payload the unfused bundle used
+    to dispatch separately. Same contracts as its unfused parts: int32
+    histogram/count partials (the streaming counter discipline),
+    dtype-preserving compacted buffers, and a bucket-size-stable
+    primitive trail (everything data-dependent — ``n_valid``, the
+    histogram prefixes, the spec scalars — rides traced, so the program
+    compiles once per (bucket, dtype, #prefixes, #collect, #tee))."""
+    import numpy as np
+
+    from mpi_k_selection_tpu.ops.pallas.fused_ingest import fused_ingest_core
+
+    path = "mpi_k_selection_tpu/ops/pallas/fused_ingest.py"
+
+    def fused(u):
+        # the spill-pass shape: 2 surviving prefixes histogrammed, 2
+        # collect specs at distinct resolved depths, a 2-spec union tee
+        return fused_ingest_core(
+            u,
+            np.int32(u.shape[0] - 7),
+            np.asarray([0, 3], np.uint32),
+            np.asarray([24, 16], np.uint32),
+            np.asarray([0, 3], np.uint32),
+            np.asarray([24, 16], np.uint32),
+            np.asarray([0, 3], np.uint32),
+            shift=16,
+            radix_bits=8,
+            method="scatter",
+            hist_mode="multi",
+            n_collect=2,
+            n_tee=2,
+        )
+
+    return [
+        (
+            path,
+            "streaming fused ingest[uint32, 2 prefixes + 2 collect + tee]",
+            fused,
+            "uint32",
+            _STREAMING_INGEST_SIZES,
+        ),
+    ]
+
+
 @contract(
     "KSC101",
     "public selections preserve their input dtype",
@@ -429,6 +476,38 @@ def check_counter_width() -> list[Finding]:
                             f"{np.dtype(cnt.dtype)}, want the int32 "
                             "per-chunk partial")
                 )
+    # the fused single-read ingest program: its histogram half must keep
+    # the int32 per-chunk partial, every compaction part must preserve the
+    # key dtype with an int32 survivor count — the same books as its
+    # unfused parts, checked on the fused trace so the fusion cannot
+    # silently widen or narrow anything
+    for case_path, label, fn, dt, sizes in _streaming_fused_ingest_cases():
+        for n in sizes:
+            hist, collect, tee = jax.eval_shape(fn, _spec(n, dt))
+            if np.dtype(hist.dtype) != np.dtype(np.int32):
+                findings.append(
+                    Finding("KSC102", case_path, 0,
+                            f"{label} n={n}: fused histogram traced as "
+                            f"{np.dtype(hist.dtype)}, want int32")
+                )
+            for part_label, (out, cnt) in (
+                [(f"collect[{i}]", part) for i, part in enumerate(collect)]
+                + ([("tee", tee)] if tee is not None else [])
+            ):
+                if np.dtype(out.dtype) != np.dtype(dt):
+                    findings.append(
+                        Finding("KSC102", case_path, 0,
+                                f"{label} n={n}: fused {part_label} "
+                                f"compaction traced as {np.dtype(out.dtype)}, "
+                                f"want {dt}")
+                    )
+                if np.dtype(cnt.dtype) != np.dtype(np.int32):
+                    findings.append(
+                        Finding("KSC102", case_path, 0,
+                                f"{label} n={n}: fused {part_label} count "
+                                f"traced as {np.dtype(cnt.dtype)}, want the "
+                                "int32 per-chunk partial")
+                    )
     # host-merge side (numpy method — host-only, nothing touches a device):
     # both the single- and multi-prefix merge inputs must already be int64
     kdt = np.dtype(np.uint32)
@@ -501,6 +580,10 @@ def check_jaxpr_stability() -> list[Finding]:
     cases += _streaming_ingest_cases()
     cases += _streaming_collect_mask_cases()
     cases += _streaming_compaction_cases()
+    # the fused single-read program at both staging buckets: a trail
+    # divergence would mean the fusion recompiles per bucket — exactly the
+    # per-pass compile discipline it inherits from its unfused parts
+    cases += _streaming_fused_ingest_cases()
     for path, label, fn, dt, (n1, n2) in cases:
         t1 = _primitive_trail(jax.make_jaxpr(fn)(_spec(n1, dt)))
         t2 = _primitive_trail(jax.make_jaxpr(fn)(_spec(n2, dt)))

@@ -311,6 +311,11 @@ def kselect_streaming(source, k, **kwargs):
     p-wide FIFO window pops, so multi-device collect/spill passes scale
     like the histogram passes instead of serializing on per-chunk eager
     gathers; ``"off"`` is the historical eager path, bit-identical.
+    ``fused`` (default ``"auto"``) collapses each deferred pass's
+    per-chunk device programs — histogram, survivor compactions,
+    spill-tee payload — into ONE program per staged bucket
+    (ops/pallas/fused_ingest.py), so every staged key is read once per
+    pass; ``"off"`` keeps the unfused bundle as the bit-for-bit oracle.
     ``retry`` arms the resilience policies (docs/ROBUSTNESS.md; default
     on): transient source errors re-pull mid-pass, staging transfers
     retry in place, failed passes re-run from the previous spill
@@ -328,7 +333,7 @@ def kselect_streaming(source, k, **kwargs):
     streaming/chunked.py:streaming_kselect for the full option set
     (``radix_bits``, ``hist_method``, ``collect_budget``, ``sketch``,
     ``pipeline_depth``, ``timer``, ``devices``, ``spill``, ``spill_dir``,
-    ``deferred``, ``retry``, ``obs``)."""
+    ``deferred``, ``fused``, ``retry``, ``obs``)."""
     from mpi_k_selection_tpu.streaming.chunked import streaming_kselect
 
     return streaming_kselect(source, k, **kwargs)
@@ -353,7 +358,9 @@ class StreamingQuantiles:
     executor discipline for the exact refinement passes
     (streaming/executor.py; default auto = deferred device-side
     compaction, ``"off"`` the historical eager gathers — bit-identical
-    either way)."""
+    either way) and ``fused`` whether those passes run as ONE device
+    program per staged bucket (ops/pallas/fused_ingest.py; default auto,
+    ``"off"`` the unfused oracle — bit-identical)."""
 
     def __init__(
         self,
@@ -364,11 +371,14 @@ class StreamingQuantiles:
         pipeline_depth: int | None = None,
         devices=None,
         deferred=None,
+        fused=None,
         obs=None,
     ):
         from mpi_k_selection_tpu.streaming.executor import (
             DEFAULT_DEFERRED,
+            DEFAULT_FUSED,
             resolve_deferred,
+            resolve_fused,
         )
         from mpi_k_selection_tpu.streaming.pipeline import (
             resolve_stream_devices,
@@ -383,6 +393,10 @@ class StreamingQuantiles:
         #: (streaming/executor.py; None resolves to the package default)
         self.deferred = DEFAULT_DEFERRED if deferred is None else deferred
         resolve_deferred(self.deferred)  # validate eagerly, like depth
+        #: single-read fused ingest for the refinement passes
+        #: (ops/pallas/fused_ingest.py; None resolves to the default)
+        self.fused = DEFAULT_FUSED if fused is None else fused
+        resolve_fused(self.fused)  # validate eagerly, like depth
         #: optional Observability bundle threaded through update_stream
         #: and refine_quantiles (off = None, the default)
         self.obs = obs
@@ -421,6 +435,7 @@ class StreamingQuantiles:
             pipeline_depth=self.pipeline_depth,
             devices=self.devices,
             deferred=self.deferred,
+            fused=self.fused,
             obs=self.obs,
         )
         out.sketch = self.sketch.merge(
@@ -452,6 +467,7 @@ class StreamingQuantiles:
             pipeline_depth=self.pipeline_depth,
             devices=self.devices,
             deferred=self.deferred,
+            fused=self.fused,
             obs=self.obs,
         )
 

@@ -160,6 +160,15 @@ def build_parser() -> argparse.ArgumentParser:
         "time. Answers are bit-identical in every mode",
     )
     p.add_argument(
+        "--fused", choices=("auto", "off"), default="auto",
+        help="--streaming single-read ingest (ops/pallas/fused_ingest.py): "
+        "auto (default) fuses each deferred pass's per-chunk device "
+        "programs — histogram, survivor compactions, spill-tee payload — "
+        "into ONE program per staged bucket, so every staged key is read "
+        "once per pass; off keeps the unfused consumer bundle (the "
+        "bit-for-bit oracle). Answers are bit-identical in every mode",
+    )
+    p.add_argument(
         "--retry", choices=("default", "off"), default="default",
         help="--streaming resilience policies (faults/, docs/ROBUSTNESS.md): "
         "default = bounded retry (3 attempts, exponential backoff) for "
@@ -443,6 +452,7 @@ def _run_streaming(args, obs=None):
         spill=spill_store if spill_store is not None else args.spill,
         spill_dir=args.spill_dir,
         deferred=args.deferred,
+        fused=args.fused,
         retry=args.retry,
         obs=obs,
     )
@@ -467,6 +477,7 @@ def _run_streaming(args, obs=None):
         record.extra["ingest_devices"] = n_ingest
         record.extra["spill"] = args.spill
         record.extra["deferred"] = args.deferred
+        record.extra["fused"] = args.fused
         record.extra["retry"] = args.retry
         if injector is not None:
             record.extra["chaos"] = {

@@ -123,16 +123,23 @@ class _BucketFoldConsumer:
     advance, so a bucket boundary can never split a dispatch/fold
     pair); host/device-resident chunks fold inline."""
 
-    def __init__(self, ws: WindowedSketch):
+    def __init__(self, ws: WindowedSketch, obs=None):
         self._ws = ws
+        self._obs = obs
         self.staged_chunks = 0
 
     def dispatch(self, keys, kv):
+        from mpi_k_selection_tpu.obs import wiring as _bw
         from mpi_k_selection_tpu.streaming import pipeline as _pl
 
         cur = self._ws.current
         if isinstance(keys, _pl.StagedKeys):
             self.staged_chunks += 1
+            # two device programs per staged bucket (deep histogram +
+            # extremes), same as the sketch consumer — keeps the
+            # bucket_read_bytes / staged_bytes amplification honest for
+            # monitor runs too
+            _bw.bucket_read(self._obs, "monitor", keys, 2)
             return cur, cur._dispatch_staged(keys)
         if not isinstance(kv, np.ndarray):
             kv = np.asarray(kv)
@@ -271,7 +278,7 @@ class Monitor:
         self.ws = self._make_window(dtype)
         src = as_chunk_source(source, one_shot_ok=True)
         timer, _restore = _wr.attach_timer(self.obs, timer)
-        consumer = _BucketFoldConsumer(self.ws)
+        consumer = _BucketFoldConsumer(self.ws, obs=self.obs)
         ex = _exec.StreamExecutor(
             [consumer], window=len(devs),
             occupancy=_wr.window_occupancy(self.obs, phase="monitor"),

@@ -94,6 +94,31 @@ def window_occupancy(obs, phase: str | None = None):
     )
 
 
+def bucket_read(obs, phase: str, staged, programs: int = 1):
+    """Count ``programs`` device-program dispatches consuming one staged
+    bucket — sampled at DISPATCH time, so ``ingest.bucket_reads{phase}``
+    (and its byte twin ``ingest.bucket_read_bytes{phase}``) measure the
+    reads-per-pass multiplier the fused single-read ingest collapses:
+    an unfused spill pass reads each bucket for the histogram AND the
+    tee (2 programs), an unfused collect pass once per spec; the fused
+    program (phase ``"fused"``) reads it exactly once. ``phase``
+    partitions over the closed consumer set (``histogram`` | ``collect``
+    | ``tee`` | ``certificate`` | ``sketch`` | ``monitor`` | ``fused``).
+    Byte counts
+    are the PADDED bucket bytes (what the program actually sweeps), the
+    same unit as ``ingest.staged_bytes`` — so ``bucket_read_bytes /
+    staged_bytes`` is the per-pass read amplification. Pure host
+    observation; no-op when metrics are off."""
+    if obs is None or obs.metrics is None:
+        return
+    nbytes = (
+        int(staged.data.shape[0]) * staged.data.dtype.itemsize * int(programs)
+    )
+    lab = {"phase": phase}
+    obs.metrics.counter("ingest.bucket_reads", labels=lab).inc(int(programs))
+    obs.metrics.counter("ingest.bucket_read_bytes", labels=lab).inc(nbytes)
+
+
 def attach_timer(obs, timer):
     """Resolve the (timer, recorder) wiring: with span tracing on, every
     phase needs a PhaseTimer to timestamp it — create one if the caller
@@ -148,3 +173,10 @@ def chunk_event(obs, pass_index, chunk_index, keys, kdt, devs):
         lab = {"device": dev}
         obs.metrics.counter("ingest.chunks", labels=lab).inc()
         obs.metrics.counter("ingest.bytes", labels=lab).inc(nbytes)
+        if staged:
+            # the PADDED bucket bytes that landed on device — the
+            # denominator of the bucket_read_bytes / staged_bytes read
+            # amplification (see bucket_read above)
+            obs.metrics.counter("ingest.staged_bytes").inc(
+                int(keys.data.shape[0]) * keys.data.dtype.itemsize
+            )

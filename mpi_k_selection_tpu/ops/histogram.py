@@ -21,6 +21,13 @@ Methods:
 
 Counts use ``count_dtype`` (int32 by default — exact for n < 2^31; pass int64
 under x64 for larger n, per SURVEY.md §7 "int overflow hygiene").
+
+Composition note: the streaming descent's fused single-read ingest
+(ops/pallas/fused_ingest.py) calls :func:`multi_masked_radix_histogram`
+INSIDE its one-program-per-staged-bucket trace, alongside the survivor
+compactions — the histogram sub-jaxpr is identical either way, which is
+what makes the fused and unfused paths bit-interchangeable (the
+``fused="off"`` oracle in streaming/executor.py).
 """
 
 from __future__ import annotations

@@ -1,0 +1,215 @@
+"""Fused single-read ingest program — one device program per staged
+bucket per streamed pass.
+
+Before this module, a staged chunk (streaming/pipeline.py:StagedKeys) was
+read by up to THREE separate device programs per radix pass: the digit
+histogram (ops/histogram.py via streaming/executor.py:
+dispatch_chunk_histograms), the deferred survivor compaction
+(``compact_core`` below, one dispatch per collect spec), and the spill
+tee's union-mask compaction. Each program is its own XLA dispatch over the
+same pow2-padded buffer — on the out-of-core hot path that multiplies the
+per-pass HBM traffic of every staged key by the consumer count, exactly
+the bandwidth the reference CGM protocol's one-scan-per-round discipline
+exists to avoid (PAPER.md; the ROADMAP's "fused single-read ingest"
+item).
+
+:func:`fused_ingest_core` computes every per-chunk device product the
+streamed descent needs in ONE program: the (multi-prefix) radix
+histogram, a fixed-shape ``(compacted survivors, int32 count)`` pair per
+survivor-collect spec, and — when a spill tee is armed — the compacted
+union-of-specs payload the ``SpillWriter`` appends. Everything
+data-dependent (``n_valid``, the histogram prefixes, the ``(shift,
+prefix)`` spec scalars) rides as traced values, so the program compiles
+once per (bucket, dtype, #hist-prefixes, #collect-specs, #tee-specs) —
+the same KSC103 trail-stability discipline as the unfused programs, which
+the contract grid (analysis/jaxpr_checks.py:_streaming_fused_ingest_cases)
+pins at both staging buckets.
+
+Bit-equality with the unfused bundle is by construction: the histogram
+half calls the very same ``masked_radix_histogram`` /
+``multi_masked_radix_histogram`` primitives over the same padded buffer
+(integer counts; the host pad correction is applied at finish exactly as
+for the unfused dispatch), and the compaction halves are
+:func:`compact_core` — the single program the unfused deferred executor
+already dispatches per spec — evaluated on the same traced scalars.
+``fused="off"`` (streaming/executor.py) keeps the unfused bundle as the
+bit-for-bit oracle.
+
+Like the histogram kernels, this module runs identically on CPU (the
+pallas kernels interpret; the jit program is plain XLA elsewhere) — the
+fusion is a dispatch/read-count contract, observable through the
+``ingest.bucket_reads{phase}`` counter (obs/wiring.py:bucket_read) and
+the KSL014 lint rule, not a TPU-only code path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def compact_core(data, n_valid, shifts, prefixes):
+    """mask -> count -> fixed-shape compaction over one padded staging
+    bucket: survivors (keys matching ANY ``(shift, prefix)`` spec, pad
+    lanes masked out) are scattered to the FRONT of a bucket-shaped
+    output, in chunk order, alongside their int32 count. Everything
+    data-dependent (``n_valid``, the spec scalars) rides as traced
+    values, so the program compiles once per (bucket, dtype, #specs) —
+    the same discipline as the staged histogram — and its primitive
+    trail is size-stable (KSC103). Only ``#specs`` is baked into the
+    trace (the union loop unrolls over it), and a pass's spec count is
+    fixed for every chunk of that pass."""
+    import jax
+    import jax.numpy as jnp
+
+    m = None
+    for j in range(shifts.shape[0]):
+        mj = jax.lax.shift_right_logical(data, shifts[j]) == prefixes[j]
+        m = mj if m is None else (m | mj)
+    m = m & (jax.lax.iota(jnp.int32, data.shape[0]) < n_valid)
+    mi = m.astype(jnp.int32)
+    pos = jnp.cumsum(mi) - 1  # survivor j's target slot (int32: bucket < 2^31)
+    tgt = jnp.where(m, pos, jnp.int32(data.shape[0]))  # non-survivors drop OOB
+    out = jnp.zeros(data.shape, data.dtype).at[tgt].set(data, mode="drop")
+    return out, jnp.sum(mi)
+
+
+def fused_ingest_core(
+    data,
+    n_valid,
+    hist_prefixes,
+    c_shifts,
+    c_prefixes,
+    t_shifts,
+    t_prefixes,
+    *,
+    shift,
+    radix_bits,
+    method,
+    hist_mode,
+    n_collect,
+    n_tee,
+):
+    """ONE sweep of a padded staging bucket producing every per-chunk
+    device product of a streamed pass:
+
+    - ``hist``: the int32 digit histogram(s) at ``shift`` — ``(K, 2**rb)``
+      for ``hist_mode="multi"`` (one row per traced prefix in
+      ``hist_prefixes``), ``None`` for ``hist_mode="none"`` (the collect
+      pass carries no histogram). The exact per-chunk accumulator the
+      unfused staged dispatch produces (ops/histogram.py over the whole
+      padded buffer; pad corrected host-side at finish).
+    - ``collect``: a tuple of ``n_collect`` ``(compacted, count)`` pairs,
+      one :func:`compact_core` per single collect spec — byte-identical
+      to the unfused per-spec dispatches (``c_shifts``/``c_prefixes``
+      hold the spec scalars, traced).
+    - ``tee``: the union-of-``n_tee``-specs :func:`compact_core` pair the
+      spill tee appends (``None`` when no tee is armed).
+
+    ``hist_mode``, ``n_collect`` and ``n_tee`` are the only structural
+    (static) parameters besides the kernel geometry — a pass's spec
+    counts are fixed across its chunks, so the program compiles once per
+    (bucket, dtype, #hist-prefixes, #collect, #tee) and its primitive
+    trail is bucket-size-stable (KSC102/KSC103 grid coverage)."""
+    import jax.numpy as jnp
+
+    from mpi_k_selection_tpu.ops.histogram import multi_masked_radix_histogram
+
+    if hist_mode not in ("none", "multi"):
+        raise ValueError(f"unknown hist_mode {hist_mode!r}")
+    hist = None
+    if hist_mode == "multi":
+        # the very call the unfused staged dispatch makes
+        # (streaming/executor.py:dispatch_chunk_histograms): shared-sweep
+        # multi-prefix counts over the WHOLE padded buffer, int32
+        hist = multi_masked_radix_histogram(
+            data,
+            shift=shift,
+            radix_bits=radix_bits,
+            prefixes=hist_prefixes,
+            method=method,
+            count_dtype=jnp.int32,
+        )
+    collect = tuple(
+        compact_core(data, n_valid, c_shifts[j : j + 1], c_prefixes[j : j + 1])
+        for j in range(n_collect)
+    )
+    tee = compact_core(data, n_valid, t_shifts, t_prefixes) if n_tee else None
+    return hist, collect, tee
+
+
+_FUSED_FN = None
+
+
+def _fused_fn():
+    global _FUSED_FN
+    if _FUSED_FN is None:
+        import jax
+
+        _FUSED_FN = jax.jit(
+            fused_ingest_core,
+            static_argnames=(
+                "shift", "radix_bits", "method", "hist_mode",
+                "n_collect", "n_tee",
+            ),
+        )
+    return _FUSED_FN
+
+
+def _spec_arrays(specs, kdt, total_bits):
+    """``(shifts, prefixes)`` concrete arrays for a ``(resolved_bits,
+    prefix)`` spec list — the traced scalars :func:`compact_core`
+    consumes (empty pair for no specs)."""
+    if not specs:
+        return (np.empty((0,), kdt), np.empty((0,), kdt))
+    shifts = np.asarray([total_bits - r for r, _ in specs], kdt)
+    prefixes = np.asarray([p for _, p in specs], kdt)
+    return shifts, prefixes
+
+
+def dispatch_fused_ingest(
+    staged,
+    *,
+    kdt,
+    total_bits,
+    shift=None,
+    radix_bits=None,
+    hist_prefixes=None,
+    method=None,
+    collect_specs=(),
+    tee_specs=(),
+):
+    """Launch the fused program for one staged chunk on its OWN device
+    (async dispatch — ``staged.data`` is committed, so the program runs
+    where the chunk lives). ``hist_prefixes`` is the pass's surviving
+    prefix list (``None`` = no histogram: the collect pass);
+    ``collect_specs``/``tee_specs`` are ``(resolved_bits, prefix)``
+    lists. Returns the in-flight ``(hist, collect, tee)`` handle whose
+    parts the :class:`~mpi_k_selection_tpu.streaming.executor.
+    FusedIngestConsumer` materializes at FIFO-finish time."""
+    if hist_prefixes is not None:
+        hist_mode = "multi"
+        hp = np.asarray(list(hist_prefixes), kdt)
+        hshift, hrb, hmethod = shift, radix_bits, method
+    else:
+        hist_mode = "none"
+        hp = np.empty((0,), kdt)
+        # structural placeholders: unused by the "none" trace, but static
+        # jit keys — pin them so collect-only passes share one cache line
+        hshift, hrb, hmethod = 0, 1, "scatter"
+    c_shifts, c_prefixes = _spec_arrays(list(collect_specs), kdt, total_bits)
+    t_shifts, t_prefixes = _spec_arrays(list(tee_specs), kdt, total_bits)
+    return _fused_fn()(
+        staged.data,
+        np.int32(staged.n_valid),
+        hp,
+        c_shifts,
+        c_prefixes,
+        t_shifts,
+        t_prefixes,
+        shift=hshift,
+        radix_bits=hrb,
+        method=hmethod,
+        hist_mode=hist_mode,
+        n_collect=len(collect_specs),
+        n_tee=len(tee_specs),
+    )

@@ -37,9 +37,12 @@ from mpi_k_selection_tpu.streaming.chunked import (
 )
 from mpi_k_selection_tpu.streaming.executor import (
     DEFAULT_DEFERRED,
+    DEFAULT_FUSED,
+    FusedIngestConsumer,
     StreamExecutor,
     collect_hidden_frac,
     resolve_deferred,
+    resolve_fused,
 )
 from mpi_k_selection_tpu.streaming.pipeline import (
     DEFAULT_PIPELINE_DEPTH,
@@ -49,6 +52,7 @@ from mpi_k_selection_tpu.streaming.pipeline import (
     ingest_hidden_frac,
     live_staged_keys,
     resolve_stream_devices,
+    stage_device_keys,
 )
 from mpi_k_selection_tpu.streaming.sketch import RadixSketch
 from mpi_k_selection_tpu.streaming.spill import (
@@ -61,8 +65,10 @@ from mpi_k_selection_tpu.streaming.spill import (
 __all__ = [
     "ChunkPipeline",
     "DEFAULT_DEFERRED",
+    "DEFAULT_FUSED",
     "DEFAULT_PIPELINE_DEPTH",
     "DEFAULT_SPILL",
+    "FusedIngestConsumer",
     "RadixSketch",
     "SPILL_DIR_PREFIX",
     "SPILL_MODES",
@@ -76,7 +82,9 @@ __all__ = [
     "ingest_hidden_frac",
     "live_staged_keys",
     "resolve_deferred",
+    "resolve_fused",
     "resolve_stream_devices",
+    "stage_device_keys",
     "streaming_kselect",
     "streaming_kselect_many",
     "streaming_rank_certificate",

@@ -84,7 +84,7 @@ from mpi_k_selection_tpu.obs import wiring as _wr
 from mpi_k_selection_tpu.streaming import executor as _ex
 from mpi_k_selection_tpu.streaming import pipeline as _pl
 from mpi_k_selection_tpu.streaming import spill as _sp
-from mpi_k_selection_tpu.streaming.executor import DEFAULT_DEFERRED
+from mpi_k_selection_tpu.streaming.executor import DEFAULT_DEFERRED, DEFAULT_FUSED
 from mpi_k_selection_tpu.streaming.pipeline import DEFAULT_PIPELINE_DEPTH, StagedKeys
 from mpi_k_selection_tpu.utils import dtypes as _dt
 
@@ -435,7 +435,8 @@ def _recover_pass(
 
 def _collect_survivors(
     src, dtype, specs, *, pipeline_depth=0, timer=None, devices=None,
-    hist_method=None, obs=None, read_from="source", deferred=True, retry=None,
+    hist_method=None, obs=None, read_from="source", deferred=True,
+    fused=False, retry=None,
 ):
     """One streamed pass collecting survivors for EVERY ``(resolved_bits,
     prefix) -> expected population`` spec at once — the shared finish of
@@ -454,18 +455,29 @@ def _collect_survivors(
     FIFO window pops (streaming/executor.py) — the consumer never blocks
     per chunk, which is what lets the collect pass scale with devices
     like the histogram passes. ``deferred=False`` keeps the historical
-    eager boolean gather. Survivor multisets are identical either way
-    (and the final ``np.partition`` is order-invariant regardless)."""
+    eager boolean gather. ``fused`` (resolved by the caller; implies
+    deferral) collapses the per-spec compaction dispatches into ONE
+    fused program per staged bucket (streaming/executor.py:
+    FusedIngestConsumer) — one read of each staged chunk instead of one
+    per spec. Survivor multisets are identical in every mode (and the
+    final ``np.partition`` is order-invariant regardless)."""
     kdt = np.dtype(_dt.key_dtype(dtype))
     total_bits = _dt.key_bits(dtype)
     devs = _pl.resolve_stream_devices(devices)
     multi = len(devs) > 1 and _pl.validate_pipeline_depth(pipeline_depth) > 0
     sorted_specs = sorted(specs)
     collector = _ex.CollectConsumer(
-        sorted_specs, kdt, total_bits, deferred=deferred
+        sorted_specs, kdt, total_bits, deferred=deferred, obs=obs
+    )
+    consumer = (
+        _ex.FusedIngestConsumer(
+            collect=collector, kdt=kdt, total_bits=total_bits, obs=obs
+        )
+        if fused
+        else collector
     )
     ex = _ex.StreamExecutor(
-        [collector], window=len(devs) if multi else 1,
+        [consumer], window=len(devs) if multi else 1,
         occupancy=_wr.window_occupancy(obs, phase="collect"),
     )
     chunk_i = keys_read = 0
@@ -572,6 +584,7 @@ def streaming_kselect(
     spill=DEFAULT_SPILL,
     spill_dir=None,
     deferred=DEFAULT_DEFERRED,
+    fused=DEFAULT_FUSED,
     retry=None,
     obs=None,
 ):
@@ -635,7 +648,21 @@ def streaming_kselect(
     chunk-arrival time. Answers are bit-identical across the whole
     devices x pipeline_depth x spill x deferred grid; host chunks and
     the host-exact routes (64-bit-no-x64, f64-on-TPU) never stage and so
-    bypass deferral by construction.
+    bypass deferral by construction. Device-resident source chunks ARE
+    staged (pow2-padded on their own device, no transfer) whenever a
+    device method consumes them, so they ride the same deferred
+    discipline instead of the retired eager gather.
+
+    ``fused`` (default ``"auto"``) collapses the per-chunk device
+    programs of each deferred pass — the digit histogram, the survivor
+    compactions, the spill-tee payload — into ONE fused program per
+    staged bucket (ops/pallas/fused_ingest.py), so every staged key is
+    read once per pass instead of once per consumer. ``"off"`` keeps the
+    unfused consumer bundle as the bit-for-bit oracle; with
+    ``deferred="off"`` the bundle is unfused regardless (fusion is a
+    deferral discipline). Answers are bit-identical in every mode;
+    ``ingest.bucket_reads{phase}`` (docs/OBSERVABILITY.md) makes the
+    reads-per-pass collapse measurable.
 
     ``retry`` configures the resilience policies (see
     :func:`streaming_kselect_many` and docs/ROBUSTNESS.md): ``None`` =
@@ -664,6 +691,7 @@ def streaming_kselect(
         spill=spill,
         spill_dir=spill_dir,
         deferred=deferred,
+        fused=fused,
         retry=retry,
         obs=obs,
     )[0]
@@ -683,6 +711,7 @@ def streaming_kselect_many(
     spill=DEFAULT_SPILL,
     spill_dir=None,
     deferred=DEFAULT_DEFERRED,
+    fused=DEFAULT_FUSED,
     retry=None,
     obs=None,
 ):
@@ -707,7 +736,10 @@ def streaming_kselect_many(
     ``deferred`` the tee's filter rides the same executor window as the
     histogram dispatches (one device-side compaction per staged chunk,
     record written at FIFO-finish time), so the spill pass no longer
-    serializes on per-chunk gathers.
+    serializes on per-chunk gathers — and under ``fused`` (default
+    ``"auto"``; see :func:`streaming_kselect`) the tee compaction and the
+    histogram are ONE program per staged bucket, so each spilled key is
+    read once per pass.
 
     ``retry`` governs the resilience policies (faults/policy.py;
     docs/ROBUSTNESS.md): ``None`` = the package default
@@ -731,6 +763,9 @@ def streaming_kselect_many(
     pipeline_depth = _pl.validate_pipeline_depth(pipeline_depth)
     devs = _pl.resolve_stream_devices(devices)
     defer = _ex.resolve_deferred(deferred)
+    # fusion is a deferral discipline: the fused handle materializes at
+    # window-pop time, so deferred="off" implies the unfused eager bundle
+    fuse = _ex.resolve_fused(fused) and defer
     policy = _fp.resolve_retry(retry)
     timer, _restore_recorder = _wr.attach_timer(obs, timer)
     occupancy = _wr.window_occupancy(obs, phase="descent")
@@ -890,7 +925,8 @@ def streaming_kselect_many(
                                 method = resolve_stream_hist(hist_method, dtype)
                                 shift0 = total_bits - radix_bits
                                 hist_c = _ex.HistogramConsumer(
-                                    shift0, radix_bits, [None], method, kdt
+                                    shift0, radix_bits, [None], method, kdt,
+                                    obs=obs,
                                 )
                                 ex = _ex.StreamExecutor(
                                     [hist_c], window=window, occupancy=occupancy
@@ -1042,19 +1078,33 @@ def streaming_kselect_many(
                 # its eager form writes before the histogram handle can
                 # finish) and the histogram dispatch share the FIFO
                 # window, and the staged buffer is released when the LAST
-                # of the two results materializes — not before
+                # of the two results materializes — not before. Under
+                # ``fused`` the tee + histogram collapse further into ONE
+                # device program per staged bucket (the single-read
+                # ingest, ops/pallas/fused_ingest.py) — the unfused
+                # bundle stays the bit-for-bit oracle (fused="off")
                 hist_c = _ex.HistogramConsumer(
-                    shift, radix_bits, prefixes, method, kdt
+                    shift, radix_bits, prefixes, method, kdt, obs=obs
                 )
-                consumers = [hist_c]
-                if writer is not None:
-                    consumers.insert(
-                        0,
-                        _ex.SpillTeeConsumer(
-                            writer, filter_specs, dtype, kdt, total_bits,
-                            devs, deferred=defer,
-                        ),
+                tee_c = (
+                    _ex.SpillTeeConsumer(
+                        writer, filter_specs, dtype, kdt, total_bits,
+                        devs, deferred=defer, obs=obs,
                     )
+                    if writer is not None
+                    else None
+                )
+                if tee_c is not None and fuse:
+                    consumers = [
+                        _ex.FusedIngestConsumer(
+                            hist=hist_c, tee=tee_c, kdt=kdt,
+                            total_bits=total_bits, obs=obs,
+                        )
+                    ]
+                elif tee_c is not None:
+                    consumers = [tee_c, hist_c]
+                else:
+                    consumers = [hist_c]
                 ex = _ex.StreamExecutor(
                     consumers, window=window, occupancy=occupancy
                 )
@@ -1183,7 +1233,7 @@ def streaming_kselect_many(
                         timer=timer, devices=None if devices is None else devs,
                         hist_method=method, obs=obs,
                         read_from=read_from,
-                        deferred=defer, retry=policy,
+                        deferred=defer, fused=fuse, retry=policy,
                     ),
                     read_from,
                     int(kr),
@@ -1286,7 +1336,9 @@ def streaming_rank_certificate(
                         np.asarray([value], np.dtype(chunk.dtype))
                     )[0]
                     kdt = np.dtype(_dt.key_dtype(np.dtype(chunk.dtype)))
-                    counter = _ex.CountLessLeqConsumer(vkey, kdt, deferred=defer)
+                    counter = _ex.CountLessLeqConsumer(
+                        vkey, kdt, deferred=defer, obs=obs
+                    )
                     # both counts dispatch async on the chunk's own device;
                     # the FIFO materializes the oldest once one bundle per
                     # device is in flight (deferred: over the whole padded

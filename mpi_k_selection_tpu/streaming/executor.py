@@ -51,6 +51,15 @@ Determinism contract (the grid tests/test_executor.py enforces):
   makes ``deferred="off"`` a bit-for-bit oracle rather than a near
   re-implementation.
 
+On top of the deferral, the ``fused`` knob (default ``"auto"``)
+collapses a pass's per-chunk device programs — the histogram, the
+per-spec survivor compactions, the spill-tee payload — into ONE fused
+program per staged bucket (:class:`FusedIngestConsumer` +
+ops/pallas/fused_ingest.py), so every staged key is read once per pass
+instead of once per consumer; ``fused="off"`` keeps the unfused bundle
+as the bit-for-bit oracle, and lint rule KSL014 flags a second ingest
+program against one staged bucket anywhere else in the streaming layer.
+
 This file is the ONE sanctioned home for the eager
 ``np.asarray(<indexed device array>)`` gather under ``streaming/`` —
 lint rule KSL011 flags it anywhere else in the streaming layer, because
@@ -63,6 +72,10 @@ from __future__ import annotations
 import numpy as np
 
 from mpi_k_selection_tpu.obs import wiring as _wr
+from mpi_k_selection_tpu.ops.pallas import fused_ingest as _fi
+from mpi_k_selection_tpu.ops.pallas.fused_ingest import (
+    compact_core as _compact_core,
+)
 from mpi_k_selection_tpu.streaming import pipeline as _pl
 from mpi_k_selection_tpu.streaming.pipeline import StagedKeys, _bucket_elems
 
@@ -75,6 +88,35 @@ DEFAULT_DEFERRED = "auto"
 
 #: The ``deferred`` knob's string modes (bools are also accepted).
 DEFERRED_MODES = ("auto", "on", "off")
+
+#: Default for the ``fused`` knob: fuse the per-chunk device programs —
+#: histogram, survivor compaction(s), spill-tee payload — into ONE
+#: program per staged bucket wherever deferral is engaged (bit-identical,
+#: strictly fewer reads of the same buffer). ``"off"`` keeps the unfused
+#: consumer bundle as the bit-for-bit oracle.
+DEFAULT_FUSED = "auto"
+
+#: The ``fused`` knob's string modes (bools are also accepted).
+FUSED_MODES = ("auto", "off")
+
+
+def resolve_fused(fused) -> bool:
+    """Normalize the ``fused`` knob to a bool (True = the fused
+    single-read ingest program replaces the per-consumer device dispatches
+    for staged chunks). Accepts ``"auto"``/``"off"`` or a plain bool;
+    ``"auto"`` (the default) fuses wherever deferral is engaged — fusion
+    IS a deferral discipline, so ``deferred="off"`` implies the unfused
+    bundle regardless (the resolution in streaming/chunked.py ANDs the
+    two)."""
+    if isinstance(fused, (bool, np.bool_)):
+        return bool(fused)
+    if fused == "auto":
+        return True
+    if fused == "off":
+        return False
+    raise ValueError(
+        f"fused must be one of {FUSED_MODES} or a bool, got {fused!r}"
+    )
 
 
 def resolve_deferred(deferred) -> bool:
@@ -111,34 +153,11 @@ def prefix_mask(kv, resolved, prefix, kdt, total_bits):
 
 
 # ---------------------------------------------------------------------------
-# the deferred compaction program
-
-
-def _compact_core(data, n_valid, shifts, prefixes):
-    """mask -> count -> fixed-shape compaction over one padded staging
-    bucket: survivors (keys matching ANY ``(shift, prefix)`` spec, pad
-    lanes masked out) are scattered to the FRONT of a bucket-shaped
-    output, in chunk order, alongside their int32 count. Everything
-    data-dependent (``n_valid``, the spec scalars) rides as traced
-    values, so the program compiles once per (bucket, dtype, #specs) —
-    the same discipline as the staged histogram — and its primitive
-    trail is size-stable (KSC103). Only ``#specs`` is baked into the
-    trace (the union loop unrolls over it), and a pass's spec count is
-    fixed for every chunk of that pass."""
-    import jax
-    import jax.numpy as jnp
-
-    m = None
-    for j in range(shifts.shape[0]):
-        mj = jax.lax.shift_right_logical(data, shifts[j]) == prefixes[j]
-        m = mj if m is None else (m | mj)
-    m = m & (jax.lax.iota(jnp.int32, data.shape[0]) < n_valid)
-    mi = m.astype(jnp.int32)
-    pos = jnp.cumsum(mi) - 1  # survivor j's target slot (int32: bucket < 2^31)
-    tgt = jnp.where(m, pos, jnp.int32(data.shape[0]))  # non-survivors drop OOB
-    out = jnp.zeros(data.shape, data.dtype).at[tgt].set(data, mode="drop")
-    return out, jnp.sum(mi)
-
+# the deferred compaction program — the core lives with the fused-ingest
+# kernel (ops/pallas/fused_ingest.py:compact_core, aliased above), which
+# unions it with the histogram into ONE program per staged bucket; the
+# alias keeps the executor the import surface the contract checks and
+# tests address
 
 _COMPACT_FN = None
 
@@ -302,13 +321,28 @@ def chunk_histograms(keys, shift, radix_bits, prefixes, method, kdt):
 # consumers
 
 
+def eager_valid(kv):
+    """The valid (unpadded) view an EAGER consumer reads off its ``kv``:
+    a staged chunk's device slice, derived ON DEMAND — deferred/fused
+    paths consume ``keys.data`` whole and never touch it, so the slice
+    (a real device program over the padded bucket) is dispatched only
+    when an eager path will actually read it."""
+    return kv.valid() if isinstance(kv, StagedKeys) else kv
+
+
 class Consumer:
     """One per-chunk consumer under the executor: ``dispatch`` launches
     (or, for host/eager work, completes) a chunk's work and returns an
     in-flight handle — or ``None`` when everything already folded;
     ``finish`` materializes a pending handle host-side, strictly in chunk
     FIFO order. Implementations fold into their own accumulators; the
-    executor owns buffer lifetime (``StagedKeys.release()``)."""
+    executor owns buffer lifetime (``StagedKeys.release()``).
+
+    ``dispatch(keys, kv)``: ``kv`` is the chunk's keys on their own
+    residency (host numpy, or a device array) — EXCEPT for staged chunks,
+    where it is the :class:`StagedKeys` itself and an eager path derives
+    the valid slice via :func:`eager_valid` (deferred paths read the
+    whole padded ``keys.data`` and apply the exact pad correction)."""
 
     def dispatch(self, keys, kv):  # pragma: no cover - protocol
         raise NotImplementedError
@@ -324,14 +358,17 @@ class HistogramConsumer(Consumer):
     belt and braces, and keeps the replay-stability diagnostics
     reproducible)."""
 
-    def __init__(self, shift, radix_bits, prefixes, method, kdt):
+    def __init__(self, shift, radix_bits, prefixes, method, kdt, obs=None):
         self.hists = {
             p: np.zeros((1 << radix_bits,), np.int64) for p in prefixes
         }
         self._args = (shift, radix_bits, list(prefixes), method, kdt)
+        self._obs = obs
 
     def dispatch(self, keys, kv):
         shift, radix_bits, prefixes, method, kdt = self._args
+        if isinstance(keys, StagedKeys) and method != "numpy":
+            _wr.bucket_read(self._obs, "histogram", keys)
         handle = dispatch_chunk_histograms(
             keys, shift, radix_bits, prefixes, method, kdt
         )
@@ -356,19 +393,25 @@ class CollectConsumer(Consumer):
     (``deferred="off"``, host chunks, unstaged device chunks): the
     historical gather at dispatch time."""
 
-    def __init__(self, specs, kdt, total_bits, *, deferred: bool):
+    def __init__(self, specs, kdt, total_bits, *, deferred: bool, obs=None):
         self.specs = list(specs)
         self.out = {s: [] for s in self.specs}
         self._kdt = kdt
         self._bits = total_bits
         self._deferred = bool(deferred)
+        self._obs = obs
 
     def dispatch(self, keys, kv):
+        if isinstance(keys, StagedKeys):
+            # one program per spec, deferred or eager — the read count the
+            # fused consumer collapses to 1
+            _wr.bucket_read(self._obs, "collect", keys, len(self.specs))
         if self._deferred and isinstance(keys, StagedKeys):
             return [
                 dispatch_compaction(keys, [spec], self._kdt, self._bits)
                 for spec in self.specs
             ]
+        kv = eager_valid(kv)
         host = isinstance(kv, np.ndarray)
         for spec in self.specs:
             m = prefix_mask(kv, spec[0], spec[1], self._kdt, self._bits)
@@ -403,7 +446,10 @@ class SpillTeeConsumer(Consumer):
     order-invariantly; the staged slot each record carries preserves the
     chunk->device replay contract regardless)."""
 
-    def __init__(self, writer, specs, dtype, kdt, total_bits, devs, *, deferred):
+    def __init__(
+        self, writer, specs, dtype, kdt, total_bits, devs, *, deferred,
+        obs=None,
+    ):
         self._writer = writer
         self._specs = list(specs)
         self._dtype = dtype
@@ -411,6 +457,7 @@ class SpillTeeConsumer(Consumer):
         self._bits = total_bits
         self._devs = devs
         self._deferred = bool(deferred)
+        self._obs = obs
 
     def _append(self, surv, slot) -> None:
         if surv.size:
@@ -420,11 +467,14 @@ class SpillTeeConsumer(Consumer):
 
     def dispatch(self, keys, kv):
         slot = _wr.staged_slot(keys, self._devs)
+        if isinstance(keys, StagedKeys):
+            _wr.bucket_read(self._obs, "tee", keys)
         if self._deferred and isinstance(keys, StagedKeys):
             return (
                 slot,
                 dispatch_compaction(keys, self._specs, self._kdt, self._bits),
             )
+        kv = eager_valid(kv)
         m = None
         for resolved, prefix in self._specs:
             mi = prefix_mask(kv, resolved, prefix, self._kdt, self._bits)
@@ -450,12 +500,13 @@ class CountLessLeqConsumer(Consumer):
     ``< v`` iff ``v != 0`` and into ``<= v`` always (unsigned key space).
     Eager: the historical sums over the ragged valid slice."""
 
-    def __init__(self, vkey, kdt, *, deferred: bool):
+    def __init__(self, vkey, kdt, *, deferred: bool, obs=None):
         self.less = 0
         self.leq = 0
         self._vkey = vkey
         self._kdt = kdt
         self._deferred = bool(deferred)
+        self._obs = obs
 
     def dispatch(self, keys, kv):
         if isinstance(kv, np.ndarray):
@@ -464,9 +515,13 @@ class CountLessLeqConsumer(Consumer):
             return None
         import jax.numpy as jnp
 
+        if isinstance(keys, StagedKeys):
+            # two count programs (< and <=) per staged bucket
+            _wr.bucket_read(self._obs, "certificate", keys, 2)
         if self._deferred and isinstance(keys, StagedKeys):
             v = keys.data.dtype.type(self._vkey)
             return (jnp.sum(keys.data < v), jnp.sum(keys.data <= v), keys.pad)
+        kv = eager_valid(kv)
         v = kv.dtype.type(self._vkey)
         return (jnp.sum(kv < v), jnp.sum(kv <= v), 0)
 
@@ -479,6 +534,97 @@ class CountLessLeqConsumer(Consumer):
             le -= pad
         self.less += lt
         self.leq += le
+
+
+class FusedIngestConsumer(Consumer):
+    """ONE device program per staged bucket per pass — the fused
+    replacement for the Histogram/Collect/SpillTee consumer bundle
+    (ops/pallas/fused_ingest.py; the ``fused`` knob, default ``"auto"``).
+
+    Wraps the very sub-consumers it replaces: a staged chunk dispatches
+    the single fused program (histogram + per-spec compactions + tee
+    payload, one read of the buffer) and the FIFO-finish materializes
+    each part INTO the wrapped consumers' own accumulators — the pad
+    correction, survivor ordering, and writer append run through the
+    exact unfused finish code, so ``fused="off"`` (the unwrapped bundle)
+    is a bit-for-bit oracle by construction. Chunks that never staged
+    (host chunks, the host-exact routes, depth-0 device chunks) fall
+    back to the sub-consumers' own dispatch/finish — the fused path is a
+    read-count optimization for staged buckets only.
+
+    Construction invariant: callers build this only when deferral is
+    resolved on (fusion IS a deferral discipline — the fused handle
+    materializes at window-pop time like any deferred handle)."""
+
+    def __init__(self, *, hist=None, collect=None, tee=None, kdt,
+                 total_bits, obs=None):
+        if hist is None and collect is None and tee is None:
+            raise ValueError("FusedIngestConsumer needs at least one part")
+        self._hist = hist
+        self._collect = collect
+        self._tee = tee
+        # unfused fallback order mirrors the historical bundle: tee first
+        # (its eager form writes before the histogram handle can finish)
+        self._subs = [c for c in (tee, hist, collect) if c is not None]
+        self._kdt = kdt
+        self._bits = total_bits
+        self._obs = obs
+
+    def dispatch(self, keys, kv):
+        if not isinstance(keys, StagedKeys):
+            handles = [c.dispatch(keys, kv) for c in self._subs]
+            if all(h is None for h in handles):
+                return None
+            return ("parts", handles)
+        _wr.bucket_read(self._obs, "fused", keys)
+        if self._hist is not None:
+            shift, radix_bits, prefixes, method, _kdt = self._hist._args
+            hist_prefixes = prefixes
+        else:
+            shift = radix_bits = method = hist_prefixes = None
+        slot = (
+            _wr.staged_slot(keys, self._tee._devs)
+            if self._tee is not None
+            else None
+        )
+        handle = _fi.dispatch_fused_ingest(
+            keys,
+            kdt=self._kdt,
+            total_bits=self._bits,
+            shift=shift,
+            radix_bits=radix_bits,
+            hist_prefixes=hist_prefixes,
+            method=method,
+            collect_specs=self._collect.specs if self._collect else (),
+            tee_specs=self._tee._specs if self._tee else (),
+        )
+        return ("fused", (keys, slot, handle))
+
+    def finish(self, handle) -> None:
+        tag, payload = handle
+        if tag == "parts":
+            for c, h in zip(self._subs, payload):
+                if h is not None:
+                    c.finish(h)
+            return
+        keys, slot, (hist, collect, tee) = payload
+        # finish order mirrors the unfused bundle: the tee record lands
+        # before the histogram fold, per-chunk
+        if tee is not None:
+            self._tee._append(materialize_compacted(tee, self._kdt), slot)
+        if hist is not None:
+            _, _, prefixes, _, _ = self._hist._args
+            self._hist._fold(
+                finish_chunk_histograms(
+                    ((keys, prefixes, hist), None), release=False
+                )
+            )
+        for spec, part in zip(
+            self._collect.specs if self._collect else (), collect
+        ):
+            surv = materialize_compacted(part, self._kdt)
+            if surv.size:
+                self._collect.out[spec].append(surv)
 
 
 # ---------------------------------------------------------------------------
@@ -515,10 +661,16 @@ class StreamExecutor:
     def push(self, keys) -> None:
         """Consume one chunk: dispatch every consumer, enqueue the
         in-flight bundle (finishing the oldest when the window is full),
-        or — with nothing in flight — release immediately."""
+        or — with nothing in flight — release immediately.
+
+        ``kv`` handed to consumers is the chunk's keys on their own
+        residency; a STAGED chunk hands the :class:`StagedKeys` itself
+        and an eager consumer derives the valid slice on demand
+        (:func:`eager_valid`) — the slice is a real device program over
+        the padded bucket, so a fully deferred/fused bundle (which reads
+        ``keys.data`` whole) must never dispatch it just to discard it."""
         staged = isinstance(keys, StagedKeys)
-        kv = keys.valid() if staged else keys
-        handles = [c.dispatch(keys, kv) for c in self.consumers]
+        handles = [c.dispatch(keys, keys) for c in self.consumers]
         if all(h is None for h in handles):
             if staged:
                 keys.release()

@@ -364,6 +364,11 @@ class StagedKeys:
     # set by stage_keys: this buffer participates in the live-staged leak
     # accounting (release() decrements exactly once)
     tracked: bool = False
+    # False when `data` IS the caller's own device array (a device-resident
+    # source chunk whose size already matches its pow2 bucket —
+    # stage_device_keys wraps it without a copy): release() must not
+    # delete a buffer the caller still owns
+    own_data: bool = True
 
     @property
     def size(self) -> int:
@@ -388,7 +393,7 @@ class StagedKeys:
         happen exactly once (unwind paths — executor abort, pipeline
         close — may race a normal release on the same chunk)."""
         delete = getattr(self.data, "delete", None)
-        if delete is not None:
+        if delete is not None and self.own_data:
             try:
                 delete()
             except Exception:  # pragma: no cover  # ksel: noqa[KSL012] -- release() is idempotent by contract: delete() of an already-consumed/donated buffer is the expected second-release path, and there is nothing to report or retry
@@ -460,6 +465,62 @@ def stage_keys(
     return StagedKeys(
         data, n, host_buf=buf, pool=pool, device=device, tracked=True
     )
+
+
+_DEVICE_PAD_FN = None
+
+
+def _array_device(x):
+    """The single device an array is committed to, or ``None`` (sharded /
+    unknown) — the StagedKeys device slot for device-resident chunks."""
+    devices = getattr(x, "devices", None)
+    if devices is None:  # pragma: no cover - every jax.Array has .devices()
+        return None
+    ds = devices()
+    return next(iter(ds)) if len(ds) == 1 else None
+
+
+def stage_device_keys(keys, fault_index: int | None = None) -> StagedKeys:
+    """Wrap a DEVICE-RESIDENT key chunk in the pow2 staging discipline —
+    the device twin of :func:`stage_keys`, closing the last eager-gather
+    class (KSL011): once a device source chunk is a :class:`StagedKeys`,
+    the executor's deferred (and fused) fixed-shape programs consume it
+    exactly like a host-staged chunk, instead of the retired per-chunk
+    boolean gather.
+
+    No host transfer happens: a ragged chunk is zero-padded to its pow2
+    bucket ON its own device (pad keys are key-space 0, the exact-
+    correction contract every consumer already honors; the pad program
+    compiles once per (n, bucket) pair — equal-size chunks, the streaming
+    steady state, share one). A chunk whose length already is its bucket
+    is wrapped WITHOUT a copy, marked ``own_data=False`` so ``release()``
+    never deletes the caller's array. The (producer-thread) block on the
+    pad keeps the staging wait off the consuming descent, mirroring
+    :func:`stage_keys`'s transfer block — as does the chaos discipline:
+    the same ``"stage"`` fault site fires first (before any buffer
+    exists, so a retried stage re-runs whole), with ``fault_index`` the
+    producer's stable staged-chunk key exactly like :func:`stage_keys`'s."""
+    import jax
+
+    _maybe_fault("stage", fault_index)
+    n = int(keys.shape[0])
+    bucket = _bucket_elems(n)
+    if bucket == n:
+        _live_staged_inc()
+        return StagedKeys(
+            keys, n, device=_array_device(keys), tracked=True, own_data=False
+        )
+    global _DEVICE_PAD_FN
+    if _DEVICE_PAD_FN is None:
+        import jax.numpy as jnp
+
+        _DEVICE_PAD_FN = jax.jit(
+            lambda k, pad: jnp.pad(k, (0, pad)), static_argnums=1
+        )
+    data = _DEVICE_PAD_FN(keys, bucket - n)
+    data.block_until_ready()
+    _live_staged_inc()
+    return StagedKeys(data, n, device=_array_device(data), tracked=True)
 
 
 @dataclasses.dataclass
@@ -606,6 +667,33 @@ class ChunkPipeline:
                 )
                 host_keys = keys if isinstance(keys, np.ndarray) else None
                 staged_slot = None
+                if host_keys is None:
+                    # a DEVICE-RESIDENT source chunk: route it through the
+                    # staged/deferred path (pow2 pad on its own device, no
+                    # transfer) whenever a device method will consume it —
+                    # including the single-device collect/certificate
+                    # passes, which hand hist_method=None (the host-exact
+                    # 64-bit-no-x64 route still resolves to "numpy" and
+                    # stays unstaged; the f64-on-TPU route encodes to host
+                    # keys upstream and never reaches this branch)
+                    dev_method = (
+                        method
+                        if self._hist_method is not None
+                        else _chunked.resolve_stream_hist("auto", dtype)
+                    )
+                    if dev_method != "numpy":
+                        with _phase(self._timer, "pipeline.stage"):
+                            # same chaos/retry discipline as the host
+                            # staging below: the "stage" fault site keyed
+                            # by the shared staged-chunk counter, retried
+                            # in place under the pass's policy
+                            keys = _fpol.retry_call(
+                                lambda dk=keys, i=staged_i: stage_device_keys(
+                                    dk, fault_index=i
+                                ),
+                                self._retry, site="stage", obs=self._obs,
+                            )
+                            staged_i += 1
                 if method not in (None, "numpy") and isinstance(keys, np.ndarray):
                     with _phase(self._timer, "pipeline.stage"):
                         if replay_slot is None:
@@ -635,8 +723,14 @@ class ChunkPipeline:
                         with _phase(self._timer, "pipeline.spill"):
                             # device-chunk keys live on device: land them
                             # host-side for the record (host chunks tee in
-                            # place)
-                            hk = host_keys if host_keys is not None else np.asarray(keys)
+                            # place; a device-staged chunk lands its whole
+                            # bucket and drops the pad host-side)
+                            if host_keys is not None:
+                                hk = host_keys
+                            elif isinstance(keys, StagedKeys):
+                                hk = np.asarray(keys.data)[: keys.n_valid]
+                            else:
+                                hk = np.asarray(keys)
                             self._spill.append(hk, dtype, device_slot=staged_slot)
                     except BaseException:
                         # a failing tee write (ENOSPC, a transient disk

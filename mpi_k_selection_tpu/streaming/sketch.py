@@ -83,17 +83,22 @@ class _SketchFoldConsumer:
     finish; host/device-resident chunks fold immediately at dispatch (the
     historical inline path). Buffer release rides the executor."""
 
-    def __init__(self, sketch: "RadixSketch"):
+    def __init__(self, sketch: "RadixSketch", obs=None):
         self._sketch = sketch
+        self._obs = obs
         self.staged_chunks = 0
 
     def dispatch(self, keys, kv):
         import numpy as _np
 
+        from mpi_k_selection_tpu.obs import wiring as _wr
         from mpi_k_selection_tpu.streaming import pipeline as _pl
 
         if isinstance(keys, _pl.StagedKeys):
             self.staged_chunks += 1
+            # two device programs per staged bucket (deep histogram +
+            # extremes) — honest reads-per-pass accounting
+            _wr.bucket_read(self._obs, "sketch", keys, 2)
             return self._sketch._dispatch_staged(keys)
         # device chunks arrive as device keys (bitwise twins of the host
         # transform; the f64-on-TPU route already resolved to host-exact
@@ -236,7 +241,7 @@ class RadixSketch:
             )
         src = as_chunk_source(source, one_shot_ok=spill is not None)
         writer = spill.new_generation() if spill is not None else None
-        consumer = _SketchFoldConsumer(self)
+        consumer = _SketchFoldConsumer(self, obs=obs)
         ex = _exec.StreamExecutor(
             [consumer], window=len(devs),
             occupancy=_wr.window_occupancy(obs, phase="sketch"),

@@ -1,0 +1,412 @@
+"""Fused single-read ingest (ops/pallas/fused_ingest.py + the ``fused``
+knob, ISSUE 11).
+
+The contracts under test:
+
+- **Bit-equality over the full grid**: devices {1, 2, max} x
+  pipeline_depth {0, 2} x spill {off, force} x fused {auto, off} return
+  identical bits over heterogeneous (host + device + ragged + empty)
+  chunk streams — ``fused="off"`` (the unfused consumer bundle) is the
+  bit-for-bit oracle, as is ``deferred="off"`` beneath it.
+- **Kernel vs numpy oracle**: the fused program's histogram, per-spec
+  compactions and tee payload equal the host filters, pads excluded,
+  survivor order preserved.
+- **Device-resident source chunks take the staged/deferred path**: at
+  pipeline_depth >= 1 a device chunk is wrapped in the pow2 staging
+  discipline ON its own device (stage_device_keys) — no transfer, no
+  eager gather — and a bucket-sized chunk is wrapped WITHOUT a copy
+  (``own_data=False``: release() must not delete the caller's array).
+- **The read accounting**: ``ingest.bucket_read_bytes`` equals
+  ``ingest.staged_bytes`` under fusion (every staged key read once per
+  pass) and exceeds it for the unfused bundle; the fused run dispatches
+  no separate tee/collect programs.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from mpi_k_selection_tpu import obs as obs_lib
+from mpi_k_selection_tpu.ops.pallas import fused_ingest as fi
+from mpi_k_selection_tpu.streaming import (
+    SpillStore,
+    live_staged_keys,
+    resolve_fused,
+    stage_device_keys,
+    streaming_kselect,
+    streaming_kselect_many,
+)
+from mpi_k_selection_tpu.streaming import executor as ex_mod
+from mpi_k_selection_tpu.streaming.pipeline import stage_keys
+
+
+def _chunks(rng, sizes=(4096, 1, 0, 2777, 4096), device_chunk=1):
+    """Heterogeneous stream: host chunks, ragged sizes, an empty chunk,
+    and `device_chunk` chunks already resident on a device."""
+    out = [
+        rng.integers(-(2**31), 2**31 - 1, size=s, dtype=np.int32)
+        for s in sizes
+    ]
+    for i in range(device_chunk):
+        out[i * 3] = jnp.asarray(out[i * 3])
+    return out
+
+
+def _oracle(chunks, ks):
+    x = np.concatenate([np.asarray(c).ravel() for c in chunks])
+    part = np.partition(x, [k - 1 for k in ks])
+    return [int(part[k - 1]) for k in ks]
+
+
+# ---------------------------------------------------------------------------
+# the grid
+
+
+@pytest.mark.parametrize("devices", [None, 2, 8])
+@pytest.mark.parametrize("depth", [0, 2])
+@pytest.mark.parametrize("spill", ["off", "force"])
+@pytest.mark.parametrize("fused", ["auto", "off"])
+def test_grid_bit_equality(rng, devices, depth, spill, fused):
+    chunks = _chunks(rng)
+    n = sum(int(np.asarray(c).size) for c in chunks)
+    ks = [1, n // 3, n // 2, n]
+    want = _oracle(chunks, ks)
+    got = streaming_kselect_many(
+        chunks, ks, radix_bits=8, collect_budget=256,
+        pipeline_depth=depth, devices=devices, spill=spill, fused=fused,
+    )
+    assert [int(g) for g in got] == want
+    assert live_staged_keys() == 0
+
+
+def test_fused_matches_unfused_and_sync_f32(rng):
+    chunks = [
+        rng.standard_normal(s).astype(np.float32) for s in (3000, 1500, 700)
+    ]
+    n = sum(c.size for c in chunks)
+    k = n // 2
+    kw = dict(radix_bits=8, collect_budget=128, devices=8, pipeline_depth=2,
+              spill="force")
+    a = streaming_kselect(chunks, k, fused="auto", **kw)
+    b = streaming_kselect(chunks, k, fused="off", **kw)
+    c = streaming_kselect(chunks, k, fused="off", deferred="off", **kw)
+    d = streaming_kselect(chunks, k, pipeline_depth=0, radix_bits=8,
+                          collect_budget=128)
+    assert (
+        np.asarray(a).tobytes() == np.asarray(b).tobytes()
+        == np.asarray(c).tobytes() == np.asarray(d).tobytes()
+    )
+
+
+def test_spill_generations_identical_across_fused(rng):
+    """The fused tee writes the SAME per-pass survivor bytes as the
+    unfused tee (the multiset contract, visible in the pass_log)."""
+    chunks = _chunks(rng, sizes=(4096, 2048, 4096), device_chunk=0)
+    n = sum(c.size for c in chunks)
+    logs = {}
+    for fused in ("auto", "off"):
+        with SpillStore() as store:
+            streaming_kselect(
+                chunks, n // 2, radix_bits=4, collect_budget=64,
+                devices=8, pipeline_depth=2, spill=store, fused=fused,
+            )
+            logs[fused] = [
+                {kk: e[kk] for kk in ("pass", "keys_read", "keys_written")
+                 if kk in e}
+                for e in store.pass_log
+            ]
+    assert logs["auto"] == logs["off"]
+
+
+def test_one_shot_tee_fused(rng):
+    """A consumed generator under spill='auto': the fused tee must anchor
+    the same gen-0 bytes and the descent the same answer."""
+    chunks = [rng.integers(-1000, 1000, size=s, dtype=np.int32)
+              for s in (3000, 2000, 1000)]
+    n = sum(c.size for c in chunks)
+    k = n // 2
+    want = _oracle(chunks, [k])[0]
+    got = streaming_kselect(
+        (c for c in chunks), k, radix_bits=4, collect_budget=128,
+        fused="auto",
+    )
+    got_off = streaming_kselect(
+        (c for c in chunks), k, radix_bits=4, collect_budget=128,
+        fused="off",
+    )
+    assert int(got) == int(got_off) == want
+
+
+# ---------------------------------------------------------------------------
+# the fused program vs the numpy oracle
+
+
+def test_fused_program_matches_numpy_oracle(rng):
+    kdt = np.dtype(np.uint32)
+    keys = rng.integers(0, 2**32, size=3011, dtype=np.uint32)  # ragged: pads
+    staged = stage_keys(keys)
+    try:
+        prefixes = sorted({int(keys[0] >> 24), int(keys[7] >> 24)})
+        collect_specs = [(8, int(keys[0] >> 24)), (16, int(keys[5] >> 16))]
+        tee_specs = collect_specs
+        hist, collect, tee = fi.dispatch_fused_ingest(
+            staged, kdt=kdt, total_bits=32, shift=16, radix_bits=8,
+            hist_prefixes=prefixes, method="scatter",
+            collect_specs=collect_specs, tee_specs=tee_specs,
+        )
+        hist = np.asarray(hist)
+        parts = [ex_mod.materialize_compacted(p, kdt) for p in collect]
+        tee_out = ex_mod.materialize_compacted(tee, kdt)
+    finally:
+        staged.release()
+    # histogram: over the WHOLE padded bucket (pad keys are key-space 0 —
+    # the executor's finish subtracts them; here we include them)
+    padded = np.zeros(staged.data.shape[0], np.uint32)
+    padded[: keys.size] = keys
+    assert hist.dtype == np.int32
+    for i, p in enumerate(prefixes):
+        up = padded >> np.uint32(24)
+        dig = (padded >> np.uint32(16)) & np.uint32(0xFF)
+        want = np.bincount(
+            dig[up == np.uint32(p)].astype(np.int64), minlength=256
+        )
+        np.testing.assert_array_equal(hist[i], want)
+    # per-spec compactions: pad excluded, chunk order preserved
+    union = np.zeros(keys.shape, bool)
+    for (resolved, prefix), got in zip(collect_specs, parts):
+        m = (keys >> np.uint32(32 - resolved)) == np.uint32(prefix)
+        union |= m
+        assert got.dtype == kdt
+        np.testing.assert_array_equal(got, keys[m])
+    # tee: the union of specs, compacted once
+    np.testing.assert_array_equal(tee_out, keys[union])
+
+
+def test_fused_collect_only_program(rng):
+    """hist_prefixes=None — the collect pass's fused shape (no histogram,
+    K spec compactions in one program)."""
+    kdt = np.dtype(np.uint32)
+    keys = np.full(1000, 0xABCD1234, np.uint32)
+    staged = stage_keys(keys)
+    try:
+        hist, collect, tee = fi.dispatch_fused_ingest(
+            staged, kdt=kdt, total_bits=32,
+            collect_specs=[(16, 0x1111), (16, 0xABCD)],
+        )
+        assert hist is None and tee is None
+        none_, all_ = (
+            ex_mod.materialize_compacted(p, kdt) for p in collect
+        )
+    finally:
+        staged.release()
+    assert none_.size == 0
+    np.testing.assert_array_equal(all_, keys)  # pads must NOT leak in
+
+
+# ---------------------------------------------------------------------------
+# device-resident source chunks take the staged/deferred path
+
+
+def test_device_chunks_stage_and_defer(rng):
+    host = rng.integers(-(2**31), 2**31 - 1, size=3000, dtype=np.int32)
+    chunks = [jnp.asarray(host), host[:1777]]
+    n = 3000 + 1777
+    k = n // 2
+    want = _oracle([np.asarray(c) for c in chunks], [k])[0]
+    o = obs_lib.Observability.collecting()
+    got = streaming_kselect(chunks, k, pipeline_depth=2, obs=o)
+    assert int(got) == want
+    ev = o.events.of_kind("stream.chunk")
+    # the DEVICE chunk (index 0 of every pass, including the collect) is
+    # staged; the host chunk stays host-side on the single-device collect
+    dev_ev = [e for e in ev if e.chunk_index == 0]
+    assert dev_ev and all(e.staged for e in dev_ev)
+    # the synchronous oracle keeps device chunks unstaged
+    o0 = obs_lib.Observability.collecting()
+    got0 = streaming_kselect(chunks, k, pipeline_depth=0, obs=o0)
+    assert int(got0) == want
+    assert all(not e.staged for e in o0.events.of_kind("stream.chunk"))
+
+
+def test_host_exact_routes_still_bypass_staging(rng):
+    """64-bit device keys without x64 resolve to the host route: a device
+    chunk must NOT be staged (deferral/fusion never see it) and the
+    answer stays exact."""
+    chunks = [
+        rng.integers(-(2**62), 2**62, size=s, dtype=np.int64)
+        for s in (2000, 1000)
+    ]
+    n = sum(c.size for c in chunks)
+    k = n // 2
+    o = obs_lib.Observability.collecting()
+    got = streaming_kselect(
+        chunks, k, collect_budget=64, devices=8, pipeline_depth=2,
+        fused="auto", obs=o,
+    )
+    assert np.asarray(got).tobytes() == np.asarray(
+        np.sort(np.concatenate(chunks), kind="stable")[k - 1]
+    ).tobytes()
+    assert all(not e.staged for e in o.events.of_kind("stream.chunk"))
+
+
+def test_stage_device_keys_padded_and_released():
+    base = live_staged_keys()
+    keys = jnp.asarray(np.arange(1, 1001, dtype=np.uint32))  # ragged
+    staged = stage_device_keys(keys)
+    assert live_staged_keys() == base + 1
+    assert staged.n_valid == 1000 and staged.pad == 24
+    assert staged.data.shape[0] == 1024
+    got = np.asarray(staged.data)
+    assert (got[1000:] == 0).all()  # key-space zero pad
+    np.testing.assert_array_equal(got[:1000], np.arange(1, 1001))
+    staged.release()
+    assert live_staged_keys() == base
+
+
+def test_device_staging_shares_the_stage_fault_site(rng):
+    """stage_device_keys sits on the SAME chaos 'stage' site (and in-place
+    retry discipline) as the host staging transfer — a seeded fault plan
+    targeting staging fires for device-resident sources too, and the
+    recovered answer is bit-identical."""
+    from mpi_k_selection_tpu import faults
+
+    chunks = [
+        jnp.asarray(rng.integers(-1000, 1000, size=s, dtype=np.int32))
+        for s in (3000, 1777)
+    ]
+    n = 3000 + 1777
+    k = n // 2
+    want = _oracle([np.asarray(c) for c in chunks], [k])[0]
+    plan = faults.FaultPlan((faults.FaultSpec("stage", 1, "raise"),))
+    pol = faults.RetryPolicy(max_attempts=3, sleeper=faults.VirtualSleeper())
+    with faults.inject(plan) as inj:
+        got = int(streaming_kselect(chunks, k, pipeline_depth=2, retry=pol))
+    assert got == want
+    assert inj.fired and inj.fired[0]["site"] == "stage"
+    assert live_staged_keys() == 0
+
+
+def test_stage_device_keys_bucket_sized_wraps_without_copy():
+    """A pow2-length device chunk is wrapped as-is (own_data=False):
+    release() must NOT delete the caller's array."""
+    base = live_staged_keys()
+    keys = jnp.asarray(np.arange(2048, dtype=np.uint32))
+    staged = stage_device_keys(keys)
+    assert staged.data is keys and staged.pad == 0
+    assert not staged.own_data
+    staged.release()
+    assert live_staged_keys() == base
+    # the caller's array survives the release
+    np.testing.assert_array_equal(np.asarray(keys)[:4], [0, 1, 2, 3])
+
+
+# ---------------------------------------------------------------------------
+# the read accounting
+
+
+def _read_totals(o):
+    read = staged = 0
+    phases = set()
+    for m in o.metrics.metrics():
+        if m.name == "ingest.bucket_read_bytes":
+            read += m.value
+            phases.add(dict(m.labels).get("phase"))
+        elif m.name == "ingest.staged_bytes":
+            staged += m.value
+    return read, staged, phases
+
+
+def test_bucket_read_bytes_fused_vs_unfused(rng):
+    chunks = _chunks(rng, sizes=(4096, 2048, 4096), device_chunk=0)
+    n = sum(c.size for c in chunks)
+    totals = {}
+    for fused in ("auto", "off"):
+        o = obs_lib.Observability.collecting()
+        streaming_kselect(
+            chunks, n // 2, radix_bits=4, collect_budget=64, devices=2,
+            pipeline_depth=2, spill="force", fused=fused, obs=o,
+        )
+        totals[fused] = _read_totals(o)
+    read_f, staged_f, phases_f = totals["auto"]
+    read_u, staged_u, phases_u = totals["off"]
+    assert staged_f == staged_u  # same staging either way
+    # fused: every staged key read exactly once per pass (pass 0 has no
+    # tee program on device, so its histogram read keeps the total equal)
+    assert read_f == staged_f
+    assert "tee" not in phases_f and "collect" not in phases_f
+    assert "fused" in phases_f
+    # unfused: the tee + per-spec collect programs amplify the reads
+    assert read_u > staged_u
+    assert {"tee", "collect", "histogram"} <= phases_u
+
+
+def test_eager_mode_disables_fusion(rng):
+    """deferred='off' implies the unfused bundle even at fused='auto' —
+    fusion is a deferral discipline."""
+    chunks = _chunks(rng, sizes=(4096, 2048), device_chunk=0)
+    n = sum(c.size for c in chunks)
+    o = obs_lib.Observability.collecting()
+    streaming_kselect(
+        chunks, n // 2, radix_bits=4, collect_budget=64, devices=2,
+        pipeline_depth=2, spill="force", deferred="off", fused="auto",
+        obs=o,
+    )
+    _, _, phases = _read_totals(o)
+    assert "fused" not in phases
+
+
+# ---------------------------------------------------------------------------
+# knob + surface units
+
+
+def test_resolve_fused():
+    assert resolve_fused("auto") is True
+    assert resolve_fused("off") is False
+    assert resolve_fused(True) is True
+    assert resolve_fused(False) is False
+    with pytest.raises(ValueError, match="fused"):
+        resolve_fused("sometimes")
+    with pytest.raises(ValueError, match="fused"):
+        streaming_kselect([np.arange(4, dtype=np.int32)], 1, fused=1.5)
+
+
+def test_fused_consumer_requires_a_part():
+    with pytest.raises(ValueError, match="at least one part"):
+        ex_mod.FusedIngestConsumer(kdt=np.dtype(np.uint32), total_bits=32)
+
+
+def test_streaming_quantiles_fused_knob(rng):
+    from mpi_k_selection_tpu.api import StreamingQuantiles
+
+    with pytest.raises(ValueError, match="fused"):
+        StreamingQuantiles(np.float32, fused="bogus")
+    chunks = [rng.standard_normal(4000).astype(np.float32) for _ in range(3)]
+    qs = (0.1, 0.5, 0.9)
+    got = {}
+    for fused in ("auto", "off"):
+        sq = StreamingQuantiles(
+            np.float32, devices=8, fused=fused
+        ).update_stream(chunks)
+        got[fused] = [
+            np.asarray(v).tobytes() for v in sq.refine_quantiles(qs, chunks)
+        ]
+    assert got["auto"] == got["off"]
+
+
+def test_cli_fused_flag(capsys):
+    import json
+
+    from mpi_k_selection_tpu.cli import main
+
+    for mode in ("auto", "off"):
+        rc = main([
+            "--streaming", "--backend", "tpu", "--n", "40000",
+            "--chunk-elems", "8192", "--devices", "2", "--verify", "--check",
+            "--spill", "force", "--fused", mode, "--json",
+        ])
+        assert rc == 0
+        rec = json.loads(capsys.readouterr().out)
+        assert rec["extra"]["exact_match"] is True
+        assert rec["extra"]["certificate_ok"] is True
+        assert rec["extra"]["fused"] == mode

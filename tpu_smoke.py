@@ -307,6 +307,52 @@ def main() -> int:
             f"{round(d1 / dp, 3) if dp else None}"
         )
 
+    # --- fused single-read ingest (ISSUE 11): ONE device program per
+    # staged bucket per pass vs the unfused bundle, at devices {1, all} —
+    # bit-equality on real silicon, the read-amplification counters
+    # (bucket_read_bytes / staged_bytes ~ 1.0 fused), and the fused-vs-
+    # unfused walls (the bandwidth factor CPU CI cannot measure) ---
+    print("fused single-read ingest:")
+    from mpi_k_selection_tpu.obs import (
+        MetricsRegistry as _fu_Reg,
+        Observability as _fu_Obs,
+    )
+    from mpi_k_selection_tpu.utils.timing import time_fn as _fu_time_fn
+
+    for dv in sp_devgrid:
+        got_fu = int(
+            _sp_ksel(
+                sp_chunks, sp_k, spill="force", devices=dv, fused="auto",
+                **sp_kw,
+            )
+        )
+        check(f"fused=auto devices={dv} bit-identical", got_fu, want_sp)
+    fu_walls = {}
+    fu_amp = {}
+    for mode in ("auto", "off"):
+        o = _fu_Obs(metrics=_fu_Reg())
+        secs, _ = _fu_time_fn(
+            lambda mode=mode, o=o: _sp_ksel(
+                sp_chunks, sp_k, spill="force",
+                devices=ndev if ndev > 1 else 1, fused=mode, obs=o, **sp_kw,
+            )
+        )
+        fu_walls[mode] = round(secs, 4)
+        read = staged = 0
+        for m in o.metrics.metrics():
+            if m.name == "ingest.bucket_read_bytes":
+                read += m.value
+            elif m.name == "ingest.staged_bytes":
+                staged += m.value
+        fu_amp[mode] = round(read / staged, 3) if staged else None
+    check("fused read amplification ~1.0", fu_amp["auto"] is not None
+          and fu_amp["auto"] <= 1.1, True)
+    print(
+        f"    fused-vs-unfused walls: {fu_walls} -> fused_speedup "
+        f"{round(fu_walls['off'] / fu_walls['auto'], 3) if fu_walls['auto'] else None}"
+        f"; read_amplification fused={fu_amp['auto']} unfused={fu_amp['off']}"
+    )
+
     # --- seeded chaos recovery (ISSUE 9 follow-on (c), ROADMAP): the
     # spill descent under a seeded FaultPlan on real chips — CPU CI
     # proves the recovered BITS; this leg records the recovery TIMING:
