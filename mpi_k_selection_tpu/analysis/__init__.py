@@ -35,8 +35,11 @@ from mpi_k_selection_tpu.analysis.core import (
     run_analysis,
 )
 from mpi_k_selection_tpu.analysis import ast_rules as _ast_rules  # registers KSL rules
+from mpi_k_selection_tpu.analysis import concurrency as _concurrency  # KSL015-017
+from mpi_k_selection_tpu.analysis.concurrency import build_concurrency_report
 from mpi_k_selection_tpu.analysis.core import all_rules
 from mpi_k_selection_tpu.analysis.jaxpr_checks import CONTRACT_CHECKS
+from mpi_k_selection_tpu.analysis.lockorder import LockOrderSanitizer
 from mpi_k_selection_tpu.analysis.reporters import render_json, render_text
 
 __all__ = [
@@ -48,6 +51,8 @@ __all__ = [
     "iter_python_files",
     "load_module",
     "CONTRACT_CHECKS",
+    "LockOrderSanitizer",
+    "build_concurrency_report",
     "render_json",
     "render_text",
 ]

@@ -36,6 +36,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="skip the jaxpr contract checks (no jax import; pure AST lint)",
     )
     p.add_argument(
+        "--concurrency-report", default=None, metavar="PATH",
+        help=(
+            "also write the thread-reachability call graph, the static "
+            "lock-order graph (KSL016's), and the per-class guard "
+            "inference as JSON to PATH"
+        ),
+    )
+    p.add_argument(
         "--verbose", action="store_true",
         help="show suppressed findings in text output too",
     )
@@ -72,6 +80,18 @@ def main(argv=None) -> int:
     except (OSError, RuntimeError) as e:
         print(f"kselect-lint: error: {e}", file=sys.stderr)
         return 2
+    if args.concurrency_report:
+        import json
+
+        from mpi_k_selection_tpu.analysis.concurrency import (
+            build_concurrency_report,
+        )
+
+        with open(args.concurrency_report, "w") as fh:
+            json.dump(
+                build_concurrency_report(args.paths, mods=report.modules),
+                fh, indent=2, sort_keys=True,
+            )
     if args.output:
         with open(args.output, "w") as fh:
             fh.write(render_json(report))

@@ -66,6 +66,7 @@ class SourceModule:
         # line -> {rule -> justification}; rule "" means all rules
         self.line_noqa: dict[int, dict[str, str]] = {}
         self.file_noqa: dict[str, str] = {}
+        self.file_noqa_lines: dict[str, int] = {}
         for lineno, line in enumerate(self.lines, start=1):
             m = _NOQA_RE.search(line)
             if not m:
@@ -75,10 +76,24 @@ class SourceModule:
             if m.group("scope"):
                 for r in rules:
                     self.file_noqa[r] = why
+                    self.file_noqa_lines[r] = lineno
             else:
                 table = self.line_noqa.setdefault(lineno, {})
                 for r in rules:
                     table[r] = why
+
+    def string_literal_lines(self) -> set[int]:
+        """Line numbers carrying string constants (docstrings, fixture
+        sources, ``.replace`` arguments) — a noqa-shaped comment INSIDE
+        one is text, not a suppression, so the staleness audit skips
+        those lines (a true suppression sharing a line with a string
+        merely dodges the audit, never enforcement)."""
+        out: set[int] = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                end = node.end_lineno or node.lineno
+                out.update(range(node.lineno, end + 1))
+        return out
 
     def suppression(self, rule: str, line: int) -> str | None:
         """Justification string when ``rule`` is suppressed at ``line``
@@ -136,6 +151,14 @@ class Report:
     findings: list[Finding]
     files: list[str]
     checks_run: list[str]
+    #: ``# ksel: noqa[...]`` entries whose rule RAN on this scan but no
+    #: longer fires at that location — stale ledger entries (the gate
+    #: warns; see dead_suppressions() below)
+    dead_suppressions: list = dataclasses.field(default_factory=list)
+    #: the parsed SourceModules of this scan — NOT serialized; lets the
+    #: CLI hand the already-loaded tree to build_concurrency_report
+    #: instead of re-parsing every file
+    modules: list = dataclasses.field(default_factory=list, repr=False)
 
     @property
     def unsuppressed(self) -> list[Finding]:
@@ -263,4 +286,56 @@ def run_analysis(
             findings.extend(check.run())
 
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
-    return Report(findings, [str(f) for f in files], checks_run)
+    dead = _dead_suppressions(mods, findings, checks_run)
+    return Report(findings, [str(f) for f in files], checks_run, dead, mods)
+
+
+def _dead_suppressions(mods, findings, checks_run) -> list[dict]:
+    """Stale ``# ksel: noqa[...]`` entries: the named rule RAN on this
+    scan yet produced no (suppressed) finding at the suppression's
+    location — the justification ledger is carrying a dead exception.
+    Rules that were deselected are skipped (their silence proves
+    nothing); so are non-KSL ids, which have no line-anchored findings
+    to judge (contract checks deselect via ``--ignore`` instead)."""
+    ran = set(checks_run)
+    out: list[dict] = []
+    for mod in mods:
+        in_string = mod.string_literal_lines()
+        live_lines = {
+            (f.rule, f.line)
+            for f in findings
+            if f.path == mod.relpath and f.suppressed
+        }
+        live_rules = {rule for rule, _line in live_lines}
+        for line, table in sorted(mod.line_noqa.items()):
+            if line in in_string:
+                continue  # noqa-shaped text inside a string literal
+            for rule, why in sorted(table.items()):
+                if rule not in ran or not rule.startswith("KSL"):
+                    continue
+                if (rule, line) not in live_lines:
+                    out.append(
+                        {
+                            "path": mod.relpath,
+                            "line": line,
+                            "rule": rule,
+                            "justification": why,
+                            "scope": "line",
+                        }
+                    )
+        for rule, why in sorted(mod.file_noqa.items()):
+            if mod.file_noqa_lines.get(rule, 0) in in_string:
+                continue
+            if rule not in ran or not rule.startswith("KSL"):
+                continue
+            if rule not in live_rules:
+                out.append(
+                    {
+                        "path": mod.relpath,
+                        "line": mod.file_noqa_lines.get(rule, 1),
+                        "rule": rule,
+                        "justification": why,
+                        "scope": "file",
+                    }
+                )
+    return out

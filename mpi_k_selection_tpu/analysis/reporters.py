@@ -18,10 +18,17 @@ def render_text(report: Report, *, verbose: bool = False) -> str:
     shown = report.findings if verbose else report.unsuppressed
     for f in shown:
         lines.append(f.render())
+    for d in report.dead_suppressions:
+        lines.append(
+            f"{d['path']}:{d['line']}: stale noqa[{d['rule']}] "
+            f"({d['scope']}-scope) — the rule no longer fires here; "
+            "drop the suppression"
+        )
     nsup = len(report.findings) - len(report.unsuppressed)
     summary = (
         f"{len(report.unsuppressed)} finding(s) "
-        f"({nsup} suppressed) in {len(report.files)} file(s); "
+        f"({nsup} suppressed, {len(report.dead_suppressions)} stale "
+        f"suppression(s)) in {len(report.files)} file(s); "
         f"checks: {', '.join(report.checks_run)}"
     )
     lines.append(summary)
@@ -38,6 +45,7 @@ def render_json(report: Report) -> str:
             "suppressed": len(report.findings) - len(report.unsuppressed),
             "files_scanned": report.files,
             "checks_run": report.checks_run,
+            "dead_suppressions": report.dead_suppressions,
             "rules": {
                 rid: {"title": r.title, "rationale": r.rationale}
                 for rid, r in sorted(all_rules().items())

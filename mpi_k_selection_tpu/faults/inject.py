@@ -48,7 +48,7 @@ class FaultInjector:
         self._lock = threading.Lock()
         self._site_calls: dict[str, int] = {}  # auto-index per site
         self._attempts: dict[tuple, int] = {}  # (site, index) -> tries
-        self.fired: list[dict] = []
+        self.fired: list[dict] = []  # ksel: guarded-by[_lock]
         self._by_key = {}
         for s in plan.specs:
             # later specs for the same (site, index) extend the earlier
@@ -185,7 +185,7 @@ def apply_disk_fault(path: str, kind: str) -> None:
 
 # -- the process-wide active injector ---------------------------------------
 
-_ACTIVE: FaultInjector | None = None
+_ACTIVE: FaultInjector | None = None  # ksel: guarded-by[_ACTIVE_LOCK] (writes; the hook-point read is a deliberate bare is-None probe)
 _ACTIVE_LOCK = threading.Lock()
 
 

@@ -44,7 +44,7 @@ class VirtualSleeper(Sleeper):
 
     def __init__(self):
         self._lock = threading.Lock()
-        self.slept: list[float] = []
+        self.slept: list[float] = []  # ksel: guarded-by[_lock]
 
     def sleep(self, seconds: float) -> None:
         with self._lock:

@@ -379,7 +379,7 @@ class MetricsHTTPServer(ThreadingHTTPServer):
         super().__init__(address, _MetricsHandler)
         self.registry = registry
         self._req_lock = threading.Lock()
-        self._req_threads: list[threading.Thread] = []
+        self._req_threads: list[threading.Thread] = []  # ksel: guarded-by[_req_lock]
         self._serve_thread: threading.Thread | None = None
 
     @property

@@ -12,8 +12,8 @@ import threading
 import numpy as np
 
 _lock = threading.Lock()
-_lib = None
-_failed = False
+_lib = None  # ksel: guarded-by[_lock]
+_failed = False  # ksel: guarded-by[_lock]
 
 _NTH = {
     np.dtype(np.int32): ("nth_element_i32", ctypes.c_int32),

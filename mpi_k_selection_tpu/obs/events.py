@@ -245,7 +245,7 @@ class ListSink(EventSink):
 
     def __init__(self):
         self._lock = threading.Lock()
-        self.events: list[ObsEvent] = []
+        self.events: list[ObsEvent] = []  # ksel: guarded-by[_lock]
 
     def emit(self, event: ObsEvent) -> None:
         with self._lock:

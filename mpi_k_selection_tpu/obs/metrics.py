@@ -158,7 +158,7 @@ class Histogram(_Metric):
     def __init__(self, name, labels, lock, buckets=DEFAULT_BUCKETS):
         super().__init__(name, labels, lock)
         self.bounds = tuple(sorted(float(b) for b in buckets))
-        self.bucket_counts = [0] * (len(self.bounds) + 1)  # last = +Inf
+        self.bucket_counts = [0] * (len(self.bounds) + 1)  # ksel: guarded-by[_lock] (last = +Inf)
         self.count = 0
         self.sum = 0
         self.min = None
@@ -186,7 +186,16 @@ class Histogram(_Metric):
 
     def cumulative(self) -> list[int]:
         """Cumulative counts per ``le`` bound (+Inf last) — the
-        Prometheus wire shape."""
+        Prometheus wire shape. Snapshots under the registry lock: an
+        observe() racing this iteration would otherwise tear the
+        monotone-bucket invariant (KSL015)."""
+        with self._lock:
+            return self._cumulative_locked()
+
+    def _cumulative_locked(self) -> list[int]:
+        """The raw accumulation — callers hold the registry lock (the
+        exposition renderer snapshots buckets/count/sum in ONE critical
+        section, so the +Inf bucket and _count lines agree)."""
         out, running = [], 0
         for c in self.bucket_counts:
             running += c
@@ -198,16 +207,20 @@ class Histogram(_Metric):
         return self.sum / self.count if self.count else None
 
     def as_dict(self) -> dict:
+        with self._lock:
+            cum = self._cumulative_locked()
+            count, total = self.count, self.sum
+            mn, mx = self.min, self.max
         return {
             "type": self.type_name,
-            "count": self.count,
-            "sum": self.sum,
-            "min": self.min,
-            "max": self.max,
-            "mean": self.mean,
+            "count": count,
+            "sum": total,
+            "min": mn,
+            "max": mx,
+            "mean": total / count if count else None,
             "buckets": {
-                **{str(b): c for b, c in zip(self.bounds, self.cumulative())},
-                "+Inf": self.count,
+                **{str(b): c for b, c in zip(self.bounds, cum)},
+                "+Inf": count,
             },
         }
 
@@ -224,8 +237,8 @@ class MetricsRegistry:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._metrics: dict = {}
-        self._window_specs: dict = {}
+        self._metrics: dict = {}  # ksel: guarded-by[_lock]
+        self._window_specs: dict = {}  # ksel: guarded-by[_lock]
 
     @staticmethod
     def _key(name: str, labels):
@@ -333,7 +346,7 @@ class MetricsRegistry:
                     # a scrape racing a live observe() would otherwise
                     # read m.count twice across the interleaving
                     with m._lock:
-                        cum = m.cumulative()
+                        cum = m._cumulative_locked()
                         count, total = m.count, m.sum
                     for bound, c in zip(m.bounds, cum):
                         lab = dict(m.labels)

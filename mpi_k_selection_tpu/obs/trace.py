@@ -57,7 +57,7 @@ class TraceRecorder:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self.spans: list[Span] = []
+        self.spans: list[Span] = []  # ksel: guarded-by[_lock]
 
     def record(self, name: str, t0: float, t1: float) -> None:
         """Called by PhaseTimer on the thread that ran the phase."""

@@ -108,7 +108,7 @@ class PendingQuery:
     #: does not count the SAME query's deadline twice in the metrics;
     #: ``_dl_lock`` makes abandon-vs-drop a real test-and-set (the two
     #: threads race on exactly this decision)
-    abandoned: bool = False
+    abandoned: bool = False  # ksel: guarded-by[_dl_lock]
     _dl_lock: threading.Lock = dataclasses.field(
         default_factory=threading.Lock
     )

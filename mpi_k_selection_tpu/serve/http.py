@@ -269,7 +269,7 @@ class KSelectHTTPServer(ThreadingHTTPServer):
         super().__init__(address, _Handler)
         self.kserver = kserver
         self._req_lock = threading.Lock()
-        self._req_threads: list[threading.Thread] = []
+        self._req_threads: list[threading.Thread] = []  # ksel: guarded-by[_req_lock]
         self._serve_thread: threading.Thread | None = None
 
     @property

@@ -62,7 +62,7 @@ class ProgramCache:
 
     def __init__(self, *, max_entries: int = 64):
         self._lock = threading.Lock()
-        self._entries: collections.OrderedDict = collections.OrderedDict()
+        self._entries: collections.OrderedDict = collections.OrderedDict()  # ksel: guarded-by[_lock]
         self.max_entries = int(max_entries)
         self.hits = 0
         self.misses = 0
@@ -152,7 +152,7 @@ class DatasetRegistry:
 
     def __init__(self, *, programs: ProgramCache | None = None):
         self._lock = threading.Lock()
-        self._datasets: dict[str, ResidentDataset] = {}
+        self._datasets: dict[str, ResidentDataset] = {}  # ksel: guarded-by[_lock]
         self.programs = programs if programs is not None else ProgramCache()
 
     # -- lifecycle ---------------------------------------------------------

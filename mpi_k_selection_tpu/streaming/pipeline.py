@@ -192,9 +192,9 @@ class StagingPool:
 
     def __init__(self, *, max_per_key: int = 4, max_bytes: int = 1 << 31):
         self._lock = threading.Lock()
-        self._free: dict = {}  # key -> [np.ndarray, ...]
-        self._order: list = []  # insertion order of (key, nbytes) for eviction
-        self._bytes = 0
+        self._free: dict = {}  # ksel: guarded-by[_lock]
+        self._order: list = []  # ksel: guarded-by[_lock] (eviction order of (key, nbytes))
+        self._bytes = 0  # ksel: guarded-by[_lock]
         self.max_per_key = int(max_per_key)
         self.max_bytes = int(max_bytes)
         self.hits = 0
@@ -263,7 +263,7 @@ STAGING_POOL = StagingPool()
 # returns to its pre-test baseline after every test, including raise paths
 # with handles in flight).
 _LIVE_STAGED_LOCK = threading.Lock()
-_LIVE_STAGED = 0
+_LIVE_STAGED = 0  # ksel: guarded-by[_LIVE_STAGED_LOCK]
 
 
 def _live_staged_inc() -> None:

@@ -37,7 +37,8 @@ from __future__ import annotations
 
 import jax
 
-# ksel: noqa-file[KSL006] -- this module IS the shim the rule points everyone at
+# (KSL006 exempts utils/compat.py by path — this module IS the shim; the
+# redundant noqa-file here was retired by the staleness audit)
 
 
 def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):

@@ -42,8 +42,8 @@ class PhaseTimer:
     thread-attributed spans.
     """
 
-    phases: dict = field(default_factory=dict)
-    counts: dict = field(default_factory=dict)
+    phases: dict = field(default_factory=dict)  # ksel: guarded-by[_lock]
+    counts: dict = field(default_factory=dict)  # ksel: guarded-by[_lock]
     recorder: object = None
     _lock: threading.Lock = field(
         default_factory=threading.Lock, repr=False, compare=False
@@ -80,12 +80,17 @@ class PhaseTimer:
             }
 
     def report(self) -> str:
-        total = self.total or 1.0
+        # snapshot under the lock: a producer thread landing a phase
+        # mid-report would otherwise tear this iteration (KSL015)
+        with self._lock:
+            phases = dict(self.phases)
+            counts = dict(self.counts)
+        total = sum(phases.values()) or 1.0
         lines = ["phase timing:"]
-        for name, s in sorted(self.phases.items(), key=lambda kv: -kv[1]):
+        for name, s in sorted(phases.items(), key=lambda kv: -kv[1]):
             lines.append(
                 f"  {name:<24} {s * 1e3:10.3f} ms  {100 * s / total:5.1f}%"
-                f"  ({self.counts[name]}x)"
+                f"  ({counts[name]}x)"
             )
         lines.append(f"  {'total':<24} {total * 1e3:10.3f} ms")
         return "\n".join(lines)

@@ -1362,3 +1362,18 @@ def test_analyzer_gate_whole_repo():
         "suppressed without a justification (add `-- why` to the noqa):\n"
         + "\n".join(f.render() for f in unjustified)
     )
+    # staleness audit: a noqa whose rule no longer fires is a dead ledger
+    # entry — the gate WARNS (tests/test_concurrency.py keeps the shipped
+    # tree at zero; this warning is the in-band nudge during development)
+    if report.dead_suppressions:
+        import warnings
+
+        warnings.warn(
+            "stale ksel noqa suppressions (rule no longer fires): "
+            + ", ".join(
+                f"{d['path']}:{d['line']}[{d['rule']}]"
+                for d in report.dead_suppressions
+            ),
+            RuntimeWarning,
+            stacklevel=2,
+        )
