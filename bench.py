@@ -829,19 +829,24 @@ def bench_streaming_oc(on_tpu: bool):
 
 
 def bench_ingest_fusion(on_tpu: bool):
-    """Fused single-read ingest (ISSUE 11): the spill config — radix_bits=4
-    and a tiny collect budget force several prefix-filtered passes whose
-    staged buckets the UNFUSED bundle reads 2-3x each (histogram + spill
-    tee per descent pass, one compaction per spec in the collect) — run
-    fused="auto" vs fused="off" on the same multi-rank stream. The record
-    carries interleaved best-of-3 walls (`fused_speedup` = off/auto),
-    the read-amplification evidence (`bytes_read_per_pass` vs
+    """Single-read ingest tiers (ISSUEs 11 + 13): the spill config —
+    radix_bits=4 and a tiny collect budget force several prefix-filtered
+    passes whose staged buckets the UNFUSED bundle reads 2-3x each
+    (histogram + spill tee per descent pass, one compaction per spec in
+    the collect) — run all three tiers interleaved on the same
+    multi-rank stream: fused="kernel" (the single-sweep pallas program,
+    ONE guaranteed HBM read per bucket; interpret-mode off TPU),
+    fused="xla" (the one-XLA-program fusion) and fused="off" (the
+    unfused oracle). The record carries interleaved best-of-3 walls
+    (`fused_speedup` = off/kernel, `kernel_vs_xla` = xla/kernel), the
+    read-amplification evidence (`bytes_read_per_pass` vs
     `bytes_staged_per_pass`, both in padded bucket bytes;
-    `read_amplification` gated ~1.0 for the fused leg against the issue's
-    <= 1.1 bound), and `exact_match` REQUIRES bit-equality of both legs
-    against the spill="off" replay answer. Chunks are small (many
-    dispatches) because the fusion's CPU-CI-visible win is dispatch/read
-    count, not bandwidth — the bandwidth factor needs TPU validation."""
+    `read_amplification` gated <= 1.0 for the kernel leg — every staged
+    key dispatched to exactly one program per pass), and `exact_match`
+    REQUIRES bit-equality of all three legs against the spill="off"
+    replay answer. Chunks are small (many dispatches) because the
+    CPU-CI-visible win is dispatch/read count, not bandwidth — the
+    kernel tier's bandwidth factor is what the TPU run records."""
     import numpy as np
 
     from mpi_k_selection_tpu.obs import MetricsRegistry, Observability
@@ -856,6 +861,7 @@ def bench_ingest_fusion(on_tpu: bool):
     rb, budget = 4, 512
     ndev = len(_jax.devices())
     devices = ndev if ndev > 1 else None
+    modes = ("kernel", "xla", "off")
 
     def gen(i):
         return np.random.default_rng(41 + i).integers(
@@ -867,23 +873,23 @@ def bench_ingest_fusion(on_tpu: bool):
         source, ks, radix_bits=rb, collect_budget=budget, spill="off"
     )
 
-    # untimed warmup over a short prefix compiles every program BOTH legs
-    # hit (the fused program AND the unfused bundle's), so neither timed
-    # run carries the other's XLA compiles
+    # untimed warmup over a short prefix compiles every program ALL legs
+    # hit (the sweep kernel, the XLA fusion, the unfused bundle's), so no
+    # timed run carries another's compiles
     warm = lambda: (gen(i) for i in range(max(2, ndev)))
-    for mode in ("auto", "off"):
+    for mode in modes:
         with SpillStore() as ws:
             streaming_kselect_many(
                 warm, [chunk, 2 * chunk], radix_bits=rb, collect_budget=64,
                 spill=ws, devices=devices, fused=mode,
             )
 
-    best = {"auto": float("inf"), "off": float("inf")}
+    best = {m: float("inf") for m in modes}
     answers = {}
     obs_by = {}
     passes_by = {}
     for _rep in range(3):  # interleaved best-of-3: shared-host noise hedge
-        for mode in ("auto", "off"):
+        for mode in modes:
             o = Observability(metrics=MetricsRegistry())
             with SpillStore() as store:
                 t0 = time.perf_counter()
@@ -898,19 +904,22 @@ def bench_ingest_fusion(on_tpu: bool):
                 best[mode] = dt
                 obs_by[mode] = o
 
-    reads = {m: _bucket_read_totals(obs_by[m]) for m in ("auto", "off")}
+    reads = {m: _bucket_read_totals(obs_by[m]) for m in modes}
     amp = {
         m: (
             round(reads[m]["bytes_read"] / reads[m]["bytes_staged"], 4)
             if reads[m]["bytes_staged"]
             else None
         )
-        for m in ("auto", "off")
+        for m in modes
     }
-    exact = answers["auto"] == answers["off"] == [int(w) for w in want]
+    exact = (
+        answers["kernel"] == answers["xla"] == answers["off"]
+        == [int(w) for w in want]
+    )
     rec = {
         "metric": "kselect_ingest_fusion",
-        "value": round(n / best["auto"], 1) if exact else 0.0,
+        "value": round(n / best["kernel"], 1) if exact else 0.0,
         "unit": "elems/sec/chip",
         "n": n,
         "ks": ks,
@@ -919,37 +928,45 @@ def bench_ingest_fusion(on_tpu: bool):
         "radix_bits": rb,
         "collect_budget": budget,
         "devices": ndev,
-        "seconds": round(best["auto"], 6),
+        "seconds": round(best["kernel"], 6),
+        "xla_seconds": round(best["xla"], 6),
         "unfused_seconds": round(best["off"], 6),
         "fused_speedup": (
-            round(best["off"] / best["auto"], 3) if exact else 0.0
+            round(best["off"] / best["kernel"], 3) if exact else 0.0
         ),
-        # the issue's acceptance evidence: with fusion every staged key is
-        # read ~once per pass (ratio <= 1.1); the unfused leg shows the
-        # amplification the fusion removed
+        "kernel_vs_xla": (
+            round(best["xla"] / best["kernel"], 3) if exact else 0.0
+        ),
+        # the issue's acceptance evidence: under the kernel tier every
+        # staged key is dispatched to exactly ONE program per pass
+        # (ratio <= 1.0 — and on silicon, one guaranteed HBM sweep); the
+        # unfused leg shows the amplification the fusion removed
         "bytes_read_per_pass": (
-            round(reads["auto"]["bytes_read"] / passes_by["auto"], 1)
-            if passes_by.get("auto")
+            round(reads["kernel"]["bytes_read"] / passes_by["kernel"], 1)
+            if passes_by.get("kernel")
             else None
         ),
         "bytes_staged_per_pass": (
-            round(reads["auto"]["bytes_staged"] / passes_by["auto"], 1)
-            if passes_by.get("auto")
+            round(reads["kernel"]["bytes_staged"] / passes_by["kernel"], 1)
+            if passes_by.get("kernel")
             else None
         ),
-        "read_amplification": amp["auto"],
+        "read_amplification": amp["kernel"],
+        "read_amplification_xla": amp["xla"],
         "read_amplification_unfused": amp["off"],
-        "bucket_reads_by_phase": reads["auto"]["by_phase"],
+        "bucket_reads_by_phase": reads["kernel"]["by_phase"],
         "bucket_reads_by_phase_unfused": reads["off"]["by_phase"],
         "exact_match": bool(exact),
     }
     _emit(rec)
     return (
         bool(exact)
-        and amp["auto"] is not None
-        and amp["auto"] <= 1.1
+        and amp["kernel"] is not None
+        and amp["kernel"] <= 1.0
+        and amp["xla"] is not None
+        and amp["xla"] <= 1.1
         and amp["off"] is not None
-        and amp["off"] > amp["auto"]
+        and amp["off"] > amp["kernel"]
     )
 
 

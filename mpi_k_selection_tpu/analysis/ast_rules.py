@@ -1008,15 +1008,23 @@ class MultiProgramStagedConsume(Rule):
     )
 
     #: The ingest-program dispatch surface (matched on the last dotted
-    #: segment): the histogram primitives and the executor's dispatch
-    #: helpers. Two of these against one staged variable in one function
-    #: is the read-amplification class; unrelated device calls (e.g. the
-    #: sketch's extremes fold) are out of scope — they are not reads of
-    #: the radix-ingest program family this rule gates.
+    #: segment): the histogram primitives, the executor's dispatch
+    #: helpers, and the single-read programs themselves — both the XLA
+    #: fusion (dispatch_fused_ingest / fused_ingest_core) and the sweep
+    #: kernel (dispatch_sweep_ingest / sweep_ingest_core): each IS one
+    #: read, so a second ingest program beside one re-introduces exactly
+    #: the amplification it exists to retire. Two of these against one
+    #: staged variable in one function is the read-amplification class;
+    #: unrelated device calls (e.g. the sketch's extremes fold) are out
+    #: of scope — they are not reads of the radix-ingest program family
+    #: this rule gates.
     _DISPATCHERS = {
         "dispatch_chunk_histograms",
         "dispatch_compaction",
         "dispatch_fused_ingest",
+        "dispatch_sweep_ingest",
+        "fused_ingest_core",
+        "sweep_ingest_core",
         "masked_radix_histogram",
         "multi_masked_radix_histogram",
     }

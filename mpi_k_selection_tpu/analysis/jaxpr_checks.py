@@ -16,9 +16,11 @@ asserts a property every review round has had to re-derive by hand:
   device count program of the (multi-device) staged ingest — chunked
   single-/multi-prefix and the sketch deep fold — stays int32, the
   cross-chunk host merge int64, the multi-device collect filter stays a
-  bool predicate, and the deferred executor's compaction keeps an int32
-  survivor count and a dtype-preserving compacted buffer — at two chunk
-  sizes.
+  bool predicate, the deferred executor's compaction keeps an int32
+  survivor count and a dtype-preserving compacted buffer, and the
+  single-sweep kernel's every part (histogram, compactions, certificate
+  pair, sketch fold + extremes) holds the same books on the hand-written
+  trace — at two chunk sizes.
 - **KSC103 jaxpr stability across batch sizes**: the same kernel traced
   at nearby n produces the identical primitive sequence — a divergence
   means some Python-level branch depends on n in a way that recompiles
@@ -286,6 +288,59 @@ def _streaming_fused_ingest_cases():
     ]
 
 
+def _streaming_sweep_ingest_cases():
+    """The single-sweep pallas ingest kernel (ops/pallas/sweep_ingest.py:
+    sweep_ingest_core) — ONE grid pass per staged bucket producing every
+    consumer product (multi-prefix histogram, per-spec compactions, tee
+    payload, certificate pair, sketch deep fold + extremes). Same books
+    as the programs it replaces, checked ON the kernel trace: int32
+    histogram/count/certificate partials (the streaming counter
+    discipline), dtype-preserving compacted buffers, key-dtype extremes,
+    int32 deep-level partials — and a bucket-size-stable primitive trail
+    (the kernel body never unrolls on the tile count; everything
+    data-dependent rides as a traced SMEM scalar), traced at both
+    adjacent pow2 staging buckets exactly like its unfused and
+    XLA-fusion counterparts."""
+    import numpy as np
+
+    from mpi_k_selection_tpu.ops.pallas.sweep_ingest import sweep_ingest_core
+
+    path = "mpi_k_selection_tpu/ops/pallas/sweep_ingest.py"
+
+    def sweep(u):
+        # every part armed at once: 2 surviving prefixes histogrammed, 2
+        # collect specs at distinct resolved depths, a 2-spec union tee,
+        # the certificate probe, and a 16-bit sketch fold — the superset
+        # of the shapes the descent/certificate/sketch passes dispatch
+        return sweep_ingest_core(
+            u,
+            np.int32(u.shape[0] - 7),
+            np.asarray([0, 3], np.uint32),
+            np.asarray([24, 16], np.uint32),
+            np.asarray([0, 3], np.uint32),
+            np.asarray([24, 16], np.uint32),
+            np.asarray([0, 3], np.uint32),
+            np.asarray(5, np.uint32),
+            shift=16,
+            radix_bits=8,
+            hist_mode="multi",
+            n_collect=2,
+            n_tee=2,
+            cert=True,
+            sketch_bits=16,
+        )
+
+    return [
+        (
+            path,
+            "streaming sweep ingest[uint32, hist+collect+tee+cert+sketch]",
+            sweep,
+            "uint32",
+            _STREAMING_INGEST_SIZES,
+        ),
+    ]
+
+
 @contract(
     "KSC101",
     "public selections preserve their input dtype",
@@ -508,6 +563,60 @@ def check_counter_width() -> list[Finding]:
                                 f"traced as {np.dtype(cnt.dtype)}, want the "
                                 "int32 per-chunk partial")
                     )
+    # the single-sweep kernel: one program now carries EVERY consumer's
+    # accumulator, so the width discipline is checked part by part on the
+    # kernel trace — int32 histogram partial, dtype-preserving compactions
+    # with int32 counts (collect AND tee), int32 certificate pair, int32
+    # deep-level partial with key-dtype extremes
+    for case_path, label, fn, dt, sizes in _streaming_sweep_ingest_cases():
+        for n in sizes:
+            hist, collect, tee, cert, sketch = jax.eval_shape(fn, _spec(n, dt))
+            if np.dtype(hist.dtype) != np.dtype(np.int32):
+                findings.append(
+                    Finding("KSC102", case_path, 0,
+                            f"{label} n={n}: sweep histogram traced as "
+                            f"{np.dtype(hist.dtype)}, want int32")
+                )
+            for part_label, (out, cnt) in (
+                [(f"collect[{i}]", part) for i, part in enumerate(collect)]
+                + ([("tee", tee)] if tee is not None else [])
+            ):
+                if np.dtype(out.dtype) != np.dtype(dt):
+                    findings.append(
+                        Finding("KSC102", case_path, 0,
+                                f"{label} n={n}: sweep {part_label} "
+                                f"compaction traced as {np.dtype(out.dtype)}, "
+                                f"want {dt}")
+                    )
+                if np.dtype(cnt.dtype) != np.dtype(np.int32):
+                    findings.append(
+                        Finding("KSC102", case_path, 0,
+                                f"{label} n={n}: sweep {part_label} count "
+                                f"traced as {np.dtype(cnt.dtype)}, want the "
+                                "int32 per-chunk partial")
+                    )
+            for cname, c in zip(("less", "leq"), cert):
+                if np.dtype(c.dtype) != np.dtype(np.int32):
+                    findings.append(
+                        Finding("KSC102", case_path, 0,
+                                f"{label} n={n}: sweep certificate {cname} "
+                                f"traced as {np.dtype(c.dtype)}, want the "
+                                "int32 per-chunk partial")
+                    )
+            deep, kmin, kmax = sketch
+            if np.dtype(deep.dtype) != np.dtype(np.int32):
+                findings.append(
+                    Finding("KSC102", case_path, 0,
+                            f"{label} n={n}: sweep deep-level partial traced "
+                            f"as {np.dtype(deep.dtype)}, want int32")
+                )
+            for ename, e in (("min", kmin), ("max", kmax)):
+                if np.dtype(e.dtype) != np.dtype(dt):
+                    findings.append(
+                        Finding("KSC102", case_path, 0,
+                                f"{label} n={n}: sweep key-space {ename} "
+                                f"traced as {np.dtype(e.dtype)}, want {dt}")
+                    )
     # host-merge side (numpy method — host-only, nothing touches a device):
     # both the single- and multi-prefix merge inputs must already be int64
     kdt = np.dtype(np.uint32)
@@ -584,6 +693,12 @@ def check_jaxpr_stability() -> list[Finding]:
     # divergence would mean the fusion recompiles per bucket — exactly the
     # per-pass compile discipline it inherits from its unfused parts
     cases += _streaming_fused_ingest_cases()
+    # the single-sweep kernel at both staging buckets: the kernel body
+    # must not unroll on the tile count (grid geometry is a pallas_call
+    # param, not program structure), or the kernel tier recompiles per
+    # bucket — the same per-(bucket, dtype, spec-counts) compile-once
+    # discipline as the XLA tier, now pinned on the hand-written trace
+    cases += _streaming_sweep_ingest_cases()
     for path, label, fn, dt, (n1, n2) in cases:
         t1 = _primitive_trail(jax.make_jaxpr(fn)(_spec(n1, dt)))
         t2 = _primitive_trail(jax.make_jaxpr(fn)(_spec(n2, dt)))

@@ -313,9 +313,12 @@ def kselect_streaming(source, k, **kwargs):
     gathers; ``"off"`` is the historical eager path, bit-identical.
     ``fused`` (default ``"auto"``) collapses each deferred pass's
     per-chunk device programs — histogram, survivor compactions,
-    spill-tee payload — into ONE program per staged bucket
-    (ops/pallas/fused_ingest.py), so every staged key is read once per
-    pass; ``"off"`` keeps the unfused bundle as the bit-for-bit oracle.
+    spill-tee payload — into ONE program per staged bucket:
+    ``"kernel"`` is the hand-written single-sweep pallas kernel
+    (ops/pallas/sweep_ingest.py — one GUARANTEED HBM read; the auto
+    default on TPU), ``"xla"`` the one-XLA-program fusion
+    (ops/pallas/fused_ingest.py; the auto default elsewhere), and
+    ``"off"`` keeps the unfused bundle as the bit-for-bit oracle.
     ``retry`` arms the resilience policies (docs/ROBUSTNESS.md; default
     on): transient source errors re-pull mid-pass, staging transfers
     retry in place, failed passes re-run from the previous spill
@@ -358,9 +361,13 @@ class StreamingQuantiles:
     executor discipline for the exact refinement passes
     (streaming/executor.py; default auto = deferred device-side
     compaction, ``"off"`` the historical eager gathers — bit-identical
-    either way) and ``fused`` whether those passes run as ONE device
-    program per staged bucket (ops/pallas/fused_ingest.py; default auto,
-    ``"off"`` the unfused oracle — bit-identical)."""
+    either way) and ``fused`` the single-read ingest tier for those
+    passes AND the staged sketch folds: ``"kernel"`` = ONE single-sweep
+    pallas program per staged bucket (ops/pallas/sweep_ingest.py, one
+    guaranteed read), ``"xla"`` = the one-XLA-program fusion
+    (ops/pallas/fused_ingest.py), ``"off"`` = the unfused oracle;
+    default auto = kernel on TPU, xla elsewhere — bit-identical at
+    every tier."""
 
     def __init__(
         self,
@@ -378,7 +385,7 @@ class StreamingQuantiles:
             DEFAULT_DEFERRED,
             DEFAULT_FUSED,
             resolve_deferred,
-            resolve_fused,
+            validate_fused,
         )
         from mpi_k_selection_tpu.streaming.pipeline import (
             resolve_stream_devices,
@@ -396,7 +403,10 @@ class StreamingQuantiles:
         #: single-read fused ingest for the refinement passes
         #: (ops/pallas/fused_ingest.py; None resolves to the default)
         self.fused = DEFAULT_FUSED if fused is None else fused
-        resolve_fused(self.fused)  # validate eagerly, like depth
+        # validate eagerly, like depth — but WITHOUT resolving "auto"'s
+        # tier: resolve_fused probes jax.default_backend(), a full
+        # platform init this sketch-only constructor must not trigger
+        validate_fused(self.fused)
         #: optional Observability bundle threaded through update_stream
         #: and refine_quantiles (off = None, the default)
         self.obs = obs
@@ -420,10 +430,13 @@ class StreamingQuantiles:
         stream's encoded keys to disk during this ONE pass, making
         one-shot sources refinable: pass the store to
         :meth:`refine_quantiles` afterwards and the exact descent runs
-        entirely from the spilled generation."""
+        entirely from the spilled generation. The tracker's ``fused``
+        tier rides along: at ``"kernel"`` each supported staged bucket's
+        deep fold + extremes run as ONE single-sweep program
+        (ops/pallas/sweep_ingest.py) instead of the 2-program pair."""
         self.sketch.update_stream(
             source, pipeline_depth=self.pipeline_depth, devices=self.devices,
-            spill=spill, obs=self.obs,
+            spill=spill, fused=self.fused, obs=self.obs,
         )
         return self
 

@@ -160,13 +160,15 @@ def build_parser() -> argparse.ArgumentParser:
         "time. Answers are bit-identical in every mode",
     )
     p.add_argument(
-        "--fused", choices=("auto", "off"), default="auto",
-        help="--streaming single-read ingest (ops/pallas/fused_ingest.py): "
-        "auto (default) fuses each deferred pass's per-chunk device "
-        "programs — histogram, survivor compactions, spill-tee payload — "
-        "into ONE program per staged bucket, so every staged key is read "
-        "once per pass; off keeps the unfused consumer bundle (the "
-        "bit-for-bit oracle). Answers are bit-identical in every mode",
+        "--fused", choices=("auto", "kernel", "xla", "off"), default="auto",
+        help="--streaming single-read ingest tier: kernel = the "
+        "hand-written single-sweep pallas program "
+        "(ops/pallas/sweep_ingest.py — one GUARANTEED HBM read per "
+        "staged bucket per pass; interpret-mode off TPU), xla = the "
+        "one-XLA-program fusion (ops/pallas/fused_ingest.py — one "
+        "dispatch), off = the unfused consumer bundle (the bit-for-bit "
+        "oracle), auto (default) = kernel on TPU, xla elsewhere. "
+        "Answers are bit-identical at every tier",
     )
     p.add_argument(
         "--retry", choices=("default", "off"), default="default",
@@ -552,7 +554,8 @@ def _run_streaming(args, obs=None):
             less, leq = streaming_rank_certificate(
                 cert_src,
                 answer, pipeline_depth=depth, devices=devices,
-                deferred=args.deferred, retry=args.retry, obs=cert_obs,
+                deferred=args.deferred, fused=args.fused, retry=args.retry,
+                obs=cert_obs,
             )
             cert_ok = less < k <= leq
             record.extra["rank_certificate"] = [less, leq]

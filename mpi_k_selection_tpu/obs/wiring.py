@@ -98,12 +98,16 @@ def bucket_read(obs, phase: str, staged, programs: int = 1):
     """Count ``programs`` device-program dispatches consuming one staged
     bucket — sampled at DISPATCH time, so ``ingest.bucket_reads{phase}``
     (and its byte twin ``ingest.bucket_read_bytes{phase}``) measure the
-    reads-per-pass multiplier the fused single-read ingest collapses:
+    reads-per-pass multiplier the single-read ingest tiers collapse:
     an unfused spill pass reads each bucket for the histogram AND the
     tee (2 programs), an unfused collect pass once per spec; the fused
-    program (phase ``"fused"``) reads it exactly once. ``phase``
-    partitions over the closed consumer set (``histogram`` | ``collect``
-    | ``tee`` | ``certificate`` | ``sketch`` | ``monitor`` | ``fused``).
+    program (phase ``"fused"``, either tier — the single-sweep kernel
+    or the XLA fusion) reads it exactly once — and under the kernel
+    tier the certificate pair (``certificate``: 2 -> 1) and the
+    sketch's deep-fold + extremes pair (``sketch``: 2 -> 1) collapse
+    too. ``phase`` partitions over the closed consumer set
+    (``histogram`` | ``collect`` | ``tee`` | ``certificate`` |
+    ``sketch`` | ``monitor`` | ``fused``).
     Byte counts
     are the PADDED bucket bytes (what the program actually sweeps), the
     same unit as ``ingest.staged_bytes`` — so ``bucket_read_bytes /

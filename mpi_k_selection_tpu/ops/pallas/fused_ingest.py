@@ -40,6 +40,16 @@ pallas kernels interpret; the jit program is plain XLA elsewhere) — the
 fusion is a dispatch/read-count contract, observable through the
 ``ingest.bucket_reads{phase}`` counter (obs/wiring.py:bucket_read) and
 the KSL014 lint rule, not a TPU-only code path.
+
+Since ISSUE 13 this program is the ``fused="xla"`` TIER: one dispatch
+with shared subexpressions, but no guarantee XLA walks the bucket only
+once inside it. The hand-written single-sweep kernel
+(ops/pallas/sweep_ingest.py, the ``"kernel"`` tier and the ``"auto"``
+default on TPU backends) makes the one-HBM-read contract structural;
+this module remains the fallback for buckets outside the kernel's
+support matrix and the cheap-compile default off-TPU — and
+:func:`compact_core` remains the compaction oracle the kernel's buffers
+are bit-identical to.
 """
 
 from __future__ import annotations

@@ -1,0 +1,561 @@
+"""Single-sweep Pallas ingest kernel — one GUARANTEED HBM read per
+staged bucket.
+
+PR 11's fused ingest (ops/pallas/fused_ingest.py) collapsed the per-chunk
+device programs of a streamed pass into ONE XLA program per staged
+bucket: one dispatch, shared subexpressions — but an XLA program is a
+scheduling contract, not a memory-traffic one. XLA may (and for the
+independent histogram / compaction / tee subgraphs often does) walk the
+same pow2 staging bucket once per consumer inside that single program,
+so "one dispatch" never guaranteed "one sweep". This module is the
+hand-written kernel the ROADMAP's follow-on (c) asked for: a grid-tiled
+Pallas kernel that, in ONE sequential pass over the bucket's
+``(block_rows, 128)`` tiles, accumulates EVERY product a staged bucket's
+consumers need — each tile is DMA'd to VMEM exactly once and every
+consumer's accumulator is updated from that resident tile, the
+Blocked-Filter/ShearSort single-pass shape (PAPERS.md):
+
+- the (multi-prefix) radix digit histogram of the descent pass — the
+  very ``z = (key >> shift) ^ (prefix << radix_bits)`` digit/prefix
+  fusion of the histogram kernels, accumulated per lane;
+- one front-compacted ``(survivors, int32 count)`` pair per survivor-
+  collect spec, plus the spill tee's union-of-specs payload: per-spec
+  running offsets live in SMEM scratch, each tile's survivors compact to
+  the front of a tile-shaped staging window that lands at the running
+  offset (the next tile's window overwrites this one's tail, so the
+  final buffer is bit-identical to ``fused_ingest.compact_core``'s —
+  survivors front-packed in chunk order, zeros after);
+- the rank certificate's ``(#keys < v, #keys <= v)`` pair (pad lanes
+  excluded in kernel via the global-position mask, so the pair needs no
+  host correction), compared in signed space by folding the
+  uint32->int32 bias into both sides exactly like ``pallas_tau_counts``;
+- the sketch's deepest-level histogram (pads counted, like the staged
+  histogram — the consumer's exact bucket-0 subtraction is unchanged)
+  and the key-space min/max extremes with pad lanes masked to the
+  unsigned identities exactly as ``sketch._staged_extremes`` does —
+  closing the last 2-programs-per-staged-bucket consumer
+  (``ingest.bucket_reads{phase="sketch"}`` drops to 1).
+
+Like the histogram kernels, the kernel interprets off-TPU
+(``interpret = jax.default_backend() != "tpu"``), where it is the exact
+jnp program — so the CPU CI enforces bit-equality against both the XLA
+fusion tier and the unfused oracle, and the compiled kernel's bandwidth
+factor is what ``tpu_smoke.py``'s kernel leg records on silicon.
+
+Support matrix (:func:`sweep_supported` — unsupported buckets fall back
+to the XLA fusion tier per bucket, never to a wrong answer): 4-byte key
+space (uint32 — the int32/uint32/float32/int16-as-uint32 streams stage
+as uint32; uint16/uint64 key spaces ride the XLA tier), buckets of at
+least one ``(1, 128)`` lane tile, ``radix_bits <= 8`` histograms and
+sketch resolutions up to 20 bits (the RadixSketch cap). Trail
+discipline matches the XLA tier: every data-dependent value
+(``n_valid``, the histogram prefixes, the ``(shift, prefix)`` spec
+scalars, the certificate key) rides as a traced SMEM scalar, so the
+program compiles once per (bucket, dtype, #hist-prefixes, #collect,
+#tee, parts) and its primitive trail is bucket-size-stable — nothing in
+the kernel body unrolls on the tile count (the per-bucket KSC103
+contract ``analysis/jaxpr_checks.py:_streaming_sweep_ingest_cases``
+pins at both staging buckets). The survivor and deep-histogram outputs
+live in compiler-placed memory (``pl.ANY``) and the survivor windows
+are written with dynamic-start, static-size stores — the one construct
+whose Mosaic lowering the silicon run validates; interpret mode
+executes it exactly.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+LANES = 128
+
+#: Tile height of the sweep grid. 512 rows x 128 lanes x 4 B = 256 KB of
+#: key data per step — small enough that the per-part accumulators (the
+#: largest: a 2^20-counter sketch level, 4 MB) and Pallas's double
+#: buffering stay inside the 16 MB scoped-VMEM budget together.
+DEFAULT_BLOCK_ROWS = 512
+
+#: Histogram digits wider than this leave the per-lane accumulator
+#: VPU-unfriendly (2**rb compare rows per prefix); the streaming descent
+#: never exceeds 8.
+_MAX_KERNEL_RADIX_BITS = 8
+
+#: RadixSketch's own fixed-size cap (streaming/sketch.py) — the deepest
+#: level is a flat (2**bits,) int32 accumulator, 4 MB at 20 bits.
+_MAX_KERNEL_SKETCH_BITS = 20
+
+_PALLAS_OK = None
+
+
+def _pallas_available() -> bool:
+    """Whether this jax build carries the TPU pallas backend (it is
+    importable on CPU builds too, where the kernel interprets) — the
+    histogram kernels' own availability guard, probed lazily so this
+    module stays jax-import-free at load time (streaming/executor.py
+    imports it eagerly)."""
+    global _PALLAS_OK
+    if _PALLAS_OK is None:
+        try:
+            from jax.experimental.pallas import tpu as _  # noqa: F401
+
+            _PALLAS_OK = True
+        except ImportError:  # pragma: no cover - all CI builds carry it
+            _PALLAS_OK = False
+    return _PALLAS_OK
+
+
+def _i32const(v: int) -> int:
+    """Python int with the uint32 bit pattern ``v`` as a signed int32
+    value (the kernel computes on int32 bit patterns)."""
+    v &= 0xFFFFFFFF
+    return v - (1 << 32) if v >= (1 << 31) else v
+
+
+def sweep_supported(staged, kdt, *, radix_bits=None, sketch_bits=0) -> bool:
+    """Whether the sweep kernel covers this staged bucket's geometry —
+    the per-bucket gate the kernel tier consults before dispatching
+    (False = that bucket rides the XLA fusion tier instead; the answer
+    is bit-identical either way, this only picks the program)."""
+    if not _pallas_available():
+        return False
+    if np.dtype(kdt).itemsize != 4:
+        return False
+    bucket = int(staged.data.shape[0])
+    if bucket < LANES or bucket % LANES:
+        return False
+    if bucket & (bucket - 1):
+        # non-pow2 lane multiples (e.g. 768 rows) can leave the tile
+        # height not dividing the row count — sweep_ingest_core would
+        # raise rather than truncate, so route them to the XLA tier
+        return False
+    if radix_bits is not None and radix_bits > _MAX_KERNEL_RADIX_BITS:
+        return False
+    if sketch_bits and sketch_bits > _MAX_KERNEL_SKETCH_BITS:
+        return False
+    return True
+
+
+def _sweep_kernel(
+    *refs,
+    shift,
+    radix_bits,
+    nq,
+    n_collect,
+    n_tee,
+    cert,
+    sketch_bits,
+    block_rows,
+):
+    """One grid step: consume one resident (block_rows, 128) tile for
+    EVERY enabled part. Ref layout (inputs, then outputs in part order,
+    then scratch): ``nv, zrefs, cshifts, cprefs, tshifts, tprefs, vkey,
+    keys | [hist] [counts surv_0..surv_C-1 [tee]] [cert] [deep ext] |
+    carries``."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    (nv_ref, zrefs_ref, csh_ref, cpr_ref, tsh_ref, tpr_ref, vk_ref,
+     keys_ref) = refs[:8]
+    outs = list(refs[8:-1])
+    carry_ref = refs[-1]
+    i = pl.program_id(0)
+    nb = 1 << radix_bits
+    rows = block_rows
+    belems = rows * LANES
+
+    ku = keys_ref[:]  # (rows, LANES) key-space uint32
+    k = jax.lax.bitcast_convert_type(ku, jnp.int32)
+    # element order is the raveled bucket's (lane fastest): the global
+    # position masks pads and keeps compaction order == chunk order
+    gpos = (
+        (i * rows + jax.lax.broadcasted_iota(jnp.int32, (rows, LANES), 0))
+        * LANES
+        + jax.lax.broadcasted_iota(jnp.int32, (rows, LANES), 1)
+    )
+    valid = gpos < nv_ref[0, 0]
+    bias = jnp.int32(_i32const(1 << 31))
+    sb = k ^ bias  # signed-comparable key view (pallas_tau_counts trick)
+
+    oi = 0
+    hist_ref = counts_ref = cert_ref = deep_ref = ext_ref = None
+    surv_refs = []
+    if nq:
+        hist_ref = outs[oi]
+        oi += 1
+    n_surv = n_collect + (1 if n_tee else 0)
+    if n_surv:
+        counts_ref = outs[oi]
+        oi += 1
+        surv_refs = outs[oi:oi + n_surv]
+        oi += n_surv
+    if cert:
+        cert_ref = outs[oi]
+        oi += 1
+    if sketch_bits:
+        deep_ref, ext_ref = outs[oi], outs[oi + 1]
+        oi += 2
+
+    @pl.when(i == 0)
+    def _():
+        if hist_ref is not None:
+            hist_ref[:] = jnp.zeros_like(hist_ref)
+        if counts_ref is not None:
+            counts_ref[:] = jnp.zeros_like(counts_ref)
+        for sr in surv_refs:
+            sr[:] = jnp.zeros_like(sr)  # compact_core's zeros-after tail
+        if cert_ref is not None:
+            cert_ref[:] = jnp.zeros_like(cert_ref)
+        if sketch_bits:
+            deep_ref[:] = jnp.zeros_like(deep_ref)
+            # biased-space reduction identities: +max for min, -max for max
+            ext_ref[0] = jnp.full((LANES,), jnp.int32(0x7FFFFFFF))
+            ext_ref[1] = jnp.full((LANES,), bias)
+        carry_ref[:] = jnp.zeros_like(carry_ref)
+
+    if nq:
+        # the histogram kernels' digit/prefix fusion, over the WHOLE
+        # padded tile (pads are key 0 — the host finish subtracts them,
+        # exactly as for the staged XLA histogram)
+        s = jax.lax.shift_right_logical(k, jnp.int32(shift))
+        for q in range(nq):
+            z = s ^ zrefs_ref[q, 0]
+            hist_ref[q * nb:(q + 1) * nb] += jnp.stack(
+                [
+                    jnp.sum(z == jnp.int32(b), axis=0, dtype=jnp.int32)
+                    for b in range(nb)
+                ]
+            )
+
+    def compact(mask, slot):
+        # front-compact this tile's survivors into the running window of
+        # survivor output `slot` and advance its SMEM offset; the window
+        # write is dynamic-start/static-size, and the next tile's window
+        # overwrites this one's zero tail — so the final buffer is
+        # front-packed survivors in chunk order, zeros after
+        mf = mask.reshape(-1)
+        csum = jnp.cumsum(mf.astype(jnp.int32))
+        cnt = csum[belems - 1]
+        tgt = jnp.where(mf, csum - 1, jnp.int32(belems))
+        comp = (
+            jnp.zeros((belems,), ku.dtype)
+            .at[tgt]
+            .set(ku.reshape(-1), mode="drop")
+        )
+        carry = carry_ref[slot]
+        surv_refs[slot][pl.ds(carry, belems)] = comp
+        carry_ref[slot] = carry + cnt
+        counts_ref[slot] += jnp.sum(mask, axis=0, dtype=jnp.int32)
+
+    for j in range(n_collect):
+        m = (
+            jax.lax.shift_right_logical(k, csh_ref[j, 0]) == cpr_ref[j, 0]
+        ) & valid
+        compact(m, j)
+    if n_tee:
+        m = None
+        for j in range(n_tee):
+            mj = jax.lax.shift_right_logical(k, tsh_ref[j, 0]) == tpr_ref[j, 0]
+            m = mj if m is None else (m | mj)
+        compact(m & valid, n_collect)
+
+    if cert:
+        vb = vk_ref[0, 0]
+        cert_ref[0] += jnp.sum((sb < vb) & valid, axis=0, dtype=jnp.int32)
+        cert_ref[1] += jnp.sum((sb <= vb) & valid, axis=0, dtype=jnp.int32)
+
+    if sketch_bits:
+        dig = jax.lax.shift_right_logical(k, jnp.int32(32 - sketch_bits))
+        # flat scatter-add: the deepest level (up to 2^20 counters) is
+        # too wide for per-lane rows; pads count into bucket 0 like the
+        # staged XLA fold (the consumer's exact subtraction is unchanged)
+        deep_ref[:] = deep_ref[:].at[dig.reshape(-1)].add(1)
+        ext_ref[0] = jnp.minimum(
+            ext_ref[0],
+            jnp.min(jnp.where(valid, sb, jnp.int32(0x7FFFFFFF)), axis=0),
+        )
+        ext_ref[1] = jnp.maximum(
+            ext_ref[1], jnp.max(jnp.where(valid, sb, bias), axis=0)
+        )
+
+
+def sweep_ingest_core(
+    data,
+    n_valid,
+    hist_prefixes,
+    c_shifts,
+    c_prefixes,
+    t_shifts,
+    t_prefixes,
+    vkey,
+    *,
+    shift=0,
+    radix_bits=1,
+    hist_mode="none",
+    n_collect=0,
+    n_tee=0,
+    cert=False,
+    sketch_bits=0,
+    block_rows=DEFAULT_BLOCK_ROWS,
+    interpret=None,
+):
+    """ONE grid sweep of a pow2-padded staging bucket producing every
+    enabled consumer product as ``(hist, collect, tee, cert, sketch)``:
+
+    - ``hist``: ``(K, 2**radix_bits)`` int32 digit histograms over the
+      whole padded buffer (``hist_mode="multi"``; ``None`` for
+      ``"none"``) — the same per-chunk partial as the staged XLA
+      dispatch, pad-corrected host-side at finish.
+    - ``collect``: ``n_collect`` ``(compacted, int32 count)`` pairs,
+      bit-identical to ``fused_ingest.compact_core`` per spec.
+    - ``tee``: the union-of-``n_tee``-specs pair (``None`` when no tee).
+    - ``cert``: ``(#keys < vkey, #keys <= vkey)`` int32 pair over the
+      valid prefix (pad-exact in kernel; ``None`` unless ``cert``).
+    - ``sketch``: ``(deep int32 histogram of the top sketch_bits key
+      bits over the whole padded buffer, key-space min, key-space max)``
+      (``None`` unless ``sketch_bits``).
+
+    Only the part set, the kernel geometry and ``radix_bits``/
+    ``sketch_bits`` are static — every data value rides traced, so the
+    program compiles once per (bucket, dtype, part shape) and its
+    primitive trail is bucket-size-stable (KSC102/KSC103 grid)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from mpi_k_selection_tpu.utils import compat
+
+    if hist_mode not in ("none", "multi"):
+        raise ValueError(f"unknown hist_mode {hist_mode!r}")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    bucket = data.shape[0]
+    if bucket < LANES or bucket % LANES:
+        raise ValueError(
+            f"sweep kernel wants a whole-lane-tile bucket, got {bucket}"
+        )
+    rows = bucket // LANES
+    br = min(block_rows, rows)  # pow2 bucket: br always divides rows
+    if rows % br:
+        raise ValueError(
+            f"sweep kernel tile height {br} does not divide the bucket's "
+            f"{rows} rows ({bucket} elements) — pad the bucket to a power "
+            "of two (the staging contract) or pass a dividing block_rows"
+        )
+    grid = rows // br
+    nq = int(hist_prefixes.shape[0]) if hist_mode == "multi" else 0
+    nb = 1 << radix_bits
+    n_surv = n_collect + (1 if n_tee else 0)
+    kdt = data.dtype
+
+    # traced SMEM scalars: the digit/prefix fusion references, the spec
+    # scalars (shift counts are plain small ints; prefixes are bit
+    # patterns), the biased certificate key. Disabled parts ride one
+    # zero placeholder row (no zero-size SMEM operands) that the static
+    # part flags keep off the kernel's trace.
+    def i32bits(u):
+        return jax.lax.bitcast_convert_type(
+            u.astype(jnp.uint32), jnp.int32
+        ).reshape(-1, 1)
+
+    zero1 = jnp.zeros((1,), jnp.uint32)
+    zrefs = i32bits(
+        jax.lax.shift_left(
+            hist_prefixes.astype(jnp.uint32), jnp.uint32(radix_bits)
+        )
+        if nq
+        else zero1
+    )
+    csh = (c_shifts if n_collect else zero1).astype(jnp.int32).reshape(-1, 1)
+    cpr = i32bits(c_prefixes if n_collect else zero1)
+    tsh = (t_shifts if n_tee else zero1).astype(jnp.int32).reshape(-1, 1)
+    tpr = i32bits(t_prefixes if n_tee else zero1)
+    vk = i32bits(
+        (jnp.asarray(vkey).astype(jnp.uint32) if cert else zero1[0])
+        ^ jnp.uint32(1 << 31)
+    )
+    nv = jnp.asarray(n_valid, jnp.int32).reshape(1, 1)
+
+    kernel = functools.partial(
+        _sweep_kernel,
+        shift=shift,
+        radix_bits=radix_bits,
+        nq=nq,
+        n_collect=n_collect,
+        n_tee=n_tee,
+        cert=cert,  # a static jit flag already — bool() would host-sync
+        sketch_bits=sketch_bits,
+        block_rows=br,
+    )
+
+    def smem_spec(n):
+        return pl.BlockSpec((n, 1), lambda i: (0, 0), memory_space=pltpu.SMEM)
+
+    def acc_spec(shape):
+        # a grid-persistent VMEM accumulator (index_map pinned to the
+        # origin — the histogram kernels' accumulation discipline)
+        return pl.BlockSpec(
+            shape, lambda i: tuple(0 for _ in shape), memory_space=pltpu.VMEM
+        )
+
+    in_specs = [
+        smem_spec(1),                  # n_valid
+        smem_spec(max(nq, 1)),         # hist z references
+        smem_spec(max(n_collect, 1)),  # collect shifts
+        smem_spec(max(n_collect, 1)),  # collect prefixes
+        smem_spec(max(n_tee, 1)),      # tee shifts
+        smem_spec(max(n_tee, 1)),      # tee prefixes
+        smem_spec(1),                  # biased certificate key
+        pl.BlockSpec((br, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM),
+    ]
+    out_specs = []
+    out_shapes = []
+
+    def add_out(shape, dtype, space):
+        out_shapes.append(jax.ShapeDtypeStruct(shape, dtype))
+        out_specs.append(
+            acc_spec(shape)
+            if space is pltpu.VMEM
+            # compiler-placed (HBM-resident on TPU), whole-ref: written
+            # through the running windows / the flat scatter, never
+            # re-read in kernel
+            else pl.BlockSpec(memory_space=space)
+        )
+
+    if nq:
+        add_out((nq * nb, LANES), jnp.int32, pltpu.VMEM)
+    if n_surv:
+        add_out((n_surv, LANES), jnp.int32, pltpu.VMEM)  # per-lane counts
+        for _ in range(n_surv):
+            add_out((bucket,), kdt, pl.ANY)
+    if cert:
+        add_out((2, LANES), jnp.int32, pltpu.VMEM)
+    if sketch_bits:
+        add_out((1 << sketch_bits,), jnp.int32, pl.ANY)
+        add_out((2, LANES), jnp.int32, pltpu.VMEM)
+
+    # trace with x64 off: the kernel is int32-only (Mosaic cannot
+    # legalize x64-traced grid indices — the histogram kernels' rule)
+    with compat.enable_x64(False):
+        results = pl.pallas_call(
+            kernel,
+            grid=(grid,),
+            in_specs=in_specs,
+            out_specs=out_specs,
+            out_shape=out_shapes,
+            scratch_shapes=[pltpu.SMEM((max(n_surv, 1),), jnp.int32)],
+            interpret=interpret,
+        )(nv, zrefs, csh, cpr, tsh, tpr, vk, data.reshape(rows, LANES))
+    results = list(results)
+
+    hist = None
+    if nq:
+        lanes = results.pop(0)
+        hist = jnp.sum(lanes.reshape(nq, nb, LANES), axis=2, dtype=jnp.int32)
+    collect = ()
+    tee = None
+    if n_surv:
+        cnt_lanes = results.pop(0)
+        bufs = [results.pop(0) for _ in range(n_surv)]
+        pairs = [
+            (buf, jnp.sum(cnt_lanes[j], dtype=jnp.int32))
+            for j, buf in enumerate(bufs)
+        ]
+        collect = tuple(pairs[:n_collect])
+        if n_tee:
+            tee = pairs[n_collect]
+    cert_pair = None
+    if cert:
+        cl = results.pop(0)
+        cert_pair = (
+            jnp.sum(cl[0], dtype=jnp.int32),
+            jnp.sum(cl[1], dtype=jnp.int32),
+        )
+    sketch = None
+    if sketch_bits:
+        deep = results.pop(0)
+        ext = results.pop(0)
+        unbias = jnp.uint32(1 << 31)
+        kmin = (
+            jax.lax.bitcast_convert_type(jnp.min(ext[0]), jnp.uint32) ^ unbias
+        ).astype(kdt)
+        kmax = (
+            jax.lax.bitcast_convert_type(jnp.max(ext[1]), jnp.uint32) ^ unbias
+        ).astype(kdt)
+        sketch = (deep, kmin, kmax)
+    return hist, collect, tee, cert_pair, sketch
+
+
+_SWEEP_FN = None
+
+
+def _sweep_fn():
+    global _SWEEP_FN
+    if _SWEEP_FN is None:
+        import jax
+
+        _SWEEP_FN = jax.jit(
+            sweep_ingest_core,
+            static_argnames=(
+                "shift", "radix_bits", "hist_mode", "n_collect", "n_tee",
+                "cert", "sketch_bits", "block_rows", "interpret",
+            ),
+        )
+    return _SWEEP_FN
+
+
+def dispatch_sweep_ingest(
+    staged,
+    *,
+    kdt,
+    total_bits=32,
+    shift=None,
+    radix_bits=None,
+    hist_prefixes=None,
+    collect_specs=(),
+    tee_specs=(),
+    vkey=None,
+    sketch_bits=0,
+):
+    """Launch the sweep kernel for one staged chunk on its OWN device
+    (async dispatch — ``staged.data`` is committed, so the program runs
+    where the chunk lives). Part selection mirrors the consumers:
+    ``hist_prefixes`` (the pass's surviving prefix list, ``None`` = no
+    histogram), ``collect_specs``/``tee_specs`` as ``(resolved_bits,
+    prefix)`` lists, ``vkey`` the certificate's key-space probe value
+    (``None`` = no certificate part), ``sketch_bits`` the sketch's
+    resolution (0 = no sketch part). Returns the in-flight ``(hist,
+    collect, tee, cert, sketch)`` handle; callers gate on
+    :func:`sweep_supported` first — this raises on unsupported geometry
+    rather than silently falling back."""
+    from mpi_k_selection_tpu.ops.pallas.fused_ingest import _spec_arrays
+
+    if hist_prefixes is not None:
+        hist_mode = "multi"
+        hp = np.asarray(list(hist_prefixes), kdt)
+        hshift, hrb = shift, radix_bits
+    else:
+        hist_mode = "none"
+        hp = np.empty((0,), kdt)
+        hshift, hrb = 0, 1  # structural placeholders (one cache line)
+    c_shifts, c_prefixes = _spec_arrays(list(collect_specs), kdt, total_bits)
+    t_shifts, t_prefixes = _spec_arrays(list(tee_specs), kdt, total_bits)
+    return _sweep_fn()(
+        staged.data,
+        np.int32(staged.n_valid),
+        hp,
+        c_shifts,
+        c_prefixes,
+        t_shifts,
+        t_prefixes,
+        np.asarray(0 if vkey is None else vkey, kdt),
+        shift=hshift,
+        radix_bits=hrb,
+        hist_mode=hist_mode,
+        n_collect=len(collect_specs),
+        n_tee=len(tee_specs),
+        cert=vkey is not None,
+        sketch_bits=int(sketch_bits),
+    )

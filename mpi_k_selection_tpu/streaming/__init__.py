@@ -38,11 +38,15 @@ from mpi_k_selection_tpu.streaming.chunked import (
 from mpi_k_selection_tpu.streaming.executor import (
     DEFAULT_DEFERRED,
     DEFAULT_FUSED,
+    FUSED_MODES,
+    FUSED_TIERS,
     FusedIngestConsumer,
     StreamExecutor,
     collect_hidden_frac,
+    kernel_tier_available,
     resolve_deferred,
     resolve_fused,
+    validate_fused,
 )
 from mpi_k_selection_tpu.streaming.pipeline import (
     DEFAULT_PIPELINE_DEPTH,
@@ -68,6 +72,8 @@ __all__ = [
     "DEFAULT_FUSED",
     "DEFAULT_PIPELINE_DEPTH",
     "DEFAULT_SPILL",
+    "FUSED_MODES",
+    "FUSED_TIERS",
     "FusedIngestConsumer",
     "RadixSketch",
     "SPILL_DIR_PREFIX",
@@ -80,6 +86,7 @@ __all__ = [
     "as_chunk_source",
     "collect_hidden_frac",
     "ingest_hidden_frac",
+    "kernel_tier_available",
     "live_staged_keys",
     "resolve_deferred",
     "resolve_fused",
@@ -88,4 +95,5 @@ __all__ = [
     "streaming_kselect",
     "streaming_kselect_many",
     "streaming_rank_certificate",
+    "validate_fused",
 ]

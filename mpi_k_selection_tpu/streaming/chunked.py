@@ -455,11 +455,12 @@ def _collect_survivors(
     FIFO window pops (streaming/executor.py) — the consumer never blocks
     per chunk, which is what lets the collect pass scale with devices
     like the histogram passes. ``deferred=False`` keeps the historical
-    eager boolean gather. ``fused`` (resolved by the caller; implies
-    deferral) collapses the per-spec compaction dispatches into ONE
-    fused program per staged bucket (streaming/executor.py:
-    FusedIngestConsumer) — one read of each staged chunk instead of one
-    per spec. Survivor multisets are identical in every mode (and the
+    eager boolean gather. ``fused`` (the caller's RESOLVED tier —
+    ``"kernel"``/``"xla"``/False — and implies deferral) collapses the
+    per-spec compaction dispatches into ONE fused program per staged
+    bucket (streaming/executor.py:FusedIngestConsumer; the kernel tier
+    guarantees one read of each staged chunk, the xla tier one
+    dispatch). Survivor multisets are identical in every mode (and the
     final ``np.partition`` is order-invariant regardless)."""
     kdt = np.dtype(_dt.key_dtype(dtype))
     total_bits = _dt.key_bits(dtype)
@@ -471,7 +472,8 @@ def _collect_survivors(
     )
     consumer = (
         _ex.FusedIngestConsumer(
-            collect=collector, kdt=kdt, total_bits=total_bits, obs=obs
+            collect=collector, kdt=kdt, total_bits=total_bits, tier=fused,
+            obs=obs,
         )
         if fused
         else collector
@@ -656,11 +658,20 @@ def streaming_kselect(
     ``fused`` (default ``"auto"``) collapses the per-chunk device
     programs of each deferred pass — the digit histogram, the survivor
     compactions, the spill-tee payload — into ONE fused program per
-    staged bucket (ops/pallas/fused_ingest.py), so every staged key is
-    read once per pass instead of once per consumer. ``"off"`` keeps the
-    unfused consumer bundle as the bit-for-bit oracle; with
-    ``deferred="off"`` the bundle is unfused regardless (fusion is a
-    deferral discipline). Answers are bit-identical in every mode;
+    staged bucket, at one of two tiers: ``"kernel"`` dispatches the
+    hand-written single-sweep pallas kernel
+    (ops/pallas/sweep_ingest.py), which GUARANTEES one HBM read of the
+    bucket (each tile is VMEM-resident once and every consumer
+    accumulates from it; buckets outside the kernel's support matrix
+    fall back to the XLA tier per bucket); ``"xla"`` dispatches the
+    one-XLA-program fusion (ops/pallas/fused_ingest.py) — one dispatch,
+    shared subexpressions, read count up to XLA. ``"auto"`` resolves to
+    ``"kernel"`` on TPU backends and ``"xla"`` elsewhere (off-TPU the
+    kernel only interprets — exact but slow — the same resolution rule
+    as ``hist_method="auto"``). ``"off"`` keeps the unfused consumer
+    bundle as the bit-for-bit oracle; with ``deferred="off"`` the
+    bundle is unfused regardless (fusion is a deferral discipline).
+    Answers are bit-identical at every tier;
     ``ingest.bucket_reads{phase}`` (docs/OBSERVABILITY.md) makes the
     reads-per-pass collapse measurable.
 
@@ -765,7 +776,11 @@ def streaming_kselect_many(
     defer = _ex.resolve_deferred(deferred)
     # fusion is a deferral discipline: the fused handle materializes at
     # window-pop time, so deferred="off" implies the unfused eager bundle
-    fuse = _ex.resolve_fused(fused) and defer
+    # (fuse is the resolved TIER otherwise: "kernel" | "xla" | False);
+    # the knob still validates on the eager route — a typo must raise,
+    # not silently ride the oracle
+    fused = _ex.validate_fused(fused)
+    fuse = _ex.resolve_fused(fused) if defer else False
     policy = _fp.resolve_retry(retry)
     timer, _restore_recorder = _wr.attach_timer(obs, timer)
     occupancy = _wr.window_occupancy(obs, phase="descent")
@@ -1098,7 +1113,7 @@ def streaming_kselect_many(
                     consumers = [
                         _ex.FusedIngestConsumer(
                             hist=hist_c, tee=tee_c, kdt=kdt,
-                            total_bits=total_bits, obs=obs,
+                            total_bits=total_bits, tier=fuse, obs=obs,
                         )
                     ]
                 elif tee_c is not None:
@@ -1285,7 +1300,8 @@ def streaming_kselect_many(
 
 def streaming_rank_certificate(
     source, value, *, pipeline_depth: int = DEFAULT_PIPELINE_DEPTH, timer=None,
-    devices=None, deferred=DEFAULT_DEFERRED, retry=None, obs=None,
+    devices=None, deferred=DEFAULT_DEFERRED, fused=DEFAULT_FUSED, retry=None,
+    obs=None,
 ):
     """``(#elements < value, #elements <= value)`` streamed — the O(n)
     exactness proof of utils/debug.py:rank_certificate without residency:
@@ -1302,7 +1318,14 @@ def streaming_rank_certificate(
     whole padded bucket with an exact pad correction — one compile per
     staging bucket instead of one per ragged chunk length — and reads
     spill records via mmap; ``"off"`` keeps the historical valid-slice
-    sums (bit-identical counts either way). ``source`` may be a
+    sums (bit-identical counts either way). ``fused`` (default
+    ``"auto"``; see :func:`streaming_kselect`) engages the single-sweep
+    kernel at the ``"kernel"`` tier: a supported staged bucket's
+    ``(<, <=)`` pair rides ONE device program (one guaranteed read,
+    ``ingest.bucket_reads{phase="certificate"}`` = 1 per bucket) instead
+    of the deferred pair of count programs; the ``"xla"`` and ``"off"``
+    tiers keep the pair — there was never a separate XLA fusion for it —
+    and counts are bit-identical at every tier. ``source`` may be a
     :class:`~mpi_k_selection_tpu.streaming.spill.SpillStore` with a
     committed generation: the single counting pass then replays the
     spilled keys instead of the original stream (certifying a one-shot
@@ -1311,6 +1334,10 @@ def streaming_rank_certificate(
     the counting pass mid-pass re-pull on transient source errors and
     in-place staging retries — counts are bit-identical on recovery."""
     defer = _ex.resolve_deferred(deferred)
+    # fusion is a deferral discipline (streaming_kselect_many's rule);
+    # the knob validates on the eager route too
+    fused = _ex.validate_fused(fused)
+    fuse = _ex.resolve_fused(fused) if defer else False
     policy = _fp.resolve_retry(retry)
     src = as_chunk_source(source, mmap=defer)
     if policy is not None:
@@ -1337,7 +1364,7 @@ def streaming_rank_certificate(
                     )[0]
                     kdt = np.dtype(_dt.key_dtype(np.dtype(chunk.dtype)))
                     counter = _ex.CountLessLeqConsumer(
-                        vkey, kdt, deferred=defer, obs=obs
+                        vkey, kdt, deferred=defer, fused=fuse, obs=obs
                     )
                     # both counts dispatch async on the chunk's own device;
                     # the FIFO materializes the oldest once one bundle per

@@ -54,11 +54,15 @@ Determinism contract (the grid tests/test_executor.py enforces):
 On top of the deferral, the ``fused`` knob (default ``"auto"``)
 collapses a pass's per-chunk device programs — the histogram, the
 per-spec survivor compactions, the spill-tee payload — into ONE fused
-program per staged bucket (:class:`FusedIngestConsumer` +
-ops/pallas/fused_ingest.py), so every staged key is read once per pass
-instead of once per consumer; ``fused="off"`` keeps the unfused bundle
-as the bit-for-bit oracle, and lint rule KSL014 flags a second ingest
-program against one staged bucket anywhere else in the streaming layer.
+program per staged bucket (:class:`FusedIngestConsumer`), at one of two
+tiers: ``"kernel"`` (the hand-written single-sweep pallas program,
+ops/pallas/sweep_ingest.py — one GUARANTEED HBM read of the bucket; the
+``"auto"`` default on TPU backends) or ``"xla"`` (the one-XLA-program
+fusion, ops/pallas/fused_ingest.py — one dispatch, read count up to
+XLA; the ``"auto"`` default elsewhere). ``fused="off"`` keeps the
+unfused bundle as the bit-for-bit oracle, and lint rule KSL014 flags a
+second ingest program against one staged bucket anywhere else in the
+streaming layer.
 
 This file is the ONE sanctioned home for the eager
 ``np.asarray(<indexed device array>)`` gather under ``streaming/`` —
@@ -73,6 +77,7 @@ import numpy as np
 
 from mpi_k_selection_tpu.obs import wiring as _wr
 from mpi_k_selection_tpu.ops.pallas import fused_ingest as _fi
+from mpi_k_selection_tpu.ops.pallas import sweep_ingest as _si
 from mpi_k_selection_tpu.ops.pallas.fused_ingest import (
     compact_core as _compact_core,
 )
@@ -92,31 +97,70 @@ DEFERRED_MODES = ("auto", "on", "off")
 #: Default for the ``fused`` knob: fuse the per-chunk device programs —
 #: histogram, survivor compaction(s), spill-tee payload — into ONE
 #: program per staged bucket wherever deferral is engaged (bit-identical,
-#: strictly fewer reads of the same buffer). ``"off"`` keeps the unfused
-#: consumer bundle as the bit-for-bit oracle.
+#: strictly fewer reads of the same buffer). ``"auto"`` resolves to the
+#: hand-written sweep kernel tier on TPU backends (one GUARANTEED HBM
+#: read — ops/pallas/sweep_ingest.py) and the XLA fusion tier elsewhere,
+#: mirroring how ``hist_method="auto"`` resolves to the pallas histogram
+#: kernels on TPU. ``"off"`` keeps the unfused consumer bundle as the
+#: bit-for-bit oracle.
 DEFAULT_FUSED = "auto"
 
-#: The ``fused`` knob's string modes (bools are also accepted).
-FUSED_MODES = ("auto", "off")
+#: The ``fused`` knob's string modes (bools are also accepted):
+#: ``kernel`` = the single-sweep pallas program (interpret-mode off-TPU),
+#: ``xla`` = the one-XLA-program fusion (PR 11's behavior), ``off`` = the
+#: unfused per-consumer bundle, ``auto`` = kernel on TPU, xla elsewhere.
+FUSED_MODES = ("auto", "kernel", "xla", "off")
+
+#: The resolved fusion tiers ``resolve_fused`` can return (besides
+#: ``False`` for the unfused bundle).
+FUSED_TIERS = ("kernel", "xla")
 
 
-def resolve_fused(fused) -> bool:
-    """Normalize the ``fused`` knob to a bool (True = the fused
-    single-read ingest program replaces the per-consumer device dispatches
-    for staged chunks). Accepts ``"auto"``/``"off"`` or a plain bool;
-    ``"auto"`` (the default) fuses wherever deferral is engaged — fusion
-    IS a deferral discipline, so ``deferred="off"`` implies the unfused
-    bundle regardless (the resolution in streaming/chunked.py ANDs the
-    two)."""
-    if isinstance(fused, (bool, np.bool_)):
-        return bool(fused)
-    if fused == "auto":
-        return True
-    if fused == "off":
+def kernel_tier_available() -> bool:
+    """Whether ``fused="auto"`` resolves to the sweep-kernel tier: a jax
+    build carrying pallas, on a TPU backend — the same resolution rule as
+    ``hist_method="auto"`` (ops/histogram.py routes to the pallas kernels
+    on TPU, scatter elsewhere). Off-TPU the kernel only interprets
+    (exact but slow), so ``"auto"`` keeps the XLA tier there; pass
+    ``fused="kernel"`` to force the interpret-mode kernel."""
+    if not _si._pallas_available():
         return False
+    import jax
+
+    return jax.default_backend() == "tpu"
+
+
+def validate_fused(fused) -> str:
+    """Check the ``fused`` knob and return its normalized mode string
+    WITHOUT resolving ``"auto"`` to a tier — unlike :func:`resolve_fused`
+    this never probes the jax backend, so validation-only paths (the
+    eager ``StreamingQuantiles.__init__`` check, the ``deferred="off"``
+    route that forces the unfused bundle anyway) reject a typo'd knob
+    without triggering platform/device initialization."""
+    if isinstance(fused, (bool, np.bool_)):
+        return "auto" if fused else "off"
+    if fused in FUSED_MODES:
+        return fused
     raise ValueError(
         f"fused must be one of {FUSED_MODES} or a bool, got {fused!r}"
     )
+
+
+def resolve_fused(fused):
+    """Normalize the ``fused`` knob to a resolved tier: ``"kernel"`` (the
+    single-sweep pallas program), ``"xla"`` (the one-XLA-program fusion),
+    or ``False`` (the unfused per-consumer bundle, the bit-for-bit
+    oracle). Accepts the :data:`FUSED_MODES` strings or a plain bool
+    (True = ``"auto"``); ``"auto"`` resolves via
+    :func:`kernel_tier_available`. Fusion IS a deferral discipline, so
+    ``deferred="off"`` implies the unfused bundle regardless (the
+    resolution in streaming/chunked.py applies that)."""
+    fused = validate_fused(fused)
+    if fused == "auto":
+        return "kernel" if kernel_tier_available() else "xla"
+    if fused == "off":
+        return False
+    return fused
 
 
 def resolve_deferred(deferred) -> bool:
@@ -498,14 +542,28 @@ class CountLessLeqConsumer(Consumer):
     bucket, like the histograms) with the exact pad correction applied at
     finish — pad keys are key-space 0, so each pad lane counts into
     ``< v`` iff ``v != 0`` and into ``<= v`` always (unsigned key space).
-    Eager: the historical sums over the ragged valid slice."""
+    Under the ``"kernel"`` fusion tier a supported staged bucket
+    dispatches the single-sweep program instead (ONE device program — and
+    one guaranteed read — per bucket, vs the deferred pair; the kernel
+    masks pads exactly, so its handle needs no correction). Eager: the
+    historical sums over the ragged valid slice."""
 
-    def __init__(self, vkey, kdt, *, deferred: bool, obs=None):
+    def __init__(self, vkey, kdt, *, deferred: bool, fused=False, obs=None):
+        if fused and fused not in FUSED_TIERS:
+            raise ValueError(
+                f"fused tier must be one of {FUSED_TIERS} or False, "
+                f"got {fused!r}"
+            )
         self.less = 0
         self.leq = 0
         self._vkey = vkey
         self._kdt = kdt
         self._deferred = bool(deferred)
+        # fusion is a deferral discipline (the handle materializes at
+        # window-pop time), and only the kernel tier changes anything
+        # here — the certificate pair was never a separate XLA program
+        # to fuse, so the xla tier keeps the deferred pair
+        self._kernel = bool(deferred) and fused == "kernel"
         self._obs = obs
 
     def dispatch(self, keys, kv):
@@ -515,6 +573,17 @@ class CountLessLeqConsumer(Consumer):
             return None
         import jax.numpy as jnp
 
+        if (
+            self._kernel
+            and isinstance(keys, StagedKeys)
+            and _si.sweep_supported(keys, self._kdt)
+        ):
+            # ONE sweep program per staged bucket (pad-exact in kernel)
+            _wr.bucket_read(self._obs, "certificate", keys, 1)
+            _, _, _, (lt, le), _ = _si.dispatch_sweep_ingest(
+                keys, kdt=self._kdt, vkey=self._vkey
+            )
+            return (lt, le, 0)
         if isinstance(keys, StagedKeys):
             # two count programs (< and <=) per staged bucket
             _wr.bucket_read(self._obs, "certificate", keys, 2)
@@ -539,30 +608,45 @@ class CountLessLeqConsumer(Consumer):
 class FusedIngestConsumer(Consumer):
     """ONE device program per staged bucket per pass — the fused
     replacement for the Histogram/Collect/SpillTee consumer bundle
-    (ops/pallas/fused_ingest.py; the ``fused`` knob, default ``"auto"``).
+    (the ``fused`` knob, default ``"auto"``), at either fusion tier:
+    ``"kernel"`` dispatches the single-sweep pallas program
+    (ops/pallas/sweep_ingest.py — one GUARANTEED HBM read of the
+    bucket), ``"xla"`` the one-XLA-program fusion
+    (ops/pallas/fused_ingest.py — one dispatch, read count up to XLA).
+    Both tiers return the same ``(hist, collect, tee)`` handle
+    structure, so one finish path serves both; a kernel-tier bucket the
+    sweep kernel does not cover (:func:`~mpi_k_selection_tpu.ops.pallas.
+    sweep_ingest.sweep_supported` — small buckets, non-4-byte key
+    spaces) falls back to the XLA tier for that bucket, never to a
+    wrong answer.
 
     Wraps the very sub-consumers it replaces: a staged chunk dispatches
     the single fused program (histogram + per-spec compactions + tee
-    payload, one read of the buffer) and the FIFO-finish materializes
-    each part INTO the wrapped consumers' own accumulators — the pad
-    correction, survivor ordering, and writer append run through the
-    exact unfused finish code, so ``fused="off"`` (the unwrapped bundle)
-    is a bit-for-bit oracle by construction. Chunks that never staged
-    (host chunks, the host-exact routes, depth-0 device chunks) fall
-    back to the sub-consumers' own dispatch/finish — the fused path is a
-    read-count optimization for staged buckets only.
+    payload) and the FIFO-finish materializes each part INTO the wrapped
+    consumers' own accumulators — the pad correction, survivor ordering,
+    and writer append run through the exact unfused finish code, so
+    ``fused="off"`` (the unwrapped bundle) is a bit-for-bit oracle by
+    construction. Chunks that never staged (host chunks, the host-exact
+    routes, depth-0 device chunks) fall back to the sub-consumers' own
+    dispatch/finish — the fused path is a read-count optimization for
+    staged buckets only.
 
     Construction invariant: callers build this only when deferral is
     resolved on (fusion IS a deferral discipline — the fused handle
     materializes at window-pop time like any deferred handle)."""
 
     def __init__(self, *, hist=None, collect=None, tee=None, kdt,
-                 total_bits, obs=None):
+                 total_bits, tier="xla", obs=None):
         if hist is None and collect is None and tee is None:
             raise ValueError("FusedIngestConsumer needs at least one part")
+        if tier not in FUSED_TIERS:
+            raise ValueError(
+                f"fused tier must be one of {FUSED_TIERS}, got {tier!r}"
+            )
         self._hist = hist
         self._collect = collect
         self._tee = tee
+        self._tier = tier
         # unfused fallback order mirrors the historical bundle: tee first
         # (its eager form writes before the histogram handle can finish)
         self._subs = [c for c in (tee, hist, collect) if c is not None]
@@ -587,17 +671,34 @@ class FusedIngestConsumer(Consumer):
             if self._tee is not None
             else None
         )
-        handle = _fi.dispatch_fused_ingest(
-            keys,
-            kdt=self._kdt,
-            total_bits=self._bits,
-            shift=shift,
-            radix_bits=radix_bits,
-            hist_prefixes=hist_prefixes,
-            method=method,
-            collect_specs=self._collect.specs if self._collect else (),
-            tee_specs=self._tee._specs if self._tee else (),
-        )
+        collect_specs = self._collect.specs if self._collect else ()
+        tee_specs = self._tee._specs if self._tee else ()
+        if self._tier == "kernel" and _si.sweep_supported(
+            keys, self._kdt, radix_bits=radix_bits
+        ):
+            hist_h, collect_h, tee_h, _, _ = _si.dispatch_sweep_ingest(
+                keys,
+                kdt=self._kdt,
+                total_bits=self._bits,
+                shift=shift,
+                radix_bits=radix_bits,
+                hist_prefixes=hist_prefixes,
+                collect_specs=collect_specs,
+                tee_specs=tee_specs,
+            )
+            handle = (hist_h, collect_h, tee_h)
+        else:
+            handle = _fi.dispatch_fused_ingest(
+                keys,
+                kdt=self._kdt,
+                total_bits=self._bits,
+                shift=shift,
+                radix_bits=radix_bits,
+                hist_prefixes=hist_prefixes,
+                method=method,
+                collect_specs=collect_specs,
+                tee_specs=tee_specs,
+            )
         return ("fused", (keys, slot, handle))
 
     def finish(self, handle) -> None:

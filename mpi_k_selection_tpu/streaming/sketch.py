@@ -78,24 +78,43 @@ def _staged_extremes(data, n_valid):
 class _SketchFoldConsumer:
     """The sketch's :class:`~mpi_k_selection_tpu.streaming.executor.
     StreamExecutor` consumer: staged chunks dispatch their deepest-level
-    int32 histogram + key-space extremes on their OWN device
-    (:meth:`RadixSketch._dispatch_staged`) and fold in FIFO chunk order at
-    finish; host/device-resident chunks fold immediately at dispatch (the
-    historical inline path). Buffer release rides the executor."""
+    int32 histogram + key-space extremes on their OWN device and fold in
+    FIFO chunk order at finish; host/device-resident chunks fold
+    immediately at dispatch (the historical inline path). Buffer release
+    rides the executor.
 
-    def __init__(self, sketch: "RadixSketch", obs=None):
+    ``fused="kernel"`` (the resolved tier) closes the last
+    2-programs-per-staged-bucket consumer: a supported bucket dispatches
+    the single-sweep kernel's sketch part
+    (:meth:`RadixSketch._dispatch_staged_sweep` — deep histogram AND
+    extremes in ONE program, one guaranteed read,
+    ``ingest.bucket_reads{phase="sketch"}`` = 1 per bucket); the
+    ``"xla"``/off tiers keep the historical deep-fold + extremes pair
+    (2 programs). The folded pyramid is bit-identical either way."""
+
+    def __init__(self, sketch: "RadixSketch", obs=None, fused=False):
         self._sketch = sketch
         self._obs = obs
+        self._kernel = fused == "kernel"
         self.staged_chunks = 0
 
     def dispatch(self, keys, kv):
         import numpy as _np
 
         from mpi_k_selection_tpu.obs import wiring as _wr
+        from mpi_k_selection_tpu.ops.pallas import sweep_ingest as _si
         from mpi_k_selection_tpu.streaming import pipeline as _pl
 
         if isinstance(keys, _pl.StagedKeys):
             self.staged_chunks += 1
+            if self._kernel and _si.sweep_supported(
+                keys, self._sketch.kdt,
+                sketch_bits=self._sketch.resolution_bits,
+            ):
+                # ONE sweep program per staged bucket (deep histogram +
+                # extremes together — the single-read ingest)
+                _wr.bucket_read(self._obs, "sketch", keys, 1)
+                return self._sketch._dispatch_staged_sweep(keys)
             # two device programs per staged bucket (deep histogram +
             # extremes) — honest reads-per-pass accounting
             _wr.bucket_read(self._obs, "sketch", keys, 2)
@@ -185,7 +204,7 @@ class RadixSketch:
 
     def update_stream(
         self, source, *, pipeline_depth=None, timer=None, devices=None,
-        spill=None, obs=None,
+        spill=None, fused=None, obs=None,
     ) -> "RadixSketch":
         """Fold EVERY chunk of a replayable/listed ``source`` in (one
         stream pass), drawing from the pipelined iterator: a background
@@ -212,6 +231,14 @@ class RadixSketch:
         ``sketch.refine(store, k)`` runs the exact descent entirely from
         disk, never re-reading the original stream.
 
+        ``fused`` (``None`` resolves to the package default,
+        streaming/executor.py:DEFAULT_FUSED) picks the staged fold's
+        fusion tier: at ``"kernel"`` a supported staged bucket's deep
+        histogram and extremes ride ONE single-sweep program (one
+        guaranteed read; ``ingest.bucket_reads{phase="sketch"}`` = 1
+        per bucket) instead of the historical 2-program pair, which the
+        ``"xla"``/``"off"`` tiers keep. Bit-identical either way.
+
         ``obs`` (an :class:`~mpi_k_selection_tpu.obs.Observability`) emits
         per-chunk ingest events, a ``sketch.pass`` summary event, window
         occupancy samples and the StagingPool counters — off by default,
@@ -232,6 +259,11 @@ class RadixSketch:
 
         pipeline_depth = _pl.validate_pipeline_depth(pipeline_depth)
         devs = _pl.resolve_stream_devices(devices)
+        # the staged fold is deferred by construction (it rides the FIFO
+        # window), so the tier resolves unconditionally
+        fuse = _exec.resolve_fused(
+            _exec.DEFAULT_FUSED if fused is None else fused
+        )
         timer, _restore_recorder = _wr.attach_timer(obs, timer)
         multi = len(devs) > 1 and pipeline_depth > 0
         if spill is not None and not isinstance(spill, _sp.SpillStore):
@@ -241,7 +273,7 @@ class RadixSketch:
             )
         src = as_chunk_source(source, one_shot_ok=spill is not None)
         writer = spill.new_generation() if spill is not None else None
-        consumer = _SketchFoldConsumer(self, obs=obs)
+        consumer = _SketchFoldConsumer(self, obs=obs, fused=fuse)
         ex = _exec.StreamExecutor(
             [consumer], window=len(devs),
             occupancy=_wr.window_occupancy(obs, phase="sketch"),
@@ -318,6 +350,19 @@ class RadixSketch:
         # bucket with the pad masked to the identities, so this half stays
         # bucket-shaped (one compile per bucket) like the histogram half
         dmin, dmax = _staged_extremes(staged.data, np.int32(staged.n_valid))
+        return staged, deep, dmin, dmax
+
+    def _dispatch_staged_sweep(self, staged) -> tuple:
+        """The kernel-tier twin of :meth:`_dispatch_staged`: deep
+        histogram AND extremes from ONE single-sweep program
+        (ops/pallas/sweep_ingest.py) — same handle shape, so
+        :meth:`_fold_staged` (and its exact pad subtraction) serves both
+        tiers unchanged."""
+        from mpi_k_selection_tpu.ops.pallas import sweep_ingest as _si
+
+        _, _, _, _, (deep, dmin, dmax) = _si.dispatch_sweep_ingest(
+            staged, kdt=self.kdt, sketch_bits=self.resolution_bits
+        )
         return staged, deep, dmin, dmax
 
     def _fold_staged(self, handle) -> None:

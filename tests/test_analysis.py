@@ -975,6 +975,67 @@ def test_ksl014_quiet_in_executor_outside_streaming_and_tests(tmp_path):
     assert "KSL014" not in _rules_hit(report)
 
 
+KSL014_SWEEP_POSITIVE = """
+    def run_pass(staged, specs, kdt):
+        # the sweep program IS the one sanctioned read; a histogram
+        # beside it re-reads the bucket
+        s = dispatch_sweep_ingest(staged, kdt=kdt, collect_specs=specs)
+        h = dispatch_chunk_histograms(staged, 16, 8, [0, 3], "scatter", kdt)
+        return s, h
+
+    def double_sweep(staged, kdt):
+        a = dispatch_sweep_ingest(staged, kdt=kdt, vkey=5)
+        b = sweep_ingest_core(staged.data, 7, hp, cs, cp, ts, tp, vk)
+        return a, b
+"""
+
+KSL014_SWEEP_NEGATIVE = """
+    def run_pass(staged, specs, kdt):
+        # ONE sweep program per staged chunk is the sanctioned shape
+        return dispatch_sweep_ingest(staged, kdt=kdt, collect_specs=specs,
+                                     vkey=5, sketch_bits=16)
+
+    def two_chunks(staged, other, kdt):
+        # a DIFFERENT chunk's sweep is not a re-read of this bucket
+        a = dispatch_sweep_ingest(staged, kdt=kdt, vkey=5)
+        b = dispatch_sweep_ingest(other, kdt=kdt, vkey=5)
+        return a, b
+"""
+
+
+def test_ksl014_recognizes_sweep_dispatchers(tmp_path):
+    report = _lint_source(
+        tmp_path, KSL014_SWEEP_POSITIVE,
+        name="mpi_k_selection_tpu/streaming/passes.py",
+    )
+    hits = [f for f in report.unsuppressed if f.rule == "KSL014"]
+    assert len(hits) == 2  # the second program in each function
+    assert all("re-reads the whole staged bucket" in f.message for f in hits)
+
+
+def test_ksl014_sweep_negative(tmp_path):
+    report = _lint_source(
+        tmp_path, KSL014_SWEEP_NEGATIVE,
+        name="mpi_k_selection_tpu/streaming/passes.py",
+    )
+    assert "KSL014" not in _rules_hit(report)
+
+
+def test_ksl014_sweep_noqa(tmp_path):
+    src = KSL014_SWEEP_POSITIVE.replace(
+        "h = dispatch_chunk_histograms(staged, 16, 8, [0, 3], \"scatter\", kdt)",
+        "h = dispatch_chunk_histograms(staged, 16, 8, [0, 3], \"scatter\", kdt)"
+        "  # ksel: noqa[KSL014] -- fixture justification",
+    )
+    report = _lint_source(
+        tmp_path, src, name="mpi_k_selection_tpu/streaming/passes.py"
+    )
+    hits = [f for f in report.unsuppressed if f.rule == "KSL014"]
+    assert len(hits) == 1  # the double_sweep pair still fires
+    sup = [f for f in report.findings if f.rule == "KSL014" and f.suppressed]
+    assert sup and sup[0].justification == "fixture justification"
+
+
 def test_ksl014_noqa(tmp_path):
     src = KSL014_POSITIVE.replace(
         "c = dispatch_compaction(staged, specs, kdt, 32)   # second read",

@@ -361,9 +361,13 @@ def test_eager_mode_disables_fusion(rng):
 
 
 def test_resolve_fused():
-    assert resolve_fused("auto") is True
+    # "auto" resolves to a fusion TIER now (ISSUE 13): the sweep kernel
+    # on TPU backends, the XLA fusion elsewhere — truthy either way, so
+    # every `if fused:` caller is unchanged; tier-specific assertions
+    # live in test_sweep_ingest.py
+    assert resolve_fused("auto") in ("kernel", "xla")
     assert resolve_fused("off") is False
-    assert resolve_fused(True) is True
+    assert resolve_fused(True) == resolve_fused("auto")
     assert resolve_fused(False) is False
     with pytest.raises(ValueError, match="fused"):
         resolve_fused("sometimes")

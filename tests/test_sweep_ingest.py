@@ -1,0 +1,501 @@
+"""Single-sweep Pallas ingest kernel (ops/pallas/sweep_ingest.py + the
+three-tier ``fused`` knob, ISSUE 13).
+
+The contracts under test:
+
+- **Bit-equality over the full grid**: devices {1, 2, max} x
+  pipeline_depth {0, 2} x spill {off, force} x fused {kernel, xla, off}
+  return identical bits over heterogeneous (host + device + ragged +
+  empty) chunk streams and the one-shot tee — ``fused="off"`` (the
+  unfused bundle) and ``"xla"`` (PR 11's one-program fusion) are the
+  bit-for-bit oracles of the kernel tier.
+- **Kernel vs numpy oracle**: the sweep program's histogram, per-spec
+  compactions, tee payload, certificate pair and sketch fold + extremes
+  equal the host filters — and the compaction BUFFERS are bit-identical
+  to the XLA tier's ``compact_core`` (front-packed survivors in chunk
+  order, zeros after), not just the materialized prefixes.
+- **One program per staged bucket**: under the kernel tier the sketch
+  consumer's ``ingest.bucket_reads{phase="sketch"}`` drops to exactly 1
+  per staged bucket (2 on the xla tier) and the certificate's
+  ``phase="certificate"`` to 1 (2 deferred pair otherwise).
+- **Graceful fallback**: buckets outside the kernel's support matrix
+  (sub-lane-tile buckets, non-4-byte key spaces) ride the XLA tier per
+  bucket with identical answers.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from mpi_k_selection_tpu import obs as obs_lib
+from mpi_k_selection_tpu.ops.pallas import fused_ingest as fi
+from mpi_k_selection_tpu.ops.pallas import sweep_ingest as si
+from mpi_k_selection_tpu.streaming import (
+    RadixSketch,
+    live_staged_keys,
+    resolve_fused,
+    streaming_kselect,
+    streaming_kselect_many,
+    streaming_rank_certificate,
+)
+from mpi_k_selection_tpu.streaming import executor as ex_mod
+from mpi_k_selection_tpu.streaming.pipeline import stage_keys
+
+
+def _chunks(rng, sizes=(4096, 1, 0, 2777, 4096), device_chunk=1):
+    out = [
+        rng.integers(-(2**31), 2**31 - 1, size=s, dtype=np.int32)
+        for s in sizes
+    ]
+    for i in range(device_chunk):
+        out[i * 3] = jnp.asarray(out[i * 3])
+    return out
+
+
+def _oracle(chunks, ks):
+    x = np.concatenate([np.asarray(c).ravel() for c in chunks])
+    part = np.partition(x, [k - 1 for k in ks])
+    return [int(part[k - 1]) for k in ks]
+
+
+def _phase_reads(o, phase):
+    total = 0
+    for m in o.metrics.metrics():
+        if m.name == "ingest.bucket_reads" and dict(m.labels).get(
+            "phase"
+        ) == phase:
+            total += m.value
+    return total
+
+
+# ---------------------------------------------------------------------------
+# the grid
+
+
+@pytest.mark.parametrize("devices", [None, 2, 8])
+@pytest.mark.parametrize("depth", [0, 2])
+@pytest.mark.parametrize("spill", ["off", "force"])
+def test_grid_bit_equality_kernel_tier(rng, devices, depth, spill):
+    """The kernel tier against the oracle over the heterogeneous stream
+    (the xla/off legs of the same grid live in test_fused_ingest.py)."""
+    chunks = _chunks(rng)
+    n = sum(int(np.asarray(c).size) for c in chunks)
+    ks = [1, n // 3, n // 2, n]
+    want = _oracle(chunks, ks)
+    got = streaming_kselect_many(
+        chunks, ks, radix_bits=8, collect_budget=256,
+        pipeline_depth=depth, devices=devices, spill=spill, fused="kernel",
+    )
+    assert [int(g) for g in got] == want
+    assert live_staged_keys() == 0
+
+
+def test_three_tiers_bit_identical_f32(rng):
+    chunks = [
+        rng.standard_normal(s).astype(np.float32) for s in (3000, 1500, 700)
+    ]
+    n = sum(c.size for c in chunks)
+    k = n // 2
+    kw = dict(radix_bits=8, collect_budget=128, devices=8, pipeline_depth=2,
+              spill="force")
+    legs = {
+        mode: streaming_kselect(chunks, k, fused=mode, **kw)
+        for mode in ("kernel", "xla", "off")
+    }
+    sync = streaming_kselect(chunks, k, pipeline_depth=0, radix_bits=8,
+                             collect_budget=128)
+    want = {np.asarray(v).tobytes() for v in legs.values()}
+    assert want == {np.asarray(sync).tobytes()}
+
+
+def test_one_shot_tee_kernel_tier(rng):
+    """A consumed generator under spill='auto': the kernel-tier tee must
+    anchor the same gen-0 bytes and the descent the same answer."""
+    chunks = [rng.integers(-1000, 1000, size=s, dtype=np.int32)
+              for s in (3000, 2000, 1000)]
+    n = sum(c.size for c in chunks)
+    k = n // 2
+    want = _oracle(chunks, [k])[0]
+    got = streaming_kselect(
+        (c for c in chunks), k, radix_bits=4, collect_budget=128,
+        fused="kernel",
+    )
+    assert int(got) == want
+
+
+def test_spill_generations_identical_across_tiers(rng):
+    """All three tiers write the SAME per-pass survivor bytes (the
+    multiset contract, visible in the pass_log)."""
+    from mpi_k_selection_tpu.streaming import SpillStore
+
+    chunks = _chunks(rng, sizes=(4096, 2048, 4096), device_chunk=0)
+    n = sum(c.size for c in chunks)
+    logs = {}
+    for fused in ("kernel", "xla", "off"):
+        with SpillStore() as store:
+            streaming_kselect(
+                chunks, n // 2, radix_bits=4, collect_budget=64,
+                devices=8, pipeline_depth=2, spill=store, fused=fused,
+            )
+            logs[fused] = [
+                {kk: e[kk] for kk in ("pass", "keys_read", "keys_written")
+                 if kk in e}
+                for e in store.pass_log
+            ]
+    assert logs["kernel"] == logs["xla"] == logs["off"]
+
+
+# ---------------------------------------------------------------------------
+# the sweep program vs the numpy oracle (and the XLA tier's buffers)
+
+
+def test_sweep_program_matches_numpy_oracle(rng):
+    kdt = np.dtype(np.uint32)
+    keys = rng.integers(0, 2**32, size=3011, dtype=np.uint32)  # ragged: pads
+    staged = stage_keys(keys)
+    try:
+        assert si.sweep_supported(staged, kdt, radix_bits=8, sketch_bits=16)
+        prefixes = sorted({int(keys[0] >> 24), int(keys[7] >> 24)})
+        collect_specs = [(8, int(keys[0] >> 24)), (16, int(keys[5] >> 16))]
+        vkey = int(keys[100])
+        hist, collect, tee, cert, sketch = si.dispatch_sweep_ingest(
+            staged, kdt=kdt, total_bits=32, shift=16, radix_bits=8,
+            hist_prefixes=prefixes, collect_specs=collect_specs,
+            tee_specs=collect_specs, vkey=vkey, sketch_bits=16,
+        )
+        hist = np.asarray(hist)
+        # histogram: over the WHOLE padded bucket (pad keys are key-space
+        # 0 — the executor's finish subtracts them; here we include them)
+        padded = np.zeros(staged.data.shape[0], np.uint32)
+        padded[: keys.size] = keys
+        assert hist.dtype == np.int32
+        for i, p in enumerate(prefixes):
+            up = padded >> np.uint32(24)
+            dig = (padded >> np.uint32(16)) & np.uint32(0xFF)
+            np.testing.assert_array_equal(
+                hist[i],
+                np.bincount(
+                    dig[up == np.uint32(p)].astype(np.int64), minlength=256
+                ),
+            )
+        # per-spec compactions: pad excluded, chunk order preserved — and
+        # the full BUFFER bit-identical to the XLA tier's compact_core
+        union = np.zeros(keys.shape, bool)
+        for (resolved, prefix), part in zip(collect_specs, collect):
+            got = ex_mod.materialize_compacted(part, kdt)
+            m = (keys >> np.uint32(32 - resolved)) == np.uint32(prefix)
+            union |= m
+            assert got.dtype == kdt
+            np.testing.assert_array_equal(got, keys[m])
+            ref_buf, ref_cnt = fi.compact_core(
+                staged.data, np.int32(staged.n_valid),
+                np.asarray([32 - resolved], kdt), np.asarray([prefix], kdt),
+            )
+            np.testing.assert_array_equal(
+                np.asarray(part[0]), np.asarray(ref_buf)
+            )
+            assert int(part[1]) == int(ref_cnt)
+        np.testing.assert_array_equal(
+            ex_mod.materialize_compacted(tee, kdt), keys[union]
+        )
+        # certificate: pad-exact in kernel (no host correction needed)
+        assert int(cert[0]) == int(np.count_nonzero(keys < vkey))
+        assert int(cert[1]) == int(np.count_nonzero(keys <= vkey))
+        # sketch: deep fold counts pads (the consumer's exact bucket-0
+        # subtraction), extremes mask them to the identities
+        deep, kmin, kmax = sketch
+        deep = np.asarray(deep).astype(np.int64)
+        deep[0] -= staged.pad
+        np.testing.assert_array_equal(
+            deep,
+            np.bincount(
+                (keys >> np.uint32(16)).astype(np.int64), minlength=1 << 16
+            ),
+        )
+        assert int(np.asarray(kmin)) == int(keys.min())
+        assert int(np.asarray(kmax)) == int(keys.max())
+    finally:
+        staged.release()
+    assert live_staged_keys() == 0
+
+
+def test_sweep_multi_block_grid(rng):
+    """A bucket spanning several grid steps: the cross-tile running
+    offsets and accumulators must stitch exactly (2^18 elems -> grid 4 at
+    the default 512-row tile)."""
+    kdt = np.dtype(np.uint32)
+    keys = rng.integers(0, 2**32, size=200_000, dtype=np.uint32)
+    staged = stage_keys(keys)
+    try:
+        specs = [(4, int(keys[0] >> 28)), (8, int(keys[3] >> 24))]
+        vkey = int(keys[5])
+        hist, collect, tee, cert, sketch = si.dispatch_sweep_ingest(
+            staged, kdt=kdt, total_bits=32, shift=24, radix_bits=4,
+            hist_prefixes=[int(keys[0] >> 28)], collect_specs=specs,
+            tee_specs=specs, vkey=vkey, sketch_bits=16,
+        )
+        union = np.zeros(keys.shape, bool)
+        for (resolved, prefix), part in zip(specs, collect):
+            m = (keys >> np.uint32(32 - resolved)) == np.uint32(prefix)
+            union |= m
+            np.testing.assert_array_equal(
+                ex_mod.materialize_compacted(part, kdt), keys[m]
+            )
+        np.testing.assert_array_equal(
+            ex_mod.materialize_compacted(tee, kdt), keys[union]
+        )
+        assert int(cert[0]) == int(np.count_nonzero(keys < vkey))
+        assert int(cert[1]) == int(np.count_nonzero(keys <= vkey))
+        deep = np.asarray(sketch[0]).astype(np.int64)
+        deep[0] -= staged.pad
+        np.testing.assert_array_equal(
+            deep,
+            np.bincount(
+                (keys >> np.uint32(16)).astype(np.int64), minlength=1 << 16
+            ),
+        )
+        assert int(np.asarray(sketch[1])) == int(keys.min())
+        assert int(np.asarray(sketch[2])) == int(keys.max())
+    finally:
+        staged.release()
+
+
+# ---------------------------------------------------------------------------
+# one program per staged bucket: the read accounting
+
+
+def test_sketch_bucket_reads_drop_to_one_under_kernel(rng):
+    """The tentpole's closed gap: the sketch consumer was the last
+    2-programs-per-staged-bucket consumer; the kernel tier folds the deep
+    histogram and the extremes into ONE sweep program — and the folded
+    pyramid is bit-identical across tiers and to sequential update."""
+    chunks = [rng.standard_normal(4000).astype(np.float32) for _ in range(3)]
+    seq = RadixSketch(np.float32)
+    for c in chunks:
+        seq.update(c)
+    sketches = {}
+    reads = {}
+    staged_counts = {}
+    for fused in ("kernel", "xla"):
+        o = obs_lib.Observability.collecting()
+        sk = RadixSketch(np.float32).update_stream(
+            chunks, devices=2, pipeline_depth=2, fused=fused, obs=o
+        )
+        sketches[fused] = sk
+        reads[fused] = _phase_reads(o, "sketch")
+        ev = [e for e in o.events.of_kind("sketch.pass")]
+        staged_counts[fused] = ev[0].staged_chunks
+    assert sketches["kernel"] == sketches["xla"] == seq
+    assert staged_counts["kernel"] == staged_counts["xla"] == len(chunks)
+    # exactly ONE program per staged bucket under the kernel tier; the
+    # xla tier keeps the historical deep-fold + extremes pair
+    assert reads["kernel"] == staged_counts["kernel"]
+    assert reads["xla"] == 2 * staged_counts["xla"]
+    assert live_staged_keys() == 0
+
+
+def test_certificate_bucket_reads_parity(rng):
+    """phase="certificate" accounting: 1 program per staged bucket under
+    the kernel tier, the deferred pair (2) on the xla tier — counts
+    bit-identical to each other and the eager oracle."""
+    chunks = [rng.integers(-(2**31), 2**31 - 1, size=s, dtype=np.int32)
+              for s in (4096, 2777, 4096)]
+    x = np.concatenate(chunks)
+    v = int(x[len(x) // 2])
+    got = {}
+    reads = {}
+    for fused in ("kernel", "xla"):
+        o = obs_lib.Observability.collecting()
+        got[fused] = streaming_rank_certificate(
+            chunks, v, pipeline_depth=2, devices=2, fused=fused, obs=o
+        )
+        reads[fused] = _phase_reads(o, "certificate")
+    eager = streaming_rank_certificate(
+        chunks, v, pipeline_depth=2, devices=2, deferred="off"
+    )
+    want = (int(np.count_nonzero(x < v)), int(np.count_nonzero(x <= v)))
+    assert got["kernel"] == got["xla"] == eager == want
+    assert reads["kernel"] == len(chunks)
+    assert reads["xla"] == 2 * len(chunks)
+
+
+def test_descent_read_amplification_one_under_kernel(rng):
+    """Every staged key dispatched to exactly one program per pass:
+    bucket_read_bytes == staged_bytes, with only histogram (pass 0) and
+    fused phases present."""
+    chunks = _chunks(rng, sizes=(4096, 2048, 4096), device_chunk=0)
+    n = sum(c.size for c in chunks)
+    o = obs_lib.Observability.collecting()
+    streaming_kselect(
+        chunks, n // 2, radix_bits=4, collect_budget=64, devices=2,
+        pipeline_depth=2, spill="force", fused="kernel", obs=o,
+    )
+    read = staged = 0
+    phases = set()
+    for m in o.metrics.metrics():
+        if m.name == "ingest.bucket_read_bytes":
+            read += m.value
+            phases.add(dict(m.labels).get("phase"))
+        elif m.name == "ingest.staged_bytes":
+            staged += m.value
+    assert read == staged
+    assert "fused" in phases
+    assert not {"tee", "collect"} & phases
+
+
+# ---------------------------------------------------------------------------
+# support matrix and fallback
+
+
+def test_sub_tile_buckets_fall_back_to_xla_tier(rng):
+    """Chunks below one (1, 128) lane tile stage into sub-128 buckets the
+    kernel cannot tile — the kernel tier must answer identically through
+    the per-bucket XLA fallback."""
+    chunks = [rng.integers(-1000, 1000, size=s, dtype=np.int32)
+              for s in (60, 50, 40)]
+    n = sum(c.size for c in chunks)
+    k = n // 2
+    want = _oracle(chunks, [k])[0]
+    got = streaming_kselect(
+        chunks, k, radix_bits=8, collect_budget=16, devices=2,
+        pipeline_depth=2, spill="force", fused="kernel",
+    )
+    assert int(got) == want
+    assert live_staged_keys() == 0
+
+
+def test_sweep_supported_matrix():
+    base = live_staged_keys()
+    small = stage_keys(np.arange(60, dtype=np.uint32))
+    big = stage_keys(np.arange(4096, dtype=np.uint32))
+    try:
+        kdt = np.dtype(np.uint32)
+        assert not si.sweep_supported(small, kdt)
+        assert si.sweep_supported(big, kdt)
+        # non-4-byte key spaces ride the XLA tier
+        assert not si.sweep_supported(big, np.dtype(np.uint16))
+        assert not si.sweep_supported(big, np.dtype(np.uint64))
+        # digit widths / sketch depths beyond the kernel's accumulators
+        assert not si.sweep_supported(big, kdt, radix_bits=9)
+        assert si.sweep_supported(big, kdt, radix_bits=8)
+        assert not si.sweep_supported(big, kdt, sketch_bits=21)
+        assert si.sweep_supported(big, kdt, sketch_bits=20)
+        # non-pow2 lane multiples (768 rows: the 512-row tile would not
+        # divide them) are outside the staging contract — the gate must
+        # route them to the XLA tier, and the core must raise rather
+        # than silently sweep a truncated grid
+        from mpi_k_selection_tpu.streaming.pipeline import StagedKeys
+
+        odd = StagedKeys(jnp.zeros(768 * 128, jnp.uint32), 768 * 128)
+        assert not si.sweep_supported(odd, kdt)
+        with pytest.raises(ValueError, match="does not divide"):
+            si.dispatch_sweep_ingest(
+                odd, kdt=kdt, total_bits=32, shift=24, radix_bits=8,
+                hist_prefixes=[0], collect_specs=[], tee_specs=[],
+            )
+    finally:
+        small.release()
+        big.release()
+    assert live_staged_keys() == base
+
+
+def test_uint64_sketch_keeps_two_program_path(rng):
+    """A 64-bit key space (x64 off -> host-exact route never stages; here
+    via the supported-matrix gate) must not break the sketch fold."""
+    chunks = [rng.integers(-(2**62), 2**62, size=2000, dtype=np.int64)]
+    seq = RadixSketch(np.int64)
+    for c in chunks:
+        seq.update(c)
+    sk = RadixSketch(np.int64).update_stream(
+        chunks, devices=2, pipeline_depth=2, fused="kernel"
+    )
+    assert sk == seq
+
+
+# ---------------------------------------------------------------------------
+# knob + surface units
+
+
+def test_resolve_fused_tiers():
+    import jax
+
+    assert resolve_fused("kernel") == "kernel"
+    assert resolve_fused("xla") == "xla"
+    assert resolve_fused("off") is False
+    assert resolve_fused(False) is False
+    # "auto" mirrors hist_method="auto": the kernel tier on TPU backends,
+    # the XLA fusion elsewhere (the kernel only interprets off-TPU)
+    want_auto = "kernel" if jax.default_backend() == "tpu" else "xla"
+    assert resolve_fused("auto") == want_auto
+    assert resolve_fused(True) == want_auto
+    with pytest.raises(ValueError, match="fused"):
+        resolve_fused("sometimes")
+
+
+def test_validate_fused_no_backend_probe(rng):
+    from mpi_k_selection_tpu.streaming import validate_fused
+
+    # normalizes without resolving "auto" (no jax backend probe)
+    assert validate_fused("auto") == "auto"
+    assert validate_fused(True) == "auto"
+    assert validate_fused(False) == "off"
+    assert validate_fused("kernel") == "kernel"
+    with pytest.raises(ValueError, match="fused"):
+        validate_fused("kernle")
+    # the eager (deferred="off") route forces the unfused bundle but
+    # must still reject a typo'd knob instead of silently riding it
+    chunks = [rng.integers(-1000, 1000, size=1000, dtype=np.int32)]
+    with pytest.raises(ValueError, match="fused"):
+        streaming_kselect(chunks, 500, deferred="off", fused="kernle")
+    with pytest.raises(ValueError, match="fused"):
+        streaming_rank_certificate(chunks, 0, deferred="off", fused="kernle")
+    from mpi_k_selection_tpu.api import StreamingQuantiles
+
+    with pytest.raises(ValueError, match="fused"):
+        StreamingQuantiles(np.int32, fused="kernle")
+
+
+def test_consumer_tier_validation():
+    kdt = np.dtype(np.uint32)
+    with pytest.raises(ValueError, match="tier"):
+        ex_mod.FusedIngestConsumer(
+            collect=object(), kdt=kdt, total_bits=32, tier="bogus"
+        )
+    with pytest.raises(ValueError, match="tier"):
+        ex_mod.CountLessLeqConsumer(
+            np.uint32(5), kdt, deferred=True, fused="bogus"
+        )
+
+
+def test_streaming_quantiles_kernel_tier(rng):
+    from mpi_k_selection_tpu.api import StreamingQuantiles
+
+    chunks = [rng.standard_normal(4000).astype(np.float32) for _ in range(3)]
+    qs = (0.1, 0.5, 0.9)
+    got = {}
+    for fused in ("kernel", "xla"):
+        sq = StreamingQuantiles(
+            np.float32, devices=8, fused=fused
+        ).update_stream(chunks)
+        got[fused] = [
+            np.asarray(v).tobytes() for v in sq.refine_quantiles(qs, chunks)
+        ]
+    assert got["kernel"] == got["xla"]
+
+
+def test_cli_fused_kernel_leg(capsys):
+    import json
+
+    from mpi_k_selection_tpu.cli import main
+
+    rc = main([
+        "--streaming", "--backend", "tpu", "--n", "40000",
+        "--chunk-elems", "8192", "--devices", "2", "--verify", "--check",
+        "--spill", "force", "--fused", "kernel", "--json",
+    ])
+    assert rc == 0
+    rec = json.loads(capsys.readouterr().out)
+    assert rec["extra"]["exact_match"] is True
+    assert rec["extra"]["certificate_ok"] is True
+    assert rec["extra"]["fused"] == "kernel"
