@@ -32,23 +32,68 @@ def _emit(rec):
     print(json.dumps(rec), flush=True)
 
 
-def _timed_chain(build_chain, xd, seed0, reps):
-    """Best-of-3 differential timing of build_chain(reps) jitted chains."""
+def _jit_cache_size(run):
+    """The jitted callable's compile-cache entry count (None when the
+    probe is unavailable on this jax) — the MEASURED ground truth behind
+    the steady-state recompile gate: a timed invocation that grows it
+    recompiled."""
+    probe = getattr(run, "_cache_size", None)
+    try:
+        return None if probe is None else int(probe())
+    except Exception:  # pragma: no cover - jax-internal API drift
+        return None
+
+
+def _timed_chain(build_chain, xd, seed0, reps, site=None):
+    """Best-of-3 differential timing of build_chain(reps) jitted chains.
+
+    With ``site``, every invocation reports into the process
+    ProgramLedger (obs/ledger.py): the first call per chain is the
+    compile (its wall clocked by the ledger), the timed calls are cache
+    hits — and the jit cache's own size is probed around the timed
+    window, so ``recompiles_after_warmup`` is MEASURED off the compiled
+    function, not asserted. Returns ``(per_rep_seconds, stats)`` then;
+    bare ``per_rep_seconds`` otherwise."""
     import numpy as np
 
-    r1, r2 = reps
+    from mpi_k_selection_tpu.obs.ledger import LEDGER
 
-    def t(run):
-        _ = np.asarray(run(xd, seed0(0)))  # compile
+    r1, r2 = reps
+    stats = {"recompiles_after_warmup": 0, "warmup_unmeasured": False}
+
+    def t(run, r):
+        key = ("chain", int(r))
+        if site is None:
+            _ = np.asarray(run(xd, seed0(0)))  # compile
+        else:
+            with LEDGER.compile_span(site, key):
+                _ = np.asarray(run(xd, seed0(0)))  # compile (clocked)
+        warm = _jit_cache_size(run)
         best = float("inf")
         for i in range(1, 4):
             t0 = time.perf_counter()
             _ = np.asarray(run(xd, seed0(i)))
             best = min(best, time.perf_counter() - t0)
+            if site is not None:
+                LEDGER.note_hit(site, key)
+        after = _jit_cache_size(run)
+        if warm is None or after is None:
+            stats["warmup_unmeasured"] = True
+        else:
+            grew = after - warm
+            if grew > 0:
+                stats["recompiles_after_warmup"] += grew
+                if site is not None:
+                    # fold the measured recompiles into the ledger book
+                    with LEDGER.compile_span(site, key + ("recompiled",)):
+                        pass
         return best
 
-    t1, t2 = t(build_chain(r1)), t(build_chain(r2))
-    return max((t2 - t1) / (r2 - r1), 1e-9)
+    t1, t2 = t(build_chain(r1), r1), t(build_chain(r2), r2)
+    per = max((t2 - t1) / (r2 - r1), 1e-9)
+    if site is None:
+        return per
+    return per, stats
 
 
 def bench_kselect_headline(on_tpu: bool):
@@ -145,9 +190,18 @@ def bench_kselect_1b(on_tpu: bool):
     # steady-state baseline (ADVICE r5 #3): time a SECOND invocation, compile
     # excluded — jit caches compilations, not results, so the same buffer
     # re-runs the full sort (no extra 4 GB copy resident during it)
+    base_cache0 = _jit_cache_size(sort_index)
     t0 = time.perf_counter()
     _ = int(sort_index(xd))
     baseline_s = time.perf_counter() - t0
+    # the baseline's steady-state claim, measured: its timed (second)
+    # invocation must not have grown the sort's jit cache
+    base_cache1 = _jit_cache_size(sort_index)
+    baseline_recompiled = (
+        None
+        if base_cache0 is None or base_cache1 is None
+        else base_cache1 - base_cache0
+    )
 
     kd = jnp.asarray(k, jnp.int32)
     got = int(np.asarray(radix_select(xd, kd)))  # compile + correctness
@@ -170,7 +224,28 @@ def bench_kselect_1b(on_tpu: bool):
 
         return run
 
-    per = _timed_chain(chain, xd, lambda i: jnp.asarray(k - i, jnp.int32), (3, 13))
+    from mpi_k_selection_tpu.obs.ledger import LEDGER, snapshot_delta
+
+    # the MEASURED steady-state contract (ISSUE 14): the ledger delta
+    # carries the chains' compile count + walls, and the jit cache is
+    # probed around the timed window — a recompile during it fails the
+    # bench instead of silently riding `baseline_includes_compile: false`
+    led0 = LEDGER.snapshot()
+    per, chain_stats = _timed_chain(
+        chain, xd, lambda i: jnp.asarray(k - i, jnp.int32), (3, 13),
+        site="bench.kselect_1b",
+    )
+    led = snapshot_delta(led0, LEDGER.snapshot())
+    unmeasured = (
+        chain_stats["warmup_unmeasured"] or baseline_recompiled is None
+    )
+    recompiles = chain_stats["recompiles_after_warmup"] + (
+        baseline_recompiled or 0
+    )
+    # gate only what was measured: a jax without the cache-size probe is
+    # REPORTED (recompile_gate_measured: false, recompiles null) rather
+    # than failed — a measured recompile still fails the bench
+    steady = unmeasured or recompiles == 0
     _emit(
         {
             "metric": "kselect_1b_int32",
@@ -183,11 +258,16 @@ def bench_kselect_1b(on_tpu: bool):
             "baseline_seconds": round(baseline_s, 6),
             "baseline": "on-chip jnp.sort-then-index (steady-state, 2nd call)",
             "baseline_includes_compile": False,
+            "compile_count": led["compiles"],
+            "compile_seconds": led["compile_seconds"],
+            "recompiles_after_warmup": None if unmeasured else recompiles,
+            "recompile_gate_measured": not unmeasured,
+            "ledger": led,
             "exact_match": exact,
         }
     )
     del xd
-    return exact
+    return exact and steady
 
 
 def bench_topk_single(on_tpu: bool):
@@ -503,7 +583,7 @@ def bench_streaming_oc(on_tpu: bool):
 
     from mpi_k_selection_tpu.streaming.pipeline import STAGING_POOL
 
-    def _obs_snapshot(o, pool_before):
+    def _obs_snapshot(o, pool_before, ledger_before=None):
         """Compact embed of the run's metrics registry: occupancy (total
         AND per executor phase — the descent/collect split is the deferred
         executor's before/after evidence), StagingPool hit rate, stall
@@ -511,7 +591,10 @@ def bench_streaming_oc(on_tpu: bool):
         sweep needs alongside wall time. The registry mirrors the MODULE
         pool's process-lifetime counters; ``pool_before`` (hits, misses)
         rebases them to THIS run's deltas so the record is per-run, not
-        cumulative across warmups/records."""
+        cumulative across warmups/records — and ``ledger_before`` (a
+        ProgramLedger.snapshot) does the same for the compile/byte book,
+        embedding the per-run ledger delta (compiles, compile walls,
+        device_bytes peaks; ISSUE 14)."""
         snap = o.metrics.as_dict()
         occ = snap.get("inflight.occupancy", {})
         hits = snap.get("staging_pool.hits", {}).get("value", 0)
@@ -526,7 +609,13 @@ def bench_streaming_oc(on_tpu: bool):
                     "max": m.max,
                 }
         reads = _bucket_read_totals(o)
+        ledger_delta = None
+        if ledger_before is not None:
+            from mpi_k_selection_tpu.obs.ledger import LEDGER, snapshot_delta
+
+            ledger_delta = snapshot_delta(ledger_before, LEDGER.snapshot())
         return {
+            **({"ledger": ledger_delta} if ledger_delta is not None else {}),
             "inflight_occupancy": {
                 k: occ.get(k) for k in ("count", "mean", "max")
             },
@@ -584,9 +673,12 @@ def bench_streaming_oc(on_tpu: bool):
     ans_sync = streaming_kselect(source, k, pipeline_depth=0)
     sync_s = time.perf_counter() - t0
 
+    from mpi_k_selection_tpu.obs.ledger import LEDGER as _LEDGER
+
     timer = PhaseTimer()
     obs = Observability(metrics=MetricsRegistry())
     pool0 = (STAGING_POOL.hits, STAGING_POOL.misses)
+    ledger0 = _LEDGER.snapshot()
     t0 = time.perf_counter()
     ans = streaming_kselect(source, k, pipeline_depth=2, timer=timer, obs=obs)
     dt = time.perf_counter() - t0
@@ -612,7 +704,7 @@ def bench_streaming_oc(on_tpu: bool):
         "speedup": round(sync_s / dt, 3) if exact else 0.0,
         "ingest_hidden_frac": round(hidden, 4) if hidden is not None else 0.0,
         "rank_certificate": [less, leq],
-        "obs": _obs_snapshot(obs, pool0),
+        "obs": _obs_snapshot(obs, pool0, ledger0),
         "exact_match": bool(exact),
     }
     if on_tpu:
@@ -770,6 +862,7 @@ def bench_streaming_oc(on_tpu: bool):
         timer_md = PhaseTimer()
         obs_md = Observability(metrics=MetricsRegistry())
         pool0_md = (STAGING_POOL.hits, STAGING_POOL.misses)
+        ledger0_md = _LEDGER.snapshot()
         t0 = time.perf_counter()
         ans_md = streaming_kselect(
             source, k, pipeline_depth=2, devices=ndev, timer=timer_md,
@@ -820,7 +913,7 @@ def bench_streaming_oc(on_tpu: bool):
                 "ingest_hidden_frac": (
                     round(hidden_md, 4) if hidden_md is not None else 0.0
                 ),
-                "obs": _obs_snapshot(obs_md, pool0_md),
+                "obs": _obs_snapshot(obs_md, pool0_md, ledger0_md),
                 "exact_match": bool(exact_md),
             }
         )

@@ -1088,3 +1088,138 @@ class MultiProgramStagedConsume(Rule):
                             "'s consumer bundle (FusedIngestConsumer "
                             "fuses it into ONE program per bucket)"
                         )
+
+
+# ---------------------------------------------------------------------------
+# KSL018 — obs event types live in obs/events.py AND in the documented
+# event catalog (docs/OBSERVABILITY.md), both directions
+
+
+@register
+class ObsEventCatalog(Rule):
+    id = "KSL018"
+    title = (
+        "obs event type defined outside obs/events.py, or out of sync "
+        "with the docs/OBSERVABILITY.md event-schema table"
+    )
+    rationale = (
+        "The typed event stream is a consumer contract: sinks, "
+        "check_stream_invariants, the flight recorder's debug bundle "
+        "and every postmortem reader key on the documented `kind` "
+        "catalog (docs/OBSERVABILITY.md). An event type declared beside "
+        "its emitter dodges the one home consumers import "
+        "(obs/events.py), and a type added there without its schema row "
+        "— or a schema row whose type was renamed away — drifts the "
+        "catalog exactly like the rule-id table PR 12's doc-drift gate "
+        "covers. This rule is that gate extended to the event schema, "
+        "both directions."
+    )
+
+    _EVENTS_FILE = "obs/events.py"
+
+    @staticmethod
+    def _event_classes(mod: SourceModule):
+        """``(classdef, kind or None)`` for every event TYPE in ``mod``:
+        a frozen dataclass with at least one base class carrying a
+        ``kind`` class attribute (the ObsEvent idiom). The base-less
+        ``ObsEvent`` root itself is not an emitted type and is skipped;
+        ``kind`` is the string literal when one is assigned."""
+        out = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ClassDef) or not node.bases:
+                continue
+            frozen = False
+            for dec in node.decorator_list:
+                if not isinstance(dec, ast.Call):
+                    continue
+                if dotted_name(dec.func).split(".")[-1] != "dataclass":
+                    continue
+                for kw in dec.keywords:
+                    if (
+                        kw.arg == "frozen"
+                        and isinstance(kw.value, ast.Constant)
+                        and kw.value.value is True
+                    ):
+                        frozen = True
+            if not frozen:
+                continue
+            kind = None
+            has_kind = False
+            for stmt in node.body:
+                target = None
+                if isinstance(stmt, ast.AnnAssign) and isinstance(
+                    stmt.target, ast.Name
+                ):
+                    target = stmt.target.id
+                elif isinstance(stmt, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == "kind"
+                    for t in stmt.targets
+                ):
+                    target = "kind"
+                if target != "kind":
+                    continue
+                has_kind = True
+                value = getattr(stmt, "value", None)
+                if isinstance(value, ast.Constant) and isinstance(
+                    value.value, str
+                ):
+                    kind = value.value
+            if has_kind:
+                out.append((node, kind))
+        return out
+
+    @staticmethod
+    def _documented_kinds(doc_text: str) -> set[str]:
+        """First-column backticked kinds of the event-schema table: the
+        rows between the '## Event schema' heading and the next '## '."""
+        kinds: set[str] = set()
+        in_section = False
+        for line in doc_text.splitlines():
+            if line.startswith("## "):
+                in_section = line.lower().startswith("## event schema")
+                continue
+            if not in_section:
+                continue
+            m = re.match(r"\|\s*`([^`]+)`\s*\|", line)
+            if m:
+                kinds.add(m.group(1))
+        return kinds
+
+    def check_module(self, mod: SourceModule):
+        p = pathlib.Path(mod.path).resolve().as_posix()
+        if "/mpi_k_selection_tpu/" not in p or _is_test_file(mod):
+            return
+        if not _path_endswith(mod, self._EVENTS_FILE):
+            for node, kind in self._event_classes(mod):
+                yield node.lineno, (
+                    f"obs event type `{node.name}` (kind "
+                    f"{kind!r}) defined outside obs/events.py — event "
+                    "types live in the ONE module consumers import, "
+                    "next to their schema row (docs/OBSERVABILITY.md)"
+                )
+            return
+        # the catalog half: events.py's kinds <-> the schema table rows.
+        # The docs root sits two levels above obs/ (repo layout and the
+        # fixture trees alike); a tree without the doc only exercises
+        # the location half above.
+        doc = pathlib.Path(mod.path).resolve().parents[2] / "docs" / "OBSERVABILITY.md"
+        if not doc.is_file():
+            return
+        documented = self._documented_kinds(doc.read_text())
+        defined: dict[str, tuple] = {}
+        for node, kind in self._event_classes(mod):
+            if kind is not None:
+                defined[kind] = (node.lineno, node.name)
+        for kind, (lineno, name) in sorted(defined.items()):
+            if kind not in documented:
+                yield lineno, (
+                    f"event type `{name}` (kind {kind!r}) has no row in "
+                    "docs/OBSERVABILITY.md's event-schema table — every "
+                    "emitted kind is documented, both directions"
+                )
+        for kind in sorted(documented - set(defined)):
+            yield 1, (
+                f"docs/OBSERVABILITY.md documents event kind {kind!r} "
+                "but obs/events.py defines no event type with it — "
+                "stale schema row (renamed or removed type)"
+            )

@@ -53,6 +53,12 @@ import threading
 
 _PKG_MARKER = "mpi_k_selection_tpu"
 
+#: The most recent sanitizer window's observed graph (``to_dict()``
+#: form), published at window exit — the flight recorder's debug bundle
+#: (obs/flight.py) embeds it as the ``lock_order`` section when a
+#: sanitizer ran in this process. ``None`` until one has.
+LAST_OBSERVED: dict | None = None
+
 
 def _creation_label() -> str:
     """Label for a lock created right now: the first stack frame inside
@@ -180,6 +186,10 @@ class LockOrderSanitizer:
         for obj, attr, original in self._module_patches:
             setattr(obj, attr, original)
         self._module_patches.clear()
+        # publish the observed graph for postmortem consumers (the flight
+        # recorder's debug bundle); single assignment, last window wins
+        global LAST_OBSERVED
+        LAST_OBSERVED = self.to_dict()
         return False
 
     def wrap_existing(self, obj, attr: str, label: str) -> None:

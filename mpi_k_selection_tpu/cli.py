@@ -228,6 +228,18 @@ def build_parser() -> argparse.ArgumentParser:
         "JSON to PATH — load in https://ui.perfetto.dev. Distinct from "
         "--trace-dir (XLA device ops); the two compose",
     )
+    p.add_argument(
+        "--debug-bundle",
+        default=None,
+        metavar="PATH",
+        help="arm the flight recorder (obs/flight.py: a bounded ring of "
+        "recent typed events + spans) and write the JSON debug bundle "
+        "— events tail, metrics snapshot, process ledger, span tracks, "
+        "fault section — to PATH at exit, success or failure. Terminal "
+        "failures (RetryExhaustedError, unrecoverable spill damage) "
+        "additionally auto-dump one ksel-flight-*.json bundle the "
+        "moment they fire. See docs/OBSERVABILITY.md 'Flight recorder'",
+    )
     return p
 
 
@@ -688,6 +700,14 @@ def build_serve_parser() -> argparse.ArgumentParser:
         "--latency-windows; the window advances on observation counts, "
         "never clocks)",
     )
+    p.add_argument(
+        "--debug-bundle", default=None, metavar="PATH",
+        help="arm the server's flight recorder (a bounded ring of recent "
+        "serve events + request/walk spans; also live at GET "
+        "/debug/bundle) and write the JSON debug bundle to PATH at "
+        "shutdown; a dispatch-loop crash auto-dumps one the moment the "
+        "supervisor restarts it (docs/OBSERVABILITY.md)",
+    )
     return p
 
 
@@ -714,6 +734,7 @@ def serve_main(argv=None) -> int:
         server = KSelectServer(
             window=args.batch_window, max_batch=args.max_batch, obs=obs,
             latency_windows=latency_windows,
+            flight=True if args.debug_bundle else None,
         )
         try:
             if args.streaming:
@@ -763,6 +784,24 @@ def serve_main(argv=None) -> int:
         except (ValueError, RuntimeError) as e:
             raise SystemExit(f"error: {e}") from e
         finally:
+            if args.debug_bundle and server.flight is not None:
+                # through the server so the bundle carries the documented
+                # `server` section (datasets, program-cache counters,
+                # restarts) — a bare flight.dump would drop it.
+                # best-effort: an unwritable PATH in this finally must
+                # not replace the error (or SystemExit) in flight
+                try:
+                    server.dump_debug_bundle(
+                        args.debug_bundle, reason="serve-shutdown"
+                    )
+                except OSError as write_err:
+                    import sys
+
+                    print(
+                        f"warning: --debug-bundle {args.debug_bundle}: "
+                        f"{write_err}",
+                        file=sys.stderr,
+                    )
             server.close()
     return 0
 
@@ -994,20 +1033,26 @@ def main(argv=None) -> int:
 
     import contextlib
 
-    # the obs bundle behind --metrics-json / --trace-events (off = None,
-    # zero overhead): metrics collected by the descent + _finish, spans
-    # recorded through the PhaseTimers on whichever thread runs the phase
+    # the obs bundle behind --metrics-json / --trace-events /
+    # --debug-bundle (off = None, zero overhead): metrics collected by
+    # the descent + _finish, spans recorded through the PhaseTimers on
+    # whichever thread runs the phase, the flight ring retaining the
+    # recent tail for the bundle
     obs = None
-    if args.metrics_json or args.trace_events:
+    if args.metrics_json or args.trace_events or args.debug_bundle:
         from mpi_k_selection_tpu import obs as obs_lib
 
         obs = obs_lib.Observability(
             metrics=obs_lib.MetricsRegistry() if args.metrics_json else None,
             trace=obs_lib.TraceRecorder() if args.trace_events else None,
+            flight=True if args.debug_bundle else None,
         )
-    timer = profiling.PhaseTimer(
-        recorder=None if obs is None else obs.trace
-    )
+    from mpi_k_selection_tpu.obs import wiring as _wr
+
+    # the trace channel, the flight ring, or the fan to both — pinning
+    # obs.trace alone would leave --debug-bundle's spans section empty
+    # whenever --trace-events is also on
+    timer = profiling.PhaseTimer(recorder=_wr.span_recorder(obs))
     tracer = lambda: (
         profiling.trace(args.trace_dir)
         if args.trace_dir
@@ -1044,12 +1089,41 @@ def main(argv=None) -> int:
                     record.extra["certificate_ok"] = cert_ok
                     ok = ok and cert_ok
     except (ValueError, RuntimeError) as e:
+        # a failing run still writes its requested postmortem artifact
+        # (terminal failures inside the descent ALSO auto-dumped one)
+        _write_debug_bundle(args, None, obs, reason="cli-error", exc=e)
         raise SystemExit(f"error: {e}") from e
     return _finish(args, record, ok, timer, obs)
 
 
+def _write_debug_bundle(args, record, obs, *, reason, exc=None) -> None:
+    """--debug-bundle PATH: dump the flight ring's debug bundle
+    (obs/flight.py) to PATH — called on both the success and the error
+    exit, so a postmortem artifact always lands where asked. Best-effort
+    like auto_dump: an unwritable PATH warns instead of masking the
+    error in flight (or failing a run that actually succeeded)."""
+    import sys
+
+    path = getattr(args, "debug_bundle", None)
+    if not path or obs is None or obs.flight is None:
+        return
+    extra = None
+    if exc is not None:
+        extra = {"error": f"{type(exc).__name__}: {exc}"}
+    try:
+        obs.flight.dump(path, obs=obs, reason=reason, extra=extra)
+    except OSError as write_err:
+        print(
+            f"warning: --debug-bundle {path}: {write_err}", file=sys.stderr
+        )
+        return
+    if record is not None:
+        record.extra["debug_bundle"] = path
+
+
 def _finish(args, record, ok, timer, obs=None) -> int:
     """Shared result reporting (JSON or reference-style) + exit code."""
+    _write_debug_bundle(args, record, obs, reason="cli")
     if obs is not None:
         if obs.metrics is not None:
             from mpi_k_selection_tpu.obs.metrics import collect_runtime

@@ -35,6 +35,7 @@ import dataclasses
 
 from mpi_k_selection_tpu.errors import RetryExhaustedError, TransientError
 from mpi_k_selection_tpu.faults.sleeper import resolve_sleeper
+from mpi_k_selection_tpu.obs import flight as _flight
 from mpi_k_selection_tpu.obs.wiring import fault_event
 
 #: Exception classes the default policy treats as transient. Deliberately
@@ -126,12 +127,17 @@ def retry_call(fn, policy: RetryPolicy | None, *, site: str, obs=None):
                 break
             _emit_retry(obs, site, retry, e)
             policy.sleep(retry)
-    raise RetryExhaustedError(
+    exhausted = RetryExhaustedError(
         f"{site}: still failing after {policy.max_attempts} attempts "
         f"({type(last).__name__}: {last})",
         site=site,
         attempts=policy.max_attempts,
-    ) from last
+    )
+    # the fault-triggered debug bundle (obs/flight.py): every terminal
+    # retry exhaustion freezes the postmortem ring ONCE per flight
+    # recorder, whichever site exhausts first — a no-op without one
+    _flight.auto_dump(obs, "retry-exhausted", exc=exhausted)
+    raise exhausted from last
 
 
 def resilient_source(src, policy: RetryPolicy | None, *, obs=None):
@@ -169,13 +175,17 @@ def resilient_source(src, policy: RetryPolicy | None, *, obs=None):
                     raise e
                 retries += 1
                 if retries >= policy.max_attempts:
-                    raise RetryExhaustedError(
+                    exhausted = RetryExhaustedError(
                         f"chunk source: {doing} still failing after "
                         f"{policy.max_attempts} attempts "
                         f"({type(e).__name__}: {e})",
                         site="source",
                         attempts=policy.max_attempts,
-                    ) from e
+                    )
+                    # same postmortem hook as retry_call: at most one
+                    # bundle per flight recorder, never raises
+                    _flight.auto_dump(obs, "retry-exhausted", exc=exhausted)
+                    raise exhausted from e
                 _emit_retry(obs, "source", retries, e)
                 policy.sleep(retries)
 

@@ -44,6 +44,7 @@ from mpi_k_selection_tpu.obs.events import (
     FaultEvent,
     ListSink,
     ObsEvent,
+    RecompileStormEvent,
     ResidentSelectEvent,
     ServeBatchEvent,
     ServeQueryEvent,
@@ -51,6 +52,18 @@ from mpi_k_selection_tpu.obs.events import (
     SpillGenerationEvent,
     StreamPassEvent,
     check_stream_invariants,
+)
+from mpi_k_selection_tpu.obs.flight import (
+    FlightRecorder,
+    build_bundle,
+    resolve_flight,
+)
+from mpi_k_selection_tpu.obs.ledger import (
+    LEDGER,
+    ProgramLedger,
+    collect_ledger,
+    ledger_dispatch,
+    snapshot_delta,
 )
 from mpi_k_selection_tpu.obs.metrics import (
     Counter,
@@ -70,12 +83,16 @@ __all__ = [
     "DistributedSelectEvent",
     "EventSink",
     "FaultEvent",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
+    "LEDGER",
     "ListSink",
     "MetricsRegistry",
     "Observability",
     "ObsEvent",
+    "ProgramLedger",
+    "RecompileStormEvent",
     "ResidentSelectEvent",
     "ServeBatchEvent",
     "ServeQueryEvent",
@@ -85,8 +102,13 @@ __all__ = [
     "StreamPassEvent",
     "TraceRecorder",
     "WindowedHistogram",
+    "build_bundle",
     "check_stream_invariants",
+    "collect_ledger",
     "collect_runtime",
+    "ledger_dispatch",
+    "resolve_flight",
+    "snapshot_delta",
 ]
 
 
@@ -95,33 +117,45 @@ class Observability:
     ``obs=``. Any subset of channels may be active; ``None`` channels
     cost one attribute check at each emission site.
 
-    All three channels are thread-safe — the pipelined descent records
-    from the producer and consumer threads concurrently.
+    ``flight`` (obs/flight.py) is the fourth, postmortem channel: a
+    bounded ring that retains the most recent events and spans so a
+    fault can dump a debug bundle — it SHARES the event stream (every
+    ``emit`` fans into it) rather than replacing any sink.
+
+    All channels are thread-safe — the pipelined descent records from
+    the producer and consumer threads concurrently.
     """
 
-    def __init__(self, *, events=None, metrics=None, trace=None):
+    def __init__(self, *, events=None, metrics=None, trace=None, flight=None):
         self.events = events
         self.metrics = metrics
         self.trace = trace
+        self.flight = resolve_flight(flight) if flight is not None else None
 
     @classmethod
-    def collecting(cls) -> "Observability":
-        """All three channels on, in-memory: a ListSink, a fresh
+    def collecting(cls, *, flight=False) -> "Observability":
+        """All three live channels on, in-memory: a ListSink, a fresh
         MetricsRegistry, and a TraceRecorder — the everything-enabled
-        form tests, the gauntlet and tpu_smoke use."""
+        form tests, the gauntlet and tpu_smoke use. ``flight=True`` (or
+        an int ring capacity / a FlightRecorder) adds the postmortem
+        ring too."""
         return cls(
-            events=ListSink(), metrics=MetricsRegistry(), trace=TraceRecorder()
+            events=ListSink(), metrics=MetricsRegistry(),
+            trace=TraceRecorder(), flight=flight or None,
         )
 
     def emit(self, event: ObsEvent) -> None:
-        """Send one event to the sink (no-op without one)."""
+        """Send one event to the sink and the flight ring (no-op without
+        either)."""
         if self.events is not None:
             self.events.emit(event)
+        if self.flight is not None:
+            self.flight.record_event(event)
 
     def __repr__(self) -> str:  # pragma: no cover - debugging aid
         on = [
             name
-            for name in ("events", "metrics", "trace")
+            for name in ("events", "metrics", "trace", "flight")
             if getattr(self, name) is not None
         ]
         return f"Observability({', '.join(on) or 'all channels off'})"

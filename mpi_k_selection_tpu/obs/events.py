@@ -177,6 +177,10 @@ class ServeQueryEvent(ObsEvent):
     tier_answered: str
     queries: int
     escalated: bool
+    #: request-correlation id (docs/OBSERVABILITY.md "Trace IDs"): minted
+    #: per query by the server (or honored from the client's
+    #: ``X-Ksel-Trace-Id``); ``None`` for embedding callers that pass none
+    trace_id: str | None = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -221,13 +225,34 @@ class FaultEvent(ObsEvent):
 class ServeBatchEvent(ObsEvent):
     """One coalesced dispatch of the query server's batcher: how many
     client requests rode the shared-pass walk and the total rank-query
-    width they coalesced into."""
+    width they coalesced into. ``trace_ids`` are the request-correlation
+    ids of every query in the group (docs/OBSERVABILITY.md "Trace IDs"),
+    so one slow walk is joinable back to the client requests that rode
+    it."""
 
     kind: ClassVar[str] = "serve.batch"
 
     dataset: str
     requests: int
     width: int
+    trace_ids: tuple = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class RecompileStormEvent(ObsEvent):
+    """The runtime twin of KSC103/KSL010 (obs/ledger.py): one dispatch
+    site's distinct-program compile count crossed the ledger's storm
+    threshold — the site is serving shape/width churn at compile latency.
+    Emitted on the crossing compile and every later one; ``key`` is the
+    repr of the compile key that triggered it, ``compiles`` the site's
+    distinct-key compile total at emission."""
+
+    kind: ClassVar[str] = "ledger.recompile_storm"
+
+    site: str
+    key: str
+    compiles: int
+    threshold: int
 
 
 class EventSink:

@@ -1,0 +1,298 @@
+"""Flight recorder — a bounded ring of recent telemetry plus the
+fault-triggered JSON debug bundle.
+
+"What exactly happened in the 10 seconds before this query failed?" is a
+postmortem question, and answering it from live sinks means having had
+every channel on and exporting continuously. The flight recorder is the
+cheap standing alternative: a fixed-size ring of the most recent typed
+events and a ring of the most recent host spans (it implements the
+PhaseTimer recorder protocol, so it rides the same KSL004-sanctioned
+clock route as the trace recorder), appended O(1) under a lock, off by
+default — attach one as the ``flight`` channel of an
+:class:`~mpi_k_selection_tpu.obs.Observability` (or the query server's
+``flight=`` knob) and every emission/span it sees is retained, oldest
+evicted first.
+
+On demand (:meth:`~mpi_k_selection_tpu.serve.server.KSelectServer.
+debug_bundle`, HTTP ``GET /debug/bundle``, CLI ``--debug-bundle PATH``)
+— or automatically, ONCE per recorder, on a terminal failure
+(``RetryExhaustedError`` / unrecoverable spill damage in the descent's
+recovery ladder, ``DispatchCrashedError`` in the serve supervisor) — the
+ring dumps a single JSON **debug bundle** with five always-present
+sections (docs/OBSERVABILITY.md "Flight recorder & debug bundle"):
+
+- ``events``   — the typed-event tail (FaultEvents included), in order;
+- ``metrics``  — the live registry snapshot (ledger gauges folded in);
+- ``ledger``   — the process ProgramLedger snapshot (compiles, bytes,
+  recent recompile storms);
+- ``spans``    — the span tail with thread identity (>= 2 tracks on any
+  pipelined run) plus the distinct track count;
+- ``faults``   — the FaultEvent tail split out, with the armed plan's
+  description when the injector is armed;
+
+plus ``lock_order`` (the last LockOrderSanitizer's observed graph, when
+one ran) and ``reason``/``trace_ids`` context. Auto-dump paths carry the
+``ksel-flight-`` prefix; every dump is registered so the test suite's
+conftest fixture validates each bundle and fails leaked ones — the same
+discipline as spill temp dirs. Pure host observation throughout:
+enabling the recorder never changes an answer bit (tests/test_ledger.py
+runs the full devices x depth x spill x fused grid with it on).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import tempfile
+import threading
+
+#: Auto-dump file prefix (conftest leak discipline, like ksel-spill-*).
+FLIGHT_FILE_PREFIX = "ksel-flight-"
+
+#: Default ring capacities (events / spans kept). Sized for "the last
+#: few seconds of a busy run": a streamed pass emits O(chunks) events,
+#: so 512 holds several recent passes; tune per recorder via the ctor.
+DEFAULT_CAPACITY = 512
+
+#: The five sections every bundle carries (conftest validates them on
+#: every dump the suite produces).
+BUNDLE_SECTIONS = ("events", "metrics", "ledger", "spans", "faults")
+
+# every bundle path written by this process (auto and on-demand dumps
+# alike), drained by the conftest fixture that validates + leak-checks
+_DUMPED_LOCK = threading.Lock()
+_DUMPED: list[str] = []  # ksel: guarded-by[_DUMPED_LOCK]
+
+
+def _register_dump(path: str) -> None:
+    with _DUMPED_LOCK:
+        _DUMPED.append(path)
+
+
+def drain_dumped() -> list[str]:
+    """Return-and-clear the bundle paths written since the last drain
+    (the conftest fixture's hook)."""
+    with _DUMPED_LOCK:
+        out, _DUMPED[:] = list(_DUMPED), []
+    return out
+
+
+class FlightRecorder:
+    """The bounded telemetry ring. Thread-safe: events arrive from
+    producer/consumer/dispatch threads, spans from whichever thread ran
+    the phase (it IS a PhaseTimer recorder). ``dump_dir`` roots the
+    auto-dump files (default: the system temp dir)."""
+
+    def __init__(
+        self,
+        *,
+        capacity: int = DEFAULT_CAPACITY,
+        span_capacity: int | None = None,
+        dump_dir: str | None = None,
+    ):
+        self._lock = threading.Lock()
+        # deques are self-synchronizing for append; the lock makes the
+        # snapshot (ordering across both rings + the sequence counter)
+        # consistent
+        self._events: collections.deque = collections.deque(
+            maxlen=max(1, int(capacity))
+        )
+        self._spans: collections.deque = collections.deque(
+            maxlen=max(1, int(span_capacity if span_capacity is not None else capacity))
+        )
+        self._seq = 0  # ksel: guarded-by[_lock] (events seen, evicted included)
+        self._auto_dumped = False  # ksel: guarded-by[_lock]
+        self.dump_dir = dump_dir
+        self.auto_dumps: list[str] = []  # ksel: guarded-by[_lock]
+
+    # -- appends (O(1)) ----------------------------------------------------
+
+    def record_event(self, event) -> None:
+        """Retain one typed obs event (Observability.emit fans in here
+        when the flight channel is on)."""
+        with self._lock:
+            self._seq += 1
+            self._events.append((self._seq, event))
+
+    def record(self, name: str, t0: float, t1: float, args=None) -> None:
+        """PhaseTimer recorder protocol: retain one finished span with
+        its thread identity (no clock is read here — KSL004). ``args``
+        carries span context when the phase provides any (the serve
+        walk's trace ids)."""
+        t = threading.current_thread()
+        with self._lock:
+            self._spans.append((name, t0, t1, t.ident or 0, t.name, args))
+
+    # -- bundle ------------------------------------------------------------
+
+    def events_tail(self) -> list:
+        with self._lock:
+            return [e for _, e in self._events]
+
+    def spans_tail(self) -> list:
+        """The retained span tuples, oldest first (snapshotted under the
+        lock — a producer thread appending mid-copy must not tear it)."""
+        with self._lock:
+            return list(self._spans)
+
+    def bundle(self, *, obs=None, reason: str = "on-demand", extra=None) -> dict:
+        """Assemble the debug-bundle dict (see module docstring for the
+        section schema). ``obs`` supplies the live metrics registry;
+        ``extra`` merges top-level context keys (server state, trace
+        ids)."""
+        return build_bundle(obs, reason=reason, flight=self, extra=extra)
+
+    def dump(self, path=None, *, obs=None, reason: str = "on-demand", extra=None) -> str:
+        """Write one bundle as JSON. ``path=None`` creates a
+        ``ksel-flight-*.json`` file under ``dump_dir`` (or the temp
+        dir). Every dump is registered for the conftest validation."""
+        payload = self.bundle(obs=obs, reason=reason, extra=extra)
+        if path is None:
+            fd, path = tempfile.mkstemp(
+                prefix=FLIGHT_FILE_PREFIX, suffix=".json", dir=self.dump_dir
+            )
+            with os.fdopen(fd, "w") as f:
+                json.dump(payload, f, indent=2, default=str)
+        else:
+            path = os.fspath(path)
+            with open(path, "w") as f:
+                json.dump(payload, f, indent=2, default=str)
+        _register_dump(path)
+        return path
+
+    def maybe_auto_dump(self, reason: str, *, obs=None, exc=None) -> str | None:
+        """The fault-triggered dump: at most ONE per recorder (a retry
+        storm must not write a bundle per attempt), test-and-set under
+        the lock. Returns the path, or None when already dumped."""
+        with self._lock:
+            if self._auto_dumped:
+                return None
+            self._auto_dumped = True
+        extra = {}
+        if exc is not None:
+            extra["error"] = f"{type(exc).__name__}: {exc}"
+        try:
+            path = self.dump(None, obs=obs, reason=reason, extra=extra)
+        except BaseException:
+            # a failed WRITE must not consume the latch: the trigger is
+            # often the very condition (ENOSPC) that fails the dump, and
+            # the next terminal failure — after space frees — still
+            # deserves its one bundle
+            with self._lock:
+                self._auto_dumped = False
+            raise
+        with self._lock:
+            self.auto_dumps.append(path)
+        return path
+
+
+def resolve_flight(flight) -> FlightRecorder | None:
+    """Normalize a ``flight=`` knob: None/False = off, True = default
+    recorder, an int = that ring capacity, a FlightRecorder = itself."""
+    if flight is None or flight is False:
+        return None
+    if flight is True:
+        return FlightRecorder()
+    if isinstance(flight, FlightRecorder):
+        return flight
+    if isinstance(flight, int):
+        return FlightRecorder(capacity=flight)
+    raise ValueError(
+        f"flight must be a bool, an int ring capacity, or a "
+        f"FlightRecorder, got {flight!r}"
+    )
+
+
+def _lock_order_section():
+    """The last LockOrderSanitizer's observed graph, when one ran in
+    this process (analysis/lockorder.py records it on exit)."""
+    try:
+        from mpi_k_selection_tpu.analysis import lockorder
+    except Exception:  # pragma: no cover - analysis always importable here
+        return None
+    return getattr(lockorder, "LAST_OBSERVED", None)
+
+
+def _faults_section(events) -> dict:
+    from mpi_k_selection_tpu.obs.events import FaultEvent
+
+    out = {
+        "events": [e.as_dict() for e in events if isinstance(e, FaultEvent)],
+        "plan": None,
+    }
+    try:
+        from mpi_k_selection_tpu.faults import inject as _inj
+
+        injector = _inj.active_injector()
+        if injector is not None:
+            out["plan"] = repr(getattr(injector, "plan", injector))
+    except Exception:  # pragma: no cover - faults always importable here
+        pass
+    return out
+
+
+def build_bundle(obs, *, reason: str = "on-demand", flight=None, extra=None) -> dict:
+    """Assemble one debug bundle from whatever channels exist. Works
+    without a flight channel (empty events/spans tails) so the on-demand
+    surfaces degrade gracefully; the five BUNDLE_SECTIONS are always
+    present."""
+    from mpi_k_selection_tpu.obs.ledger import LEDGER
+
+    if flight is None and obs is not None:
+        flight = getattr(obs, "flight", None)
+    events = flight.events_tail() if flight is not None else []
+    spans = flight.spans_tail() if flight is not None else []
+    metrics = {}
+    if obs is not None and obs.metrics is not None:
+        # phase/pool state is folded in by its owners (descent end, the
+        # server's collect_metrics); only the ledger mapping is re-run
+        # here — idempotent, and bundles built WITHOUT a server in front
+        # still get the ledger gauges
+        from mpi_k_selection_tpu.obs.ledger import collect_ledger
+
+        collect_ledger(obs.metrics)
+        metrics = obs.metrics.as_dict()
+    span_rows = [
+        {
+            "name": name,
+            "t0": t0,
+            "t1": t1,
+            "thread_id": tid,
+            "thread": tname,
+            "args": args,
+        }
+        for name, t0, t1, tid, tname, args in spans
+    ]
+    bundle = {
+        "reason": reason,
+        "events": [e.as_dict() for e in events],
+        "metrics": metrics,
+        "ledger": LEDGER.snapshot(),
+        "spans": {
+            "tail": span_rows,
+            "thread_tracks": len({r["thread_id"] for r in span_rows}),
+        },
+        "faults": _faults_section(events),
+        "lock_order": _lock_order_section(),
+    }
+    if extra:
+        bundle.update(extra)
+    return bundle
+
+
+def auto_dump(obs, reason: str, *, exc=None) -> str | None:
+    """THE fault-triggered hook the recovery surfaces call (descent
+    ladder on RetryExhaustedError / unrecoverable spill damage, serve
+    supervisor on DispatchCrashedError): dumps once per recorder; a
+    no-op without a flight channel. Never raises — a postmortem artifact
+    failing to write must not mask the typed error in flight."""
+    flight = getattr(obs, "flight", None) if obs is not None else None
+    if flight is None:
+        return None
+    try:
+        return flight.maybe_auto_dump(reason, obs=obs, exc=exc)
+    except Exception:  # pragma: no cover - disk-full etc.: the postmortem
+        # dump is best-effort by contract — the typed error that triggered
+        # it is already propagating, and raising here would replace it
+        return None

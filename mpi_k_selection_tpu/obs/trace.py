@@ -33,13 +33,17 @@ import threading
 @dataclasses.dataclass(frozen=True)
 class Span:
     """One completed phase on one thread (times are perf_counter seconds,
-    a shared monotonic base across threads of one process)."""
+    a shared monotonic base across threads of one process). ``args`` is
+    optional span context a phase attached (e.g. the serve walk's
+    request-correlation trace ids — docs/OBSERVABILITY.md "Trace IDs");
+    it rides into the Chrome trace's ``args`` field."""
 
     name: str
     t0: float
     t1: float
     thread_id: int
     thread_name: str
+    args: dict | None = None
 
     @property
     def duration(self) -> float:
@@ -59,10 +63,10 @@ class TraceRecorder:
         self._lock = threading.Lock()
         self.spans: list[Span] = []  # ksel: guarded-by[_lock]
 
-    def record(self, name: str, t0: float, t1: float) -> None:
+    def record(self, name: str, t0: float, t1: float, args=None) -> None:
         """Called by PhaseTimer on the thread that ran the phase."""
         t = threading.current_thread()
-        span = Span(name, t0, t1, t.ident or 0, t.name)
+        span = Span(name, t0, t1, t.ident or 0, t.name, args)
         with self._lock:
             self.spans.append(span)
 
@@ -105,7 +109,7 @@ class TraceRecorder:
                     "ts": (s.t0 - base) * 1e6,
                     "dur": s.duration * 1e6,
                     "cat": s.name.split(".")[0],
-                    "args": {},
+                    "args": dict(s.args) if s.args else {},
                 }
             )
         return {"traceEvents": events, "displayTimeUnit": "ms"}

@@ -123,25 +123,56 @@ def bucket_read(obs, phase: str, staged, programs: int = 1):
     obs.metrics.counter("ingest.bucket_read_bytes", labels=lab).inc(nbytes)
 
 
+class _FanRecorder:
+    """Forwards every finished span to several recorders (the trace
+    recorder and the flight ring observe the same phases — neither
+    replaces the other). Each target accepts the optional ``args``."""
+
+    __slots__ = ("_targets",)
+
+    def __init__(self, targets):
+        self._targets = tuple(targets)
+
+    def record(self, name, t0, t1, args=None) -> None:
+        for r in self._targets:
+            r.record(name, t0, t1, args)
+
+
+def span_recorder(obs):
+    """The recorder an instrumented run's PhaseTimer should feed: the
+    trace channel, the flight ring, a fan-out to both, or ``None`` when
+    neither is on."""
+    if obs is None:
+        return None
+    targets = [r for r in (obs.trace, getattr(obs, "flight", None)) if r is not None]
+    if not targets:
+        return None
+    if len(targets) == 1:
+        return targets[0]
+    return _FanRecorder(targets)
+
+
 def attach_timer(obs, timer):
-    """Resolve the (timer, recorder) wiring: with span tracing on, every
-    phase needs a PhaseTimer to timestamp it — create one if the caller
-    passed none, attach the recorder if the caller's timer has none.
+    """Resolve the (timer, recorder) wiring: with span recording on (the
+    trace channel, the flight ring, or both), every phase needs a
+    PhaseTimer to timestamp it — create one if the caller passed none,
+    attach the recorder if the caller's timer has none.
 
     Returns ``(timer, restore)``. ``restore()`` detaches a recorder this
     call attached to a CALLER-owned timer — run it on every exit path,
     so a long-lived timer reused across later uninstrumented calls does
-    not keep feeding spans into (and growing) this run's TraceRecorder.
+    not keep feeding spans into (and growing) this run's recorders.
     Timers created here, and timers whose recorder the caller set
     themselves, need no restore (a no-op is returned)."""
-    if obs is None or obs.trace is None:
+    recorder = span_recorder(obs)
+    if recorder is None:
         return timer, lambda: None
     if timer is None:
         from mpi_k_selection_tpu.utils.profiling import PhaseTimer
 
-        return PhaseTimer(recorder=obs.trace), lambda: None
+        return PhaseTimer(recorder=recorder), lambda: None
     if timer.recorder is None:
-        timer.recorder = obs.trace
+        timer.recorder = recorder
 
         def _restore(t=timer):
             t.recorder = None

@@ -98,6 +98,10 @@ class PendingQuery:
     ks: tuple = ()
     ds: object = None
     run: object = None
+    #: request-correlation id (docs/OBSERVABILITY.md "Trace IDs"): minted
+    #: or honored by the server per query, carried through the coalesced
+    #: group so the walk's batch event/span name every rider
+    trace_id: str | None = None
     #: optional utils/timing.Deadline — the waiter times out against it,
     #: and the dispatch thread drops the query once it expires
     deadline: object = None

@@ -89,6 +89,21 @@ class _Handler(BaseHTTPRequestHandler):
 
     # -- plumbing ----------------------------------------------------------
 
+    def _request_trace_id(self) -> str:
+        """The request-correlation id (docs/OBSERVABILITY.md "Trace
+        IDs"): an inbound ``X-Ksel-Trace-Id`` is honored verbatim (so a
+        caller's id follows the query across services), else one is
+        minted — either way every response echoes it, success and error
+        alike, and the serve events/spans of the work it triggered carry
+        the same id."""
+        tid = getattr(self, "_trace_id", None)
+        if tid is None:
+            from mpi_k_selection_tpu.serve.server import KSelectServer
+
+            inbound = self.headers.get("X-Ksel-Trace-Id")
+            tid = self._trace_id = KSelectServer._trace_id(inbound)
+        return tid
+
     def _send(
         self, code: int, payload, *, content_type="application/json",
         headers=None,
@@ -101,13 +116,20 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
+        self.send_header("X-Ksel-Trace-Id", self._request_trace_id())
         for k, v in (headers or {}).items():
             self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 
     def _send_error_json(self, code: int, message: str, headers=None):
-        self._send(code, {"error": message}, headers=headers)
+        # the trace id rides error BODIES too: a 504/503 postmortem
+        # starts from the id the client logged
+        self._send(
+            code,
+            {"error": message, "trace_id": self._request_trace_id()},
+            headers=headers,
+        )
 
     def _read_json(self):
         length = int(self.headers.get("Content-Length", 0) or 0)
@@ -149,6 +171,9 @@ class _Handler(BaseHTTPRequestHandler):
     # -- routes ------------------------------------------------------------
 
     def do_GET(self):
+        # keep-alive reuses one handler across requests: re-resolve the
+        # trace id per request, never per connection
+        self._trace_id = None
         self._guarded(self._get)
 
     def _get(self):
@@ -165,10 +190,21 @@ class _Handler(BaseHTTPRequestHandler):
                 self.kserver.render_prometheus().encode(),
                 content_type="text/plain; version=0.0.4; charset=utf-8",
             )
+        elif self.path == "/debug/bundle":
+            # the postmortem debug bundle (obs/flight.py; sections are
+            # empty-but-present without a flight= channel) — default=str
+            # absorbs any non-JSON leaf a span arg or plan repr carries
+            self._send(
+                200,
+                json.dumps(
+                    self.kserver.debug_bundle(reason="http"), default=str
+                ).encode(),
+            )
         else:
             self._send_error_json(404, f"unknown path {self.path!r}")
 
     def do_POST(self):
+        self._trace_id = None
         self._guarded(self._post)
 
     def _post(self):
@@ -199,28 +235,35 @@ class _Handler(BaseHTTPRequestHandler):
             if not math.isfinite(deadline) or deadline <= 0:
                 raise QueryError("deadline_ms must be a finite number > 0")
         srv = self.kserver
+        tid = self._request_trace_id()
         if op == "kselect":
             ks = req["ks"] if "ks" in req else [req["k"]] if "k" in req else None
             if ks is None:
                 raise QueryError("kselect needs 'k' or 'ks'")
-            answers = srv.kselect_many(dataset, ks, tier=tier, deadline=deadline)
+            answers = srv.kselect_many(
+                dataset, ks, tier=tier, deadline=deadline, trace_id=tid
+            )
             self._send(
                 200,
                 {
                     "dataset": dataset,
                     "op": op,
+                    "trace_id": tid,
                     "answers": [a.as_dict() for a in answers],
                 },
             )
         elif op == "quantiles":
             if "qs" not in req:
                 raise QueryError("quantiles needs 'qs'")
-            answers = srv.quantiles(dataset, req["qs"], tier=tier, deadline=deadline)
+            answers = srv.quantiles(
+                dataset, req["qs"], tier=tier, deadline=deadline, trace_id=tid
+            )
             self._send(
                 200,
                 {
                     "dataset": dataset,
                     "op": op,
+                    "trace_id": tid,
                     "answers": [a.as_dict() for a in answers],
                 },
             )
@@ -229,13 +272,14 @@ class _Handler(BaseHTTPRequestHandler):
                 raise QueryError("topk needs 'k'")
             values, indices = srv.topk(
                 dataset, int(req["k"]), largest=bool(req.get("largest", True)),
-                deadline=deadline,
+                deadline=deadline, trace_id=tid,
             )
             self._send(
                 200,
                 {
                     "dataset": dataset,
                     "op": op,
+                    "trace_id": tid,
                     "values": [_jsonable(v) for v in values],
                     "indices": [int(i) for i in indices],
                 },
@@ -244,11 +288,14 @@ class _Handler(BaseHTTPRequestHandler):
             if "value" not in req:
                 raise QueryError("rank_certificate needs 'value'")
             less, leq = srv.rank_certificate(
-                dataset, req["value"], deadline=deadline
+                dataset, req["value"], deadline=deadline, trace_id=tid
             )
             self._send(
                 200,
-                {"dataset": dataset, "op": op, "less": int(less), "leq": int(leq)},
+                {
+                    "dataset": dataset, "op": op, "trace_id": tid,
+                    "less": int(less), "leq": int(leq),
+                },
             )
         else:
             raise QueryError(

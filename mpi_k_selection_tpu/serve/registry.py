@@ -42,10 +42,12 @@ import threading
 
 import numpy as np
 
+from mpi_k_selection_tpu.obs import ledger as _ldg
 from mpi_k_selection_tpu.serve.errors import (
     DatasetExistsError,
     DatasetNotFoundError,
     QueryError,
+    ServerClosedError,
 )
 
 #: Default resident-sketch geometry (matches RadixSketch defaults).
@@ -60,12 +62,21 @@ class ProgramCache:
     ints under the lock, mirrored into the obs registry by the server so
     tests can assert them EQUAL)."""
 
+    #: ProgramLedger site this cache reports into (obs/ledger.py): hits
+    #: count as cache hits, builds as compiles with their wall clocked —
+    #: the runtime book behind the serve steady-state recompile gate.
+    LEDGER_SITE = "serve.programs"
+
     def __init__(self, *, max_entries: int = 64):
         self._lock = threading.Lock()
         self._entries: collections.OrderedDict = collections.OrderedDict()  # ksel: guarded-by[_lock]
         self.max_entries = int(max_entries)
         self.hits = 0
         self.misses = 0
+        #: optional Observability whose sink receives RecompileStormEvents
+        #: (set by KSelectServer; the ledger bookkeeping itself is
+        #: unconditional)
+        self.obs = None
 
     def get_or_build(self, key, builder):
         """The cached value for ``key``, building (and caching) it on the
@@ -74,12 +85,19 @@ class ProgramCache:
         in practice, and a concurrent duplicate would only waste work,
         never corrupt (last write wins on an identical value)."""
         with self._lock:
-            if key in self._entries:
+            hit = key in self._entries
+            if hit:
                 self.hits += 1
                 self._entries.move_to_end(key)
-                return self._entries[key]
-            self.misses += 1
-        value = builder()
+                value = self._entries[key]
+            else:
+                self.misses += 1
+        # ledger reporting OUTSIDE the cache lock (the ledger locks itself)
+        if hit:
+            _ldg.LEDGER.note_hit(self.LEDGER_SITE, key)
+            return value
+        with _ldg.LEDGER.compile_span(self.LEDGER_SITE, key, obs=self.obs):
+            value = builder()
         with self._lock:
             self._entries[key] = value
             self._entries.move_to_end(key)
@@ -117,6 +135,15 @@ class ResidentDataset:
     sketch: object = None
     stream_kwargs: dict = dataclasses.field(default_factory=dict)
 
+    @property
+    def nbytes(self) -> int:
+        """Resident footprint of the dataset's data (device or host) —
+        the per-dataset byte book the ledger's ``resident`` pool
+        aggregates (stream datasets hold no resident array: 0)."""
+        if self.data is None:
+            return 0
+        return int(self.n) * np.dtype(self.dtype).itemsize
+
     def summary(self) -> dict:
         """JSON-ready description (the /v1/datasets listing row)."""
         out = {
@@ -124,6 +151,7 @@ class ResidentDataset:
             "residency": self.residency,
             "dtype": str(np.dtype(self.dtype)),
             "n": self.n,
+            "resident_bytes": self.nbytes,
             "sketch": self.sketch is not None,
         }
         if self.sketch is not None:
@@ -153,6 +181,7 @@ class DatasetRegistry:
     def __init__(self, *, programs: ProgramCache | None = None):
         self._lock = threading.Lock()
         self._datasets: dict[str, ResidentDataset] = {}  # ksel: guarded-by[_lock]
+        self._closed = False  # ksel: guarded-by[_lock]
         self.programs = programs if programs is not None else ProgramCache()
 
     # -- lifecycle ---------------------------------------------------------
@@ -162,20 +191,37 @@ class DatasetRegistry:
         work (defensive copy, device transfer, full sketch/stream pass);
         :meth:`_register`'s locked check still closes the race."""
         with self._lock:
+            self._check_open_locked()
             if dataset_id in self._datasets:
                 raise DatasetExistsError(
                     f"dataset {dataset_id!r} already registered; resident "
                     "shards are immutable — drop() it first"
                 )
 
+    def _check_open_locked(self) -> None:
+        if self._closed:
+            raise ServerClosedError(
+                "registry is closed; datasets can no longer be registered"
+            )
+
     def _register(self, ds: ResidentDataset) -> ResidentDataset:
         with self._lock:
+            # closed-ness decided under the SAME lock as the insert: a
+            # registration racing close() either lands before the close
+            # snapshot (and is released by it) or fails here — it can
+            # never add bytes to the resident book after the snapshot
+            # subtracted, which would leave phantom bytes forever
+            self._check_open_locked()
             if ds.dataset_id in self._datasets:
                 raise DatasetExistsError(
                     f"dataset {ds.dataset_id!r} already registered; resident "
                     "shards are immutable — drop() it first"
                 )
             self._datasets[ds.dataset_id] = ds
+        # the resident byte book (obs/ledger.py) — outside the registry
+        # lock; the residency label is a closed 3-value set, per-dataset
+        # figures live in each ResidentDataset.summary()
+        _ldg.LEDGER.adjust_bytes("resident", ds.residency, ds.nbytes)
         return ds
 
     def add_array(
@@ -308,12 +354,32 @@ class DatasetRegistry:
 
     def drop(self, dataset_id: str) -> None:
         with self._lock:
-            if dataset_id not in self._datasets:
+            ds = self._datasets.get(dataset_id)
+            if ds is None:
                 raise DatasetNotFoundError(
                     f"no dataset registered as {dataset_id!r}"
                 )
             del self._datasets[dataset_id]
+        _ldg.LEDGER.adjust_bytes("resident", ds.residency, -ds.nbytes)
         self.programs.drop_dataset(dataset_id)
+
+    def close(self) -> None:
+        """Unregister every dataset, returning its bytes to the resident
+        book (obs/ledger.py). Without this, a registry discarded whole —
+        a server torn down without per-dataset ``drop()`` calls — would
+        ratchet the process-wide ``ledger.device_bytes{pool="resident"}``
+        gauge upward across server lifetimes, and the eviction budgeting
+        that book feeds would act on phantom bytes. Idempotent; races
+        with :meth:`drop` subtract each dataset exactly once (both pop
+        under the lock before touching the ledger). A closed registry
+        permanently rejects new registrations — the byte snapshot below
+        must be final."""
+        with self._lock:
+            self._closed = True
+            datasets = list(self._datasets.values())
+            self._datasets.clear()
+        for ds in datasets:
+            _ldg.LEDGER.adjust_bytes("resident", ds.residency, -ds.nbytes)
 
     def list_datasets(self) -> list[dict]:
         with self._lock:

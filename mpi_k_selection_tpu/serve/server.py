@@ -38,6 +38,8 @@ Guarantees (tested in tests/test_serve.py):
 
 from __future__ import annotations
 
+import uuid
+
 import numpy as np
 
 from mpi_k_selection_tpu.serve import tiers as _tiers
@@ -68,15 +70,17 @@ OPS = ("kselect", "quantiles", "topk", "rank_certificate")
 class _LatencyRecorder:
     """PhaseTimer recorder bridging request phases to the obs channels:
     observes each finished ``serve.request.<tier>`` duration into the
-    per-tier latency histogram and forwards every span to the trace
-    recorder. Receives finished ``(name, t0, t1)`` triples only — no
-    clock is read here (KSL004)."""
+    per-tier latency histogram and forwards every span — with its
+    ``args`` context (the request/walk trace ids) — to the trace
+    recorder and the flight ring. Receives finished ``(name, t0, t1)``
+    triples only — no clock is read here (KSL004)."""
 
-    def __init__(self, metrics, trace):
+    def __init__(self, metrics, trace, flight=None):
         self._metrics = metrics
         self._trace = trace
+        self._flight = flight
 
-    def record(self, name: str, t0: float, t1: float) -> None:
+    def record(self, name: str, t0: float, t1: float, args=None) -> None:
         if self._metrics is not None and name.startswith("serve.request."):
             tier = name.rsplit(".", 1)[-1]
             self._metrics.histogram(
@@ -85,7 +89,9 @@ class _LatencyRecorder:
                 buckets=LATENCY_BUCKETS,
             ).observe(t1 - t0)
         if self._trace is not None:
-            self._trace.record(name, t0, t1)
+            self._trace.record(name, t0, t1, args)
+        if self._flight is not None:
+            self._flight.record(name, t0, t1, args)
 
 
 class KSelectServer:
@@ -115,12 +121,38 @@ class KSelectServer:
         retry_after: float = 1.0,
         default_deadline: float | None = None,
         latency_windows=None,
+        flight=None,
         obs=None,
         registry: DatasetRegistry | None = None,
     ):
+        from mpi_k_selection_tpu.obs import Observability
+        from mpi_k_selection_tpu.obs.flight import resolve_flight
+
         from mpi_k_selection_tpu.utils.profiling import PhaseTimer
 
+        # flight (off by default): the postmortem ring (obs/flight.py) —
+        # True/int/FlightRecorder per resolve_flight. It attaches to the
+        # obs bundle so every emitted event fans into it; a server built
+        # without obs gets a flight-only bundle, so debug_bundle() and
+        # the auto-dump triggers work regardless.
+        fr = resolve_flight(flight)
+        if fr is not None:
+            if obs is None:
+                obs = Observability(flight=fr)
+            elif obs.flight is None:
+                obs.flight = fr
+            elif flight is not True and obs.flight is not fr:
+                # a concrete recorder (or capacity) that conflicts with
+                # the obs bundle's existing ring must not be silently
+                # dropped — auto-dumps would freeze the wrong ring;
+                # flight=True just means "on" and keeps the existing one
+                raise ValueError(
+                    "flight= names a recorder but obs already carries a "
+                    "different flight ring — pass one of them, or "
+                    "flight=True to keep the obs ring"
+                )
         self.obs = obs
+        self.flight = None if obs is None else obs.flight
         self.metrics = None if obs is None else obs.metrics
         # latency_windows (off by default): back serve.latency_seconds
         # with a sliding-window RadixSketch (obs/windows.py), so /metrics
@@ -144,13 +176,23 @@ class KSelectServer:
             else:
                 spec = dict(latency_windows)
             self.metrics.enable_windowed("serve.latency_seconds", **spec)
+        self._owns_registry = registry is None
+        self._closed = False
         self.registry = registry if registry is not None else DatasetRegistry()
+        # the program cache reports into the process ProgramLedger; give
+        # its storm events this server's sink — but never STEAL the sink
+        # of a shared caller-owned registry another server already wired
+        # (its storms would land on the wrong event stream)
+        if self._owns_registry or self.registry.programs.obs is None:
+            self.registry.programs.obs = self.obs
         self.default_deadline = (
             None if default_deadline is None else float(default_deadline)
         )
         self.timer = PhaseTimer(
             recorder=_LatencyRecorder(
-                self.metrics, None if obs is None else obs.trace
+                self.metrics,
+                None if obs is None else obs.trace,
+                self.flight,
             )
         )
         self.batcher = QueryBatcher(
@@ -168,6 +210,15 @@ class KSelectServer:
 
     # -- dataset lifecycle -------------------------------------------------
 
+    def _get(self, dataset_id: str):
+        """Resolve a dataset for a request, with the closed check FIRST:
+        close() empties an owned registry, so without it a post-close
+        query would read as "dataset not found" instead of the
+        documented :class:`ServerClosedError`."""
+        if self._closed:
+            raise ServerClosedError("server is closed; query rejected")
+        return self.registry.get(dataset_id)
+
     def add_dataset(
         self, dataset_id: str, data=None, *, source=None, **kwargs
     ):
@@ -175,6 +226,10 @@ class KSelectServer:
         once) or ``source`` (a replayable chunk source — sketched once,
         exact queries re-stream). Keyword options per
         :meth:`DatasetRegistry.add_array` / :meth:`add_stream`."""
+        if self._closed:
+            # a post-close registration would re-enter the ledger's
+            # resident byte book with nothing left to release it
+            raise ServerClosedError("server is closed; query rejected")
         if (data is None) == (source is None):
             raise QueryError("pass exactly one of data= or source=")
         if data is not None:
@@ -196,67 +251,93 @@ class KSelectServer:
     # -- queries (request threads) -----------------------------------------
 
     def kselect(
-        self, dataset_id: str, k, *, tier: str = "auto", deadline=None
+        self, dataset_id: str, k, *, tier: str = "auto", deadline=None,
+        trace_id=None,
     ) -> RankAnswer:
         """Exact-or-bounded k-th smallest (1-indexed). Returns one
         :class:`RankAnswer`; ``tier`` per serve/tiers.py. ``deadline``
         (seconds, or a :class:`~mpi_k_selection_tpu.utils.timing.
         Deadline`) bounds the whole request — expiry raises the typed
         :class:`~mpi_k_selection_tpu.serve.errors.
-        DeadlineExceededError` (HTTP 504)."""
-        ds = self.registry.get(dataset_id)
-        return self._rank_query(ds, [k], tier, "kselect", deadline)[0]
+        DeadlineExceededError` (HTTP 504). ``trace_id`` is the request-
+        correlation id (minted when None — docs/OBSERVABILITY.md "Trace
+        IDs"); it rides the query's events and spans."""
+        ds = self._get(dataset_id)
+        return self._rank_query(ds, [k], tier, "kselect", deadline, trace_id)[0]
 
     def kselect_many(
-        self, dataset_id: str, ks, *, tier: str = "auto", deadline=None
+        self, dataset_id: str, ks, *, tier: str = "auto", deadline=None,
+        trace_id=None,
     ):
         """One :class:`RankAnswer` per rank in ``ks``, in order — the
         whole request rides one dispatch (and one shared walk)."""
-        ds = self.registry.get(dataset_id)
-        return self._rank_query(ds, list(ks), tier, "kselect", deadline)
+        ds = self._get(dataset_id)
+        return self._rank_query(ds, list(ks), tier, "kselect", deadline, trace_id)
 
     def quantiles(
-        self, dataset_id: str, qs, *, tier: str = "auto", deadline=None
+        self, dataset_id: str, qs, *, tier: str = "auto", deadline=None,
+        trace_id=None,
     ):
         """Nearest-rank quantile answers (``api.quantile_ranks``
         conversion, so exact-tier values are bit-identical to
         ``api.quantiles`` over the same resident bits)."""
         from mpi_k_selection_tpu.api import quantile_ranks
 
-        ds = self.registry.get(dataset_id)
+        ds = self._get(dataset_id)
         try:
             ks = quantile_ranks(qs, ds.n)
         except ValueError as e:
             raise QueryError(str(e)) from e
-        return self._rank_query(ds, ks, tier, "quantiles", deadline)
+        return self._rank_query(ds, ks, tier, "quantiles", deadline, trace_id)
 
     def topk(
-        self, dataset_id: str, k: int, *, largest: bool = True, deadline=None
+        self, dataset_id: str, k: int, *, largest: bool = True, deadline=None,
+        trace_id=None,
     ):
         """Exact top-k ``(values, indices)`` over a resident dataset
         (earliest-position tie break, matching ``lax.top_k``)."""
-        ds = self.registry.get(dataset_id)
+        ds = self._get(dataset_id)
+        tid = self._trace_id(trace_id)
         result = self._run_single(
             ds, "topk",
             lambda: self.registry.topk(ds, k, largest=largest),
-            deadline,
+            deadline, tid,
         )
-        self._account(ds, "topk", None, "exact", 1, False)
+        self._account(ds, "topk", None, "exact", 1, False, tid)
         return result
 
-    def rank_certificate(self, dataset_id: str, value, *, deadline=None):
+    def rank_certificate(
+        self, dataset_id: str, value, *, deadline=None, trace_id=None
+    ):
         """Exact ``(#<, #<=)`` counts for ``value`` — the O(n) proof a
         served answer is the true order statistic."""
-        ds = self.registry.get(dataset_id)
+        ds = self._get(dataset_id)
+        tid = self._trace_id(trace_id)
         result = self._run_single(
             ds, "rank_certificate",
             lambda: self.registry.rank_certificate(ds, value),
-            deadline,
+            deadline, tid,
         )
-        self._account(ds, "rank_certificate", None, "exact", 1, False)
+        self._account(ds, "rank_certificate", None, "exact", 1, False, tid)
         return result
 
     # -- internals ---------------------------------------------------------
+
+    @staticmethod
+    def _trace_id(trace_id) -> str:
+        """Honor a caller-supplied correlation id, mint one otherwise
+        (the HTTP front passes the client's ``X-Ksel-Trace-Id`` through
+        here, so one id follows a query across services). The id is
+        echoed verbatim into response HEADERS, so it is clamped to
+        printable ASCII and bounded — an obs-folded inbound value
+        (``abc\\r\\n\\tevil`` survives the stdlib header parse) must not
+        become a CR/LF header-injection primitive on the echo. An id
+        that sanitizes to nothing is replaced by a minted one."""
+        if trace_id:
+            tid = "".join(c for c in str(trace_id)[:128] if " " <= c <= "~")
+            if tid:
+                return tid
+        return uuid.uuid4().hex[:16]
 
     def _check_open(self) -> None:
         if self.batcher.closed:
@@ -283,28 +364,34 @@ class KSelectServer:
                     self.metrics.counter("serve.deadline_exceeded").inc()
             raise
 
-    def _rank_query(self, ds, ks, tier, op, deadline=None) -> list[RankAnswer]:
+    def _rank_query(
+        self, ds, ks, tier, op, deadline=None, trace_id=None
+    ) -> list[RankAnswer]:
         """``ds`` is the RESOLVED dataset (not an id): validation and
         execution must describe the same object even if the id is
         dropped and re-registered mid-request."""
         self._check_open()
         tier = _tiers.validate_tier(tier)
         dl = self._resolve_deadline(deadline)
+        tid = self._trace_id(trace_id)
         ks = [int(k) for k in ks]
         for k in ks:
             if not 1 <= k <= ds.n:
                 raise QueryError(f"k={k} out of range [1, {ds.n}]")
         if tier == "sketch" or (tier == "auto" and _tiers.auto_pins(ds, ks)):
             _tiers.require_sketch(ds)
-            with self.timer.phase("serve.request.sketch"):
+            with self.timer.phase(
+                "serve.request.sketch", args={"trace_id": tid}
+            ):
                 answers = _tiers.sketch_answers(ds, ks)
-            self._account(ds, op, tier, "sketch", len(ks), False)
+            self._account(ds, op, tier, "sketch", len(ks), False, tid)
             return answers
         escalated = tier == "auto"
-        with self.timer.phase("serve.request.exact"):
+        with self.timer.phase("serve.request.exact", args={"trace_id": tid}):
             pending = self.batcher.submit(
                 PendingQuery(
-                    ds.dataset_id, "rank", ks=tuple(ks), ds=ds, deadline=dl
+                    ds.dataset_id, "rank", ks=tuple(ks), ds=ds, deadline=dl,
+                    trace_id=tid,
                 )
             )
             values = self._wait(pending)
@@ -315,18 +402,23 @@ class KSelectServer:
             )
             for i, k in enumerate(ks)
         ]
-        self._account(ds, op, tier, "exact", len(ks), escalated)
+        self._account(ds, op, tier, "exact", len(ks), escalated, tid)
         return answers
 
-    def _run_single(self, ds, kind, run, deadline=None):
+    def _run_single(self, ds, kind, run, deadline=None, trace_id=None):
         """Route one non-rank op through the dispatch thread (all device
         work stays serialized there)."""
         self._check_open()
         dl = self._resolve_deadline(deadline)
-        with self.timer.phase("serve.request.exact"):
+        with self.timer.phase(
+            "serve.request.exact", args={"trace_id": trace_id}
+        ):
             return self._wait(
                 self.batcher.submit(
-                    PendingQuery(ds.dataset_id, kind, ds=ds, run=run, deadline=dl)
+                    PendingQuery(
+                        ds.dataset_id, kind, ds=ds, run=run, deadline=dl,
+                        trace_id=trace_id,
+                    )
                 )
             )
 
@@ -334,10 +426,17 @@ class KSelectServer:
         """Dispatch-thread executor: ONE shared-pass select over the
         coalesced ranks of every request in the group (all items carry
         the same resolved dataset object), split back in submission
-        order."""
+        order. The walk span carries every rider's trace id, so one
+        slow coalesced walk is joinable back to the client requests
+        that rode it (and to their FaultEvents)."""
         ds = items[0].ds
         all_ks = [k for item in items for k in item.ks]
-        values = np.asarray(self.registry.select_many(ds, all_ks))
+        trace_ids = tuple(i.trace_id for i in items if i.trace_id)
+        with self.timer.phase(
+            "serve.walk",
+            args={"dataset": ds.dataset_id, "trace_ids": list(trace_ids)},
+        ):
+            values = np.asarray(self.registry.select_many(ds, all_ks))
         pos = 0
         for item in items:
             item.result = values[pos : pos + len(item.ks)]
@@ -350,6 +449,7 @@ class KSelectServer:
                     dataset=ds.dataset_id,
                     requests=len(items),
                     width=len(all_ks),
+                    trace_ids=trace_ids,
                 )
             )
 
@@ -388,8 +488,17 @@ class KSelectServer:
             self.metrics.counter("serve.dispatch_restarts").set(
                 int(self.batcher.restarts)
             )
+        # a supervisor restart means a DispatchCrashedError reached
+        # clients: freeze the postmortem ring ONCE (obs/flight.py; no-op
+        # without a flight channel, never raises)
+        from mpi_k_selection_tpu.obs.flight import auto_dump
 
-    def _account(self, ds, op, tier_requested, tier_answered, queries, escalated):
+        auto_dump(self.obs, "dispatch-crashed", exc=exc)
+
+    def _account(
+        self, ds, op, tier_requested, tier_answered, queries, escalated,
+        trace_id=None,
+    ):
         """Per-request accounting: one ``serve.query`` event plus the
         tier/op counters. Pure host-int observation."""
         if self.obs is None:
@@ -404,6 +513,7 @@ class KSelectServer:
                 tier_answered=tier_answered,
                 queries=queries,
                 escalated=escalated,
+                trace_id=trace_id,
             )
         )
         if self.metrics is not None:
@@ -420,6 +530,7 @@ class KSelectServer:
         endpoint and ``render_prometheus`` call this before exposition."""
         if self.metrics is None:
             return None
+        from mpi_k_selection_tpu.obs.ledger import collect_ledger
         from mpi_k_selection_tpu.obs.metrics import collect_runtime
 
         self.metrics.counter("serve.program_cache.hits").set(
@@ -436,7 +547,51 @@ class KSelectServer:
             int(self.batcher.restarts)
         )
         collect_runtime(self.metrics, timer=self.timer)
+        # the process ProgramLedger's compile/byte book rides /metrics
+        # too (ledger.compiles{site=}, ledger.device_bytes{pool=,device=})
+        collect_ledger(self.metrics)
         return self.metrics
+
+    def _server_section(self) -> dict:
+        return {
+            "datasets": self.list_datasets(),
+            "program_cache": {
+                "hits": int(self.registry.programs.hits),
+                "misses": int(self.registry.programs.misses),
+                "entries": len(self.registry.programs),
+            },
+            "dispatch_restarts": int(self.batcher.restarts),
+            "closed": self.batcher.closed,
+        }
+
+    def debug_bundle(self, *, reason: str = "on-demand") -> dict:
+        """Assemble the JSON-ready debug bundle (obs/flight.py): the
+        flight ring's event/span tails (empty without a ``flight=``
+        channel — the bundle degrades gracefully), the live metrics
+        snapshot, the process ledger, the fault section, and this
+        server's own state. ``GET /debug/bundle`` serves exactly this."""
+        from mpi_k_selection_tpu.obs.flight import build_bundle
+
+        if self.metrics is not None:
+            self.collect_metrics()
+        return build_bundle(
+            self.obs, reason=reason, extra={"server": self._server_section()}
+        )
+
+    def dump_debug_bundle(self, path, *, reason: str = "on-demand") -> str:
+        """:meth:`debug_bundle` written as JSON through the flight
+        ring's registered dump (the CLI ``--debug-bundle`` shutdown
+        artifact) — the ``server`` section rides along, which a bare
+        ``FlightRecorder.dump`` would drop. Requires the ``flight=``
+        channel."""
+        if self.flight is None:
+            raise ValueError("dump_debug_bundle needs the flight= channel")
+        if self.metrics is not None:
+            self.collect_metrics()
+        return self.flight.dump(
+            path, obs=self.obs, reason=reason,
+            extra={"server": self._server_section()},
+        )
 
     def render_prometheus(self) -> str:
         """Prometheus text exposition of the server metrics (empty when
@@ -447,8 +602,14 @@ class KSelectServer:
     # -- lifecycle ---------------------------------------------------------
 
     def close(self) -> None:
-        """Join the dispatch thread; fail queued stragglers. Idempotent."""
+        """Join the dispatch thread; fail queued stragglers. A registry
+        this server created is closed too (its datasets leave the ledger
+        resident byte book); a caller-provided one stays the caller's.
+        Idempotent."""
+        self._closed = True
         self.batcher.close()
+        if self._owns_registry:
+            self.registry.close()
 
     def __enter__(self) -> "KSelectServer":
         return self

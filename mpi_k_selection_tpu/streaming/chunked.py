@@ -79,6 +79,7 @@ import numpy as np
 from mpi_k_selection_tpu import errors as _err
 from mpi_k_selection_tpu.faults import policy as _fp
 from mpi_k_selection_tpu.obs import events as _ev
+from mpi_k_selection_tpu.obs import ledger as _ldg
 from mpi_k_selection_tpu.obs import metrics as _om
 from mpi_k_selection_tpu.obs import wiring as _wr
 from mpi_k_selection_tpu.streaming import executor as _ex
@@ -385,6 +386,8 @@ def _recover_pass(
 
     Everything else propagates untouched: retrying a logic error repeats
     it."""
+    from mpi_k_selection_tpu.obs import flight as _fl
+
     transient = 0
     reread = False
     src = None
@@ -394,12 +397,17 @@ def _recover_pass(
             return run(src, tee)
         except _err.SpillRecordError as e:
             if not reading_spill or src is not None:
+                # unrecoverable spill damage (no ladder rung left): the
+                # postmortem hook fires ONCE per flight recorder before
+                # the typed error propagates (a no-op without one)
+                _fl.auto_dump(obs, "spill-unrecoverable", exc=e)
                 raise
             if not reread:
                 reread = True
                 _emit_fault(obs, "spill.read", "reread", e)
                 continue
             if fallback is None:
+                _fl.auto_dump(obs, "spill-unrecoverable", exc=e)
                 raise
             _emit_fault(obs, "spill.read", "rebuild", e)
             src = fallback
@@ -422,12 +430,18 @@ def _recover_pass(
                 raise
             transient += 1
             if transient >= policy.max_attempts:
-                raise _err.RetryExhaustedError(
+                exhausted = _err.RetryExhaustedError(
                     f"{site}: still failing after {policy.max_attempts} "
                     f"attempts ({type(e).__name__}: {e})",
                     site=site,
                     attempts=policy.max_attempts,
-                ) from e
+                )
+                # the fault-triggered debug bundle (obs/flight.py): at
+                # most one per flight recorder, never raises, and the
+                # events tail it freezes still holds the retry/inject
+                # FaultEvents that led here
+                _fl.auto_dump(obs, "retry-exhausted", exc=exhausted)
+                raise exhausted from e
             _emit_fault(obs, site, "retry", e)
             policy.sleep(transient)
             continue
@@ -1267,11 +1281,14 @@ def streaming_kselect_many(
 
         if obs is not None and obs.metrics is not None:
             # snapshot the run's counters while the store is still open
-            # (the finally below may remove an internal one)
+            # (the finally below may remove an internal one); the ledger
+            # fold carries the PROCESS-lifetime compile/byte book
+            # (per-run readings delta two ledger snapshots)
             _om.collect_runtime(
                 obs.metrics, staging_pool=_pl.STAGING_POOL,
                 spill_store=store, timer=timer,
             )
+            _ldg.collect_ledger(obs.metrics)
         answers = []
         for prefix, kk, resolved, _pop in states:
             if resolved == total_bits:

@@ -76,6 +76,7 @@ from __future__ import annotations
 import numpy as np
 
 from mpi_k_selection_tpu.obs import wiring as _wr
+from mpi_k_selection_tpu.obs.ledger import ledger_dispatch as _ledger_dispatch
 from mpi_k_selection_tpu.ops.pallas import fused_ingest as _fi
 from mpi_k_selection_tpu.ops.pallas import sweep_ingest as _si
 from mpi_k_selection_tpu.ops.pallas.fused_ingest import (
@@ -411,11 +412,36 @@ class HistogramConsumer(Consumer):
 
     def dispatch(self, keys, kv):
         shift, radix_bits, prefixes, method, kdt = self._args
-        if isinstance(keys, StagedKeys) and method != "numpy":
+        staged = isinstance(keys, StagedKeys)
+        if staged and method != "numpy":
             _wr.bucket_read(self._obs, "histogram", keys)
-        handle = dispatch_chunk_histograms(
-            keys, shift, radix_bits, prefixes, method, kdt
-        )
+        if method == "numpy":
+            handle = dispatch_chunk_histograms(
+                keys, shift, radix_bits, prefixes, method, kdt
+            )
+        else:
+            # compile identity of the device histogram program: buffer
+            # length (the pow2 bucket for staged chunks, the ragged
+            # length otherwise — each distinct length IS a compile),
+            # dtype, prefix COUNT (values are traced), method and the
+            # static shift/radix geometry
+            buf = keys.data if staged else keys
+            key = (
+                int(buf.shape[0]), kdt.str,
+                0 if prefixes[0] is None else len(prefixes),
+                method, shift, radix_bits,
+            )
+            # the per-level shift multiplies compiles in ONE healthy
+            # descent (levels x buckets) — strip it from the storm
+            # detector's churn identity so only genuine shape/width
+            # churn counts toward the threshold
+            with _ledger_dispatch(
+                "ingest.histogram", key, self._obs,
+                storm_key=key[:4] + key[5:],
+            ):
+                handle = dispatch_chunk_histograms(
+                    keys, shift, radix_bits, prefixes, method, kdt
+                )
         if handle[1] is not None:  # host-computed: fold now, nothing in flight
             self._fold(handle[1])
             return None
@@ -451,10 +477,15 @@ class CollectConsumer(Consumer):
             # fused consumer collapses to 1
             _wr.bucket_read(self._obs, "collect", keys, len(self.specs))
         if self._deferred and isinstance(keys, StagedKeys):
-            return [
-                dispatch_compaction(keys, [spec], self._kdt, self._bits)
-                for spec in self.specs
-            ]
+            # ONE compiled compaction serves every single-spec dispatch of
+            # a bucket (shift/prefix are traced scalars): one ledger key
+            # per (bucket, dtype), hits for every later spec and chunk
+            key = (int(keys.data.shape[0]), self._kdt.str, 1)
+            with _ledger_dispatch("ingest.collect", key, self._obs):
+                return [
+                    dispatch_compaction(keys, [spec], self._kdt, self._bits)
+                    for spec in self.specs
+                ]
         kv = eager_valid(kv)
         host = isinstance(kv, np.ndarray)
         for spec in self.specs:
@@ -514,10 +545,14 @@ class SpillTeeConsumer(Consumer):
         if isinstance(keys, StagedKeys):
             _wr.bucket_read(self._obs, "tee", keys)
         if self._deferred and isinstance(keys, StagedKeys):
-            return (
-                slot,
-                dispatch_compaction(keys, self._specs, self._kdt, self._bits),
-            )
+            key = (int(keys.data.shape[0]), self._kdt.str, len(self._specs))
+            with _ledger_dispatch("ingest.tee", key, self._obs):
+                return (
+                    slot,
+                    dispatch_compaction(
+                        keys, self._specs, self._kdt, self._bits
+                    ),
+                )
         kv = eager_valid(kv)
         m = None
         for resolved, prefix in self._specs:
@@ -580,19 +615,27 @@ class CountLessLeqConsumer(Consumer):
         ):
             # ONE sweep program per staged bucket (pad-exact in kernel)
             _wr.bucket_read(self._obs, "certificate", keys, 1)
-            _, _, _, (lt, le), _ = _si.dispatch_sweep_ingest(
-                keys, kdt=self._kdt, vkey=self._vkey
-            )
+            key = (int(keys.data.shape[0]), self._kdt.str, "sweep")
+            with _ledger_dispatch("ingest.certificate", key, self._obs):
+                _, _, _, (lt, le), _ = _si.dispatch_sweep_ingest(
+                    keys, kdt=self._kdt, vkey=self._vkey
+                )
             return (lt, le, 0)
         if isinstance(keys, StagedKeys):
             # two count programs (< and <=) per staged bucket
             _wr.bucket_read(self._obs, "certificate", keys, 2)
         if self._deferred and isinstance(keys, StagedKeys):
             v = keys.data.dtype.type(self._vkey)
-            return (jnp.sum(keys.data < v), jnp.sum(keys.data <= v), keys.pad)
+            key = (int(keys.data.shape[0]), self._kdt.str, "pair")
+            with _ledger_dispatch("ingest.certificate", key, self._obs):
+                return (
+                    jnp.sum(keys.data < v), jnp.sum(keys.data <= v), keys.pad
+                )
         kv = eager_valid(kv)
         v = kv.dtype.type(self._vkey)
-        return (jnp.sum(kv < v), jnp.sum(kv <= v), 0)
+        key = (int(kv.shape[0]), self._kdt.str, "eager")
+        with _ledger_dispatch("ingest.certificate", key, self._obs):
+            return (jnp.sum(kv < v), jnp.sum(kv <= v), 0)
 
     def finish(self, handle) -> None:
         lt, le, pad = handle
@@ -673,32 +716,48 @@ class FusedIngestConsumer(Consumer):
         )
         collect_specs = self._collect.specs if self._collect else ()
         tee_specs = self._tee._specs if self._tee else ()
-        if self._tier == "kernel" and _si.sweep_supported(
+        use_kernel = self._tier == "kernel" and _si.sweep_supported(
             keys, self._kdt, radix_bits=radix_bits
+        )
+        # compile identity of the fused program: the bucket, dtype, the
+        # tier that actually runs (kernel support is per bucket), the
+        # static shift/radix geometry, and every part's spec COUNT
+        # (prefix/spec values are traced)
+        key = (
+            int(keys.data.shape[0]), self._kdt.str,
+            "kernel" if use_kernel else "xla", shift, radix_bits,
+            0 if hist_prefixes in (None, [None]) else len(hist_prefixes),
+            len(collect_specs), len(tee_specs),
+        )
+        # shift stripped from the churn identity: per-level compiles in
+        # one healthy descent are not shape churn (see HistogramConsumer)
+        with _ledger_dispatch(
+            "ingest.fused", key, self._obs, storm_key=key[:3] + key[4:]
         ):
-            hist_h, collect_h, tee_h, _, _ = _si.dispatch_sweep_ingest(
-                keys,
-                kdt=self._kdt,
-                total_bits=self._bits,
-                shift=shift,
-                radix_bits=radix_bits,
-                hist_prefixes=hist_prefixes,
-                collect_specs=collect_specs,
-                tee_specs=tee_specs,
-            )
-            handle = (hist_h, collect_h, tee_h)
-        else:
-            handle = _fi.dispatch_fused_ingest(
-                keys,
-                kdt=self._kdt,
-                total_bits=self._bits,
-                shift=shift,
-                radix_bits=radix_bits,
-                hist_prefixes=hist_prefixes,
-                method=method,
-                collect_specs=collect_specs,
-                tee_specs=tee_specs,
-            )
+            if use_kernel:
+                hist_h, collect_h, tee_h, _, _ = _si.dispatch_sweep_ingest(
+                    keys,
+                    kdt=self._kdt,
+                    total_bits=self._bits,
+                    shift=shift,
+                    radix_bits=radix_bits,
+                    hist_prefixes=hist_prefixes,
+                    collect_specs=collect_specs,
+                    tee_specs=tee_specs,
+                )
+                handle = (hist_h, collect_h, tee_h)
+            else:
+                handle = _fi.dispatch_fused_ingest(
+                    keys,
+                    kdt=self._kdt,
+                    total_bits=self._bits,
+                    shift=shift,
+                    radix_bits=radix_bits,
+                    hist_prefixes=hist_prefixes,
+                    method=method,
+                    collect_specs=collect_specs,
+                    tee_specs=tee_specs,
+                )
         return ("fused", (keys, slot, handle))
 
     def finish(self, handle) -> None:

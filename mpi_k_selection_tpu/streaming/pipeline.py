@@ -80,6 +80,7 @@ import numpy as np
 
 from mpi_k_selection_tpu.faults import policy as _fpol
 from mpi_k_selection_tpu.faults.inject import maybe_fault as _maybe_fault
+from mpi_k_selection_tpu.obs import ledger as _ledger
 
 #: Classic double buffering: chunk i+1 staged while chunk i computes.
 DEFAULT_PIPELINE_DEPTH = 2
@@ -216,8 +217,16 @@ class StagingPool:
                 self._bytes -= buf.nbytes
                 self._order.remove((key, buf.nbytes))
                 self.hits += 1
-                return buf
-            self.misses += 1
+            else:
+                self.misses += 1
+                buf = None
+            # gauge published while still holding the pool lock: two
+            # interleaved acquire/release publishes outside it could
+            # land last-writer-wins with the STALE footprint (the ledger
+            # lock nests inside and acquires nothing, so no cycle)
+            _ledger.LEDGER.set_bytes("staging_pool", None, self._bytes)
+        if buf is not None:
+            return buf
         return np.empty(int(bucket), np.dtype(dtype))
 
     def release(self, buf: np.ndarray, device=None) -> None:
@@ -237,6 +246,8 @@ class StagingPool:
                 if old:
                     old.pop(0)
                     self._bytes -= nbytes
+            # under the lock: see acquire()
+            _ledger.LEDGER.set_bytes("staging_pool", None, self._bytes)
 
     @property
     def resident_bytes(self) -> int:
@@ -250,6 +261,8 @@ class StagingPool:
             self._free.clear()
             self._order.clear()
             self._bytes = 0
+            # under the lock: see acquire()
+            _ledger.LEDGER.set_bytes("staging_pool", None, 0)
 
 
 #: Module-level pool: staging buckets recur across passes (every pass
@@ -276,6 +289,27 @@ def _live_staged_dec() -> None:
     global _LIVE_STAGED
     with _LIVE_STAGED_LOCK:
         _LIVE_STAGED -= 1
+
+
+def _release_latch(staged) -> tuple:
+    """Atomic test-and-set of a :class:`StagedKeys`' release latches
+    under the live-staged lock: racing releases (an unwind path against
+    the normal ring pop) each claim the pool hand-back and the tracked
+    decrement AT MOST once — an unsynchronized check-then-set would let
+    both threads see the flag, double-insert the host buffer into the
+    pool and double-subtract the staging byte gauge. Returns
+    ``(host_buf_to_release, won_tracked)``."""
+    global _LIVE_STAGED
+    with _LIVE_STAGED_LOCK:
+        host_buf = None
+        if staged.host_buf is not None and staged.pool is not None:
+            host_buf = staged.host_buf
+            object.__setattr__(staged, "host_buf", None)
+        tracked = staged.tracked
+        if tracked:
+            object.__setattr__(staged, "tracked", False)
+            _LIVE_STAGED -= 1
+    return host_buf, tracked
 
 
 def live_staged_keys() -> int:
@@ -392,20 +426,25 @@ class StagedKeys:
         Idempotent: the pool hand-back and the live-staged decrement each
         happen exactly once (unwind paths — executor abort, pipeline
         close — may race a normal release on the same chunk)."""
+        # padded-buffer bytes off the array METADATA, read before the
+        # delete below invalidates the buffer (shape/dtype survive it) —
+        # the ledger's staging gauge decrement must mirror stage-time's add
+        nbytes = int(self.data.shape[0]) * np.dtype(self.data.dtype).itemsize
         delete = getattr(self.data, "delete", None)
         if delete is not None and self.own_data:
             try:
                 delete()
             except Exception:  # pragma: no cover  # ksel: noqa[KSL012] -- release() is idempotent by contract: delete() of an already-consumed/donated buffer is the expected second-release path, and there is nothing to report or retry
                 pass
-        if self.host_buf is not None and self.pool is not None:
-            self.pool.release(self.host_buf, self.device)
-            # frozen dataclass: clear via object.__setattr__ so a second
-            # release() cannot double-insert the buffer (aliasing hazard)
-            object.__setattr__(self, "host_buf", None)
-        if self.tracked:
-            object.__setattr__(self, "tracked", False)
-            _live_staged_dec()
+        # both latches claimed atomically (_release_latch): unwind paths
+        # may race the normal release on the same chunk, and each side
+        # effect — pool hand-back, live-staged decrement, byte-gauge
+        # subtraction — must happen exactly once
+        host_buf, tracked = _release_latch(self)
+        if host_buf is not None:
+            self.pool.release(host_buf, self.device)
+        if tracked:
+            _ledger.LEDGER.adjust_bytes("staging", self.device, -nbytes)
 
 
 def _bucket_elems(n: int) -> int:
@@ -448,6 +487,7 @@ def stage_keys(
         data = jax.device_put(keys, device)
         data.block_until_ready()
         _live_staged_inc()
+        _ledger.LEDGER.adjust_bytes("staging", device, n * keys.dtype.itemsize)
         # device recorded even without a pad buffer: the spill tee keys
         # its records by the staged slot (chunk->device determinism)
         return StagedKeys(data, n, device=device, tracked=True)
@@ -459,6 +499,7 @@ def stage_keys(
     data = jax.device_put(buf, device)
     data.block_until_ready()
     _live_staged_inc()
+    _ledger.LEDGER.adjust_bytes("staging", device, bucket * keys.dtype.itemsize)
     # the pad buffer is NOT recycled yet: device_put may alias host memory
     # (CPU zero-copy), so it rides the StagedKeys and returns to the pool
     # when the consumer release()s the slot
@@ -507,9 +548,11 @@ def stage_device_keys(keys, fault_index: int | None = None) -> StagedKeys:
     bucket = _bucket_elems(n)
     if bucket == n:
         _live_staged_inc()
-        return StagedKeys(
-            keys, n, device=_array_device(keys), tracked=True, own_data=False
+        dev = _array_device(keys)
+        _ledger.LEDGER.adjust_bytes(
+            "staging", dev, n * np.dtype(keys.dtype).itemsize
         )
+        return StagedKeys(keys, n, device=dev, tracked=True, own_data=False)
     global _DEVICE_PAD_FN
     if _DEVICE_PAD_FN is None:
         import jax.numpy as jnp
@@ -520,7 +563,11 @@ def stage_device_keys(keys, fault_index: int | None = None) -> StagedKeys:
     data = _DEVICE_PAD_FN(keys, bucket - n)
     data.block_until_ready()
     _live_staged_inc()
-    return StagedKeys(data, n, device=_array_device(data), tracked=True)
+    dev = _array_device(data)
+    _ledger.LEDGER.adjust_bytes(
+        "staging", dev, bucket * np.dtype(data.dtype).itemsize
+    )
+    return StagedKeys(data, n, device=dev, tracked=True)
 
 
 @dataclasses.dataclass

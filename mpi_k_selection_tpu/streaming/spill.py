@@ -67,6 +67,7 @@ import numpy as np
 
 from mpi_k_selection_tpu.errors import SpillError, SpillRecordError
 from mpi_k_selection_tpu.faults.inject import maybe_fault as _maybe_fault
+from mpi_k_selection_tpu.obs import ledger as _ledger
 from mpi_k_selection_tpu.streaming.pipeline import _bucket_elems
 
 #: Temp-directory prefix for internally-created stores; tests assert none
@@ -411,6 +412,9 @@ class SpillStore:
     def _register(self, gen: SpillGeneration) -> None:
         self._check_open()
         self.generations[gen.index] = gen
+        # the on-disk byte book (obs/ledger.py): committed generations add
+        # their payload bytes, drop/close subtracts them exactly once
+        _ledger.LEDGER.adjust_bytes("spill", "disk", gen.nbytes)
 
     def latest_generation(self) -> SpillGeneration:
         """The newest committed generation — what a store-as-source read
@@ -429,7 +433,9 @@ class SpillStore:
         """Delete one generation's records (the eager disk-bound trim:
         at most two generations coexist during a descent)."""
         gen.dropped = True
-        self.generations.pop(gen.index, None)
+        if self.generations.pop(gen.index, None) is not None:
+            # pop-guarded so a double drop cannot double-subtract
+            _ledger.LEDGER.adjust_bytes("spill", "disk", -gen.nbytes)
         shutil.rmtree(gen.path, ignore_errors=True)
 
     def close(self) -> None:
@@ -440,6 +446,7 @@ class SpillStore:
         self._closed = True
         for gen in self.generations.values():
             gen.dropped = True
+            _ledger.LEDGER.adjust_bytes("spill", "disk", -gen.nbytes)
         self.generations.clear()
         shutil.rmtree(self.root, ignore_errors=True)
 

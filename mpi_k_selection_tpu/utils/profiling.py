@@ -50,7 +50,12 @@ class PhaseTimer:
     )
 
     @contextlib.contextmanager
-    def phase(self, name: str):
+    def phase(self, name: str, args: dict | None = None):
+        """``args`` (optional) is span context forwarded to the recorder
+        (e.g. the serve walk's trace ids) — it never touches the phase
+        accumulation, so the timing books are args-blind. Recorders
+        without an args parameter keep working: the 3-arg call is used
+        whenever no args were given."""
         t0 = time.perf_counter()
         try:
             yield
@@ -60,7 +65,10 @@ class PhaseTimer:
                 self.phases[name] = self.phases.get(name, 0.0) + (t1 - t0)
                 self.counts[name] = self.counts.get(name, 0) + 1
             if self.recorder is not None:
-                self.recorder.record(name, t0, t1)
+                if args is None:
+                    self.recorder.record(name, t0, t1)
+                else:
+                    self.recorder.record(name, t0, t1, args)
 
     def record(self, name: str, seconds: float) -> None:
         with self._lock:

@@ -80,6 +80,48 @@ def _no_leaked_staged_buffers():
 
 
 @pytest.fixture(autouse=True)
+def _flight_bundles_valid_and_reaped():
+    """Every debug bundle the test dumped (obs/flight.py registers each
+    one, auto and on-demand alike) must parse as JSON carrying all five
+    always-present sections, and no ``ksel-flight-*`` file may outlive
+    its test under the system temp dir — the spill-dir discipline
+    applied to the postmortem artifacts. Bundles written to explicit
+    paths (tmp_path) are validated too; only temp-dir ones are reaped
+    here (pytest owns tmp_path cleanup)."""
+    import glob
+    import json
+    import tempfile
+
+    from mpi_k_selection_tpu.obs.flight import (
+        BUNDLE_SECTIONS,
+        FLIGHT_FILE_PREFIX,
+        drain_dumped,
+    )
+
+    tmp = tempfile.gettempdir()
+    pattern = os.path.join(tmp, FLIGHT_FILE_PREFIX + "*")
+    before = set(glob.glob(pattern))
+    drain_dumped()  # a prior test's stragglers are not this test's
+    yield
+    for path in drain_dumped():
+        if not os.path.exists(path):
+            continue
+        with open(path) as f:
+            bundle = json.load(f)  # must parse — a torn dump fails here
+        missing = [s for s in BUNDLE_SECTIONS if s not in bundle]
+        assert not missing, (
+            f"debug bundle {path} is missing sections {missing} "
+            f"(every bundle carries {BUNDLE_SECTIONS})"
+        )
+        if os.path.dirname(path) == tmp and os.path.basename(
+            path
+        ).startswith(FLIGHT_FILE_PREFIX):
+            os.unlink(path)
+    leaked = sorted(set(glob.glob(pattern)) - before)
+    assert not leaked, f"leaked flight-recorder bundles: {leaked}"
+
+
+@pytest.fixture(autouse=True)
 def _no_leaked_spill_dirs():
     """Every internally-created spill store (streaming/spill.py) must be
     removed by the time its descent returns — on success AND on every

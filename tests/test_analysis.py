@@ -1399,6 +1399,153 @@ def test_lint_scan_skips_virtualenvs(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# KSL018 — obs event types live in obs/events.py AND in the documented
+# event catalog (docs/OBSERVABILITY.md), both directions
+
+
+KSL018_OUTSIDE = """
+    import dataclasses
+    from typing import ClassVar
+
+    class ObsEvent:
+        pass
+
+    @dataclasses.dataclass(frozen=True)
+    class RogueEvent(ObsEvent):
+        kind: ClassVar[str] = "rogue.event"
+        site: str
+"""
+
+KSL018_NEGATIVE = """
+    import dataclasses
+    from typing import ClassVar
+
+    @dataclasses.dataclass(frozen=True)
+    class ObsEvent:
+        # base-less root: not an emitted type
+        kind: ClassVar[str] = "root"
+
+    @dataclasses.dataclass
+    class NotFrozen(ObsEvent):
+        kind: ClassVar[str] = "x.y"
+
+    @dataclasses.dataclass(frozen=True)
+    class NotAnEvent(ObsEvent):
+        value: int
+
+    class PlainClass(ObsEvent):
+        kind = "no.dataclass"
+"""
+
+KSL018_EVENTS = """
+    import dataclasses
+    from typing import ClassVar
+
+    class ObsEvent:
+        pass
+
+    @dataclasses.dataclass(frozen=True)
+    class OneEvent(ObsEvent):
+        kind: ClassVar[str] = "a.one"
+        n: int
+
+    @dataclasses.dataclass(frozen=True)
+    class TwoEvent(ObsEvent):
+        kind: ClassVar[str] = "b.two"
+        n: int
+"""
+
+
+def _ksl018_doc(tmp_path, kinds):
+    doc = tmp_path / "docs" / "OBSERVABILITY.md"
+    doc.parent.mkdir(parents=True, exist_ok=True)
+    rows = "\n".join(f"| `{k}` | stuff |" for k in kinds)
+    doc.write_text(
+        "# Observability\n\n## Event schema\n\n"
+        "| kind | fields |\n|---|---|\n" + rows + "\n\n## Next section\n"
+    )
+
+
+def test_ksl018_event_type_outside_events_py(tmp_path):
+    report = _lint_source(
+        tmp_path, KSL018_OUTSIDE, name="mpi_k_selection_tpu/serve/mod.py"
+    )
+    hits = [f for f in report.unsuppressed if f.rule == "KSL018"]
+    assert len(hits) == 1
+    assert "RogueEvent" in hits[0].message
+    assert "rogue.event" in hits[0].message
+
+
+def test_ksl018_negative_shapes_pass(tmp_path):
+    report = _lint_source(
+        tmp_path, KSL018_NEGATIVE, name="mpi_k_selection_tpu/serve/mod.py"
+    )
+    assert "KSL018" not in _rules_hit(report)
+
+
+def test_ksl018_outside_package_and_tests_exempt(tmp_path):
+    report = _lint_source(tmp_path, KSL018_OUTSIDE, name="elsewhere/mod.py")
+    assert "KSL018" not in _rules_hit(report)
+    report = _lint_source(
+        tmp_path, KSL018_OUTSIDE,
+        name="mpi_k_selection_tpu/tests/test_mod.py", select=["KSL018"],
+    )
+    assert "KSL018" not in _rules_hit(report)
+
+
+def test_ksl018_noqa_suppresses(tmp_path):
+    src = KSL018_OUTSIDE.replace(
+        "class RogueEvent(ObsEvent):",
+        "class RogueEvent(ObsEvent):  # ksel: noqa[KSL018] -- fixture",
+    )
+    report = _lint_source(
+        tmp_path, src, name="mpi_k_selection_tpu/serve/mod.py"
+    )
+    assert "KSL018" not in _rules_hit(report)
+
+
+def test_ksl018_catalog_in_sync_passes(tmp_path):
+    _ksl018_doc(tmp_path, ["a.one", "b.two"])
+    report = _lint_source(
+        tmp_path, KSL018_EVENTS, name="mpi_k_selection_tpu/obs/events.py"
+    )
+    assert "KSL018" not in _rules_hit(report)
+
+
+def test_ksl018_catalog_drift_both_directions(tmp_path):
+    # b.two defined but undocumented; stale.kind documented but undefined
+    _ksl018_doc(tmp_path, ["a.one", "stale.kind"])
+    report = _lint_source(
+        tmp_path, KSL018_EVENTS, name="mpi_k_selection_tpu/obs/events.py"
+    )
+    hits = [f for f in report.unsuppressed if f.rule == "KSL018"]
+    assert len(hits) == 2
+    msgs = " | ".join(f.message for f in hits)
+    assert "b.two" in msgs and "no row" in msgs
+    assert "stale.kind" in msgs and "stale schema row" in msgs
+
+
+def test_ksl018_no_doc_tree_checks_location_only(tmp_path):
+    # a fixture tree without docs/ exercises only the location half
+    report = _lint_source(
+        tmp_path, KSL018_EVENTS, name="mpi_k_selection_tpu/obs/events.py"
+    )
+    assert "KSL018" not in _rules_hit(report)
+
+
+def test_ksl018_real_catalog_is_in_sync():
+    """The shipped obs/events.py and docs/OBSERVABILITY.md agree, both
+    directions (the gate also enforces this; this is the direct form)."""
+    report = run_analysis(
+        [REPO / "mpi_k_selection_tpu" / "obs" / "events.py"],
+        contracts=False, select=["KSL018"],
+    )
+    assert report.unsuppressed == [], [
+        f.render() for f in report.unsuppressed
+    ]
+
+
+# ---------------------------------------------------------------------------
 # THE GATE: zero unsuppressed findings over the whole repository
 
 

@@ -1,0 +1,911 @@
+"""Runtime ledger + flight recorder (obs/ledger.py, obs/flight.py,
+ISSUE 14): compile & device-memory accounting, request-correlated trace
+IDs, and the fault-triggered debug bundle.
+
+The load-bearing contracts:
+
+- **Bit-identity**: ledger + flight recorder + trace channel enabled
+  returns identical bits across the devices {1,2} x depth {0,2} x
+  spill {off,force} x fused {kernel,xla,off} grid — the same contract
+  every prior obs channel carries.
+- **Steady state**: a warmed resident serve burst reports ZERO ledger
+  compiles/recompiles (all program-cache hits); a deliberately
+  shape-churning run fires the typed ``RecompileStormEvent``.
+- **Postmortem**: a seeded chaos run that exhausts retries auto-dumps
+  exactly ONE debug bundle containing the triggering FaultEvents, the
+  ledger, the metrics snapshot, and >= 2 trace thread tracks; the
+  serve supervisor's DispatchCrashedError does the same.
+- **Byte book**: staging/spill/resident gauges go up while buffers are
+  live and return to zero when they are released, peaks retained.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from mpi_k_selection_tpu import faults
+from mpi_k_selection_tpu import obs as obs_lib
+from mpi_k_selection_tpu.errors import RetryExhaustedError
+from mpi_k_selection_tpu.obs.events import FaultEvent, RecompileStormEvent
+from mpi_k_selection_tpu.obs.flight import (
+    BUNDLE_SECTIONS,
+    FlightRecorder,
+    auto_dump,
+    build_bundle,
+    resolve_flight,
+)
+from mpi_k_selection_tpu.obs.ledger import (
+    LEDGER,
+    ProgramLedger,
+    collect_ledger,
+    ledger_dispatch,
+    snapshot_delta,
+)
+from mpi_k_selection_tpu.streaming.chunked import (
+    streaming_kselect,
+    streaming_kselect_many,
+)
+
+KW = dict(radix_bits=4, collect_budget=64)
+
+
+def _chunks(rng, sizes=(4096, 2777, 1, 0, 2048)):
+    return [
+        rng.integers(-(2**31), 2**31 - 1, size=m, dtype=np.int32)
+        for m in sizes
+    ]
+
+
+# ---------------------------------------------------------------------------
+# ProgramLedger units
+
+
+def test_dispatch_counts_compiles_then_hits():
+    led = ProgramLedger()
+    with led.dispatch("site", ("a", 1)) as compiled:
+        assert compiled is True
+    with led.dispatch("site", ("a", 1)) as compiled:
+        assert compiled is False
+    with led.dispatch("site", ("b", 2)) as compiled:
+        assert compiled is True
+    snap = led.snapshot()
+    st = snap["sites"]["site"]
+    assert st["compiles"] == 2
+    assert st["hits"] == 1
+    assert st["recompiles"] == 0
+    assert st["distinct_keys"] == 2
+    # compile walls accumulate through the sanctioned PhaseTimer route
+    assert st["compile_seconds"] >= 0.0
+    assert led.compile_seconds()["site"] >= 0.0
+
+
+def test_note_hit_and_compile_span():
+    led = ProgramLedger()
+    with led.compile_span("cache", "k1"):
+        pass
+    led.note_hit("cache", "k1")
+    led.note_hit("cache")  # keyless form
+    st = led.snapshot()["sites"]["cache"]
+    assert (st["compiles"], st["hits"]) == (1, 2)
+
+
+def test_ledger_dispatch_helper_routes_to_private_ledger():
+    led = ProgramLedger()
+    with ledger_dispatch("unit.private.site", "k", ledger=led):
+        pass
+    assert led.snapshot()["sites"]["unit.private.site"]["compiles"] == 1
+    # the process book never saw the private route's site
+    assert "unit.private.site" not in LEDGER.snapshot()["sites"]
+
+
+def test_storm_detector_fires_typed_event_into_sink():
+    sink = obs_lib.ListSink()
+    o = obs_lib.Observability(events=sink)
+    led = ProgramLedger(storm_threshold=2)
+    for i in range(5):  # 5 distinct keys: compiles 3, 4, 5 are storms
+        with led.dispatch("churny", ("shape", i), obs=o):
+            pass
+    storms = sink.of_kind("ledger.recompile_storm")
+    assert len(storms) == 3
+    assert all(isinstance(e, RecompileStormEvent) for e in storms)
+    assert [e.compiles for e in storms] == [3, 4, 5]
+    assert all(e.site == "churny" and e.threshold == 2 for e in storms)
+    snap = led.snapshot()
+    assert snap["sites"]["churny"]["recompiles"] == 3
+    # the ledger's own bounded ring retains them obs-independently
+    assert len(snap["storms"]) == 3
+    assert snap["storms"][0]["event"] == "ledger.recompile_storm"
+    # repeats of a known key are hits, never storms
+    with led.dispatch("churny", ("shape", 0), obs=o):
+        pass
+    assert len(sink.of_kind("ledger.recompile_storm")) == 3
+
+
+def test_storm_key_strips_static_dimension_from_churn_identity():
+    # the descent's per-level shift legitimately multiplies compiles in
+    # ONE healthy run (levels x buckets) — a site passing storm_key with
+    # that dimension stripped must never read as churn, while genuine
+    # shape churn (distinct storm keys) still fires
+    sink = obs_lib.ListSink()
+    o = obs_lib.Observability(events=sink)
+    led = ProgramLedger(storm_threshold=2)
+    for shift in range(8):  # 8 levels, one bucket: distinct keys, ONE identity
+        key = (4096, "uint32", 1, "device", shift, 4)
+        with led.dispatch(
+            "ingest.histogram", key, obs=o, storm_key=key[:4] + key[5:]
+        ) as compiled:
+            assert compiled  # each level really compiles...
+    assert not sink.of_kind("ledger.recompile_storm")  # ...but no churn
+    snap = led.snapshot()["sites"]["ingest.histogram"]
+    assert snap["compiles"] == 8 and snap["recompiles"] == 0
+    # genuine churn: distinct BUCKET sizes cross the threshold
+    for n in (8192, 16384, 32768):
+        key = (n, "uint32", 1, "device", 0, 4)
+        with led.dispatch(
+            "ingest.histogram", key, obs=o, storm_key=key[:4] + key[5:]
+        ):
+            pass
+    storms = sink.of_kind("ledger.recompile_storm")
+    assert len(storms) == 2  # identities 3 and 4 (threshold 2)
+    assert [e.compiles for e in storms] == [3, 4]
+
+
+def test_same_key_rebuilds_are_not_shape_churn():
+    # compile_span re-compiling ONE legitimately-invalidated key (a
+    # dataset dropped and re-added) is not churn: the detector counts
+    # DISTINCT keys, as documented
+    sink = obs_lib.ListSink()
+    o = obs_lib.Observability(events=sink)
+    led = ProgramLedger(storm_threshold=2)
+    for _ in range(6):
+        with led.compile_span("serve.programs", ("ds", 4096), obs=o):
+            pass
+    assert not sink.of_kind("ledger.recompile_storm")
+    st = led.snapshot()["sites"]["serve.programs"]
+    assert st["compiles"] == 6 and st["distinct_keys"] == 1
+    assert st["recompiles"] == 0
+
+
+def test_ledger_key_mirrors_are_bounded():
+    # the process ledger lives forever: per-site key mirrors FIFO-evict
+    # past MAX_TRACKED_KEYS while the monotone distinct counters keep
+    # the honest first-seen totals
+    from mpi_k_selection_tpu.obs.ledger import MAX_TRACKED_KEYS
+
+    led = ProgramLedger(storm_threshold=10**9)  # books only, no storms
+    for i in range(MAX_TRACKED_KEYS + 100):
+        with led.dispatch("churn", ("k", i)):
+            pass
+    st = led._sites["churn"]
+    assert len(st["keys"]) == MAX_TRACKED_KEYS
+    assert len(st["storm_keys"]) == MAX_TRACKED_KEYS
+    snap = led.snapshot()["sites"]["churn"]
+    assert snap["distinct_keys"] == MAX_TRACKED_KEYS + 100
+    assert snap["compiles"] == MAX_TRACKED_KEYS + 100
+
+
+def test_bytes_accounting_live_and_peak():
+    led = ProgramLedger()
+    led.adjust_bytes("staging", "cpu:0", 1024)
+    led.adjust_bytes("staging", "cpu:0", 2048)
+    led.adjust_bytes("staging", "cpu:0", -1024)
+    led.set_bytes("staging_pool", None, 512)
+    led.set_bytes("staging_pool", None, 128)
+    snap = led.snapshot()
+    assert snap["device_bytes"]["staging/cpu:0"] == 2048
+    assert snap["device_bytes_peak"]["staging/cpu:0"] == 3072
+    assert snap["device_bytes"]["staging_pool/default"] == 128
+    assert snap["device_bytes_peak"]["staging_pool/default"] == 512
+    assert led.device_bytes("staging") == {("staging", "cpu:0"): 2048}
+
+
+def test_snapshot_delta_is_per_run():
+    led = ProgramLedger()
+    with led.dispatch("s", 1):
+        pass
+    before = led.snapshot()
+    with led.dispatch("s", 2):
+        pass
+    with led.dispatch("s", 2):
+        pass
+    d = snapshot_delta(before, led.snapshot())
+    assert d["sites"]["s"]["compiles"] == 1
+    assert d["sites"]["s"]["hits"] == 1
+    assert d["compiles"] == 1
+    assert d["recompiles"] == 0
+    assert d["compile_seconds"] >= 0.0
+    # unchanged sites are omitted entirely
+    d2 = snapshot_delta(led.snapshot(), led.snapshot())
+    assert d2["sites"] == {} and d2["compiles"] == 0
+
+
+def test_reset_clears_everything():
+    led = ProgramLedger(storm_threshold=1)
+    with led.dispatch("s", 1):
+        pass
+    with led.dispatch("s", 2):
+        pass
+    led.adjust_bytes("staging", None, 64)
+    led.reset()
+    snap = led.snapshot()
+    assert snap["sites"] == {}
+    assert snap["device_bytes"] == {}
+    assert snap["storms"] == []
+
+
+def test_collect_ledger_exports_metric_names():
+    led = ProgramLedger()
+    with led.dispatch("a.site", ("k",)):
+        pass
+    led.note_hit("a.site", ("k",))
+    led.adjust_bytes("staging", "cpu:0", 4096)
+    reg = obs_lib.MetricsRegistry()
+    collect_ledger(reg, ledger=led)
+    collect_ledger(reg, ledger=led)  # idempotent overwrite, never additive
+    snap = reg.as_dict()
+    assert snap['ledger.compiles{site="a.site"}']["value"] == 1
+    assert snap['ledger.cache_hits{site="a.site"}']["value"] == 1
+    assert snap['ledger.recompiles{site="a.site"}']["value"] == 0
+    assert snap['ledger.compile_seconds{site="a.site"}']["value"] >= 0.0
+    assert (
+        snap['ledger.device_bytes{device="cpu:0",pool="staging"}']["value"]
+        == 4096
+    )
+    assert (
+        snap['ledger.device_bytes_peak{device="cpu:0",pool="staging"}'][
+            "value"
+        ]
+        == 4096
+    )
+
+
+# ---------------------------------------------------------------------------
+# the ledger through the real streaming vertical
+
+
+def test_streaming_populates_ledger_and_byte_book(rng, monkeypatch):
+    # A fresh process ledger: the real one is process-lifetime, so this
+    # run's byte peaks may sit below an earlier (bigger) test's high-water
+    # mark and a peak-growth delta would be empty. Call sites resolve
+    # ``_ledger.LEDGER`` at dispatch time, so the swap reroutes them all.
+    from mpi_k_selection_tpu.obs import ledger as ledger_mod
+
+    fresh = ProgramLedger()
+    monkeypatch.setattr(ledger_mod, "LEDGER", fresh)
+    chunks = _chunks(rng)
+    before = fresh.snapshot()
+    o = obs_lib.Observability(metrics=obs_lib.MetricsRegistry())
+    got = streaming_kselect(
+        chunks, sum(c.size for c in chunks) // 2, pipeline_depth=2,
+        spill="force", obs=o, **KW,
+    )
+    d = snapshot_delta(before, fresh.snapshot())
+    # at least one ingest site dispatched; repeat buckets are hits
+    ingest_sites = [s for s in d["sites"] if s.startswith("ingest.")]
+    assert ingest_sites, d["sites"]
+    assert sum(d["sites"][s]["hits"] for s in ingest_sites) > 0
+    # the staged byte book saw the padded buckets... and released them
+    peaks = d["device_bytes_peak"]
+    assert any(k.startswith("staging/") and v > 0 for k, v in peaks.items())
+    live = fresh.device_bytes("staging")
+    assert all(v == 0 for v in live.values()), live
+    # spill generations were accounted and returned to zero at close
+    assert peaks.get("spill/disk", 0) > 0
+    assert all(v == 0 for v in fresh.device_bytes("spill").values())
+    # the descent folded the ledger into the run's registry
+    reg = o.metrics.as_dict()
+    assert any(k.startswith("ledger.compiles{") for k in reg)
+    assert int(np.asarray(got)) == int(
+        np.sort(np.concatenate(chunks), kind="stable")[
+            sum(c.size for c in chunks) // 2 - 1
+        ]
+    )
+
+
+# ---------------------------------------------------------------------------
+# bit-identity: everything on, across the whole grid (ISSUE 14 gate)
+
+
+@pytest.mark.parametrize("fused", ["kernel", "xla", "off"])
+@pytest.mark.parametrize("spill", ["off", "force"])
+@pytest.mark.parametrize("depth", [0, 2])
+@pytest.mark.parametrize("devices", [1, 2])
+def test_grid_bit_identity_with_ledger_flight_and_trace(
+    rng, devices, depth, spill, fused
+):
+    chunks = _chunks(rng)
+    n = sum(c.size for c in chunks)
+    ks = [n // 3, n - 1]
+    want = streaming_kselect_many(chunks, ks, **KW)
+    o = obs_lib.Observability.collecting(flight=True)
+    got = streaming_kselect_many(
+        chunks, ks, devices=devices, pipeline_depth=depth, spill=spill,
+        fused=fused, obs=o, **KW,
+    )
+    assert [int(v) for v in got] == [int(v) for v in want], (
+        f"devices={devices} depth={depth} spill={spill} fused={fused}"
+    )
+    # the flight ring observed the run (events always; spans whenever
+    # the run is pipelined enough to create a timer)
+    assert o.flight.events_tail()
+    obs_lib.check_stream_invariants(o.events.events)
+
+
+def test_grid_bit_identity_float32_leg(rng):
+    chunks = [
+        rng.standard_normal(m).astype(np.float32)
+        for m in (4096, 2777, 2048)
+    ]
+    x = np.concatenate(chunks)
+    k = x.size // 2
+    want = np.sort(x, kind="stable")[k - 1]
+    o = obs_lib.Observability.collecting(flight=True)
+    got = streaming_kselect(
+        chunks, k, spill="force", fused="kernel", obs=o, **KW
+    )
+    assert np.asarray(got).tobytes() == np.asarray(want).tobytes()
+
+
+# ---------------------------------------------------------------------------
+# flight recorder units
+
+
+def test_resolve_flight_forms():
+    assert resolve_flight(None) is None
+    assert resolve_flight(False) is None
+    fr = resolve_flight(True)
+    assert isinstance(fr, FlightRecorder)
+    small = resolve_flight(7)
+    assert small._events.maxlen == 7
+    assert resolve_flight(fr) is fr
+    with pytest.raises(ValueError, match="flight"):
+        resolve_flight("yes")
+
+
+def test_ring_is_bounded_oldest_evicted():
+    fr = FlightRecorder(capacity=4)
+    for i in range(10):
+        fr.record_event(
+            RecompileStormEvent(site="s", key=str(i), compiles=i, threshold=0)
+        )
+        fr.record(f"span.{i}", float(i), float(i) + 0.5)
+    tail = fr.events_tail()
+    assert [e.key for e in tail] == ["6", "7", "8", "9"]
+    assert [s[0] for s in fr.spans_tail()] == [
+        "span.6", "span.7", "span.8", "span.9"
+    ]
+
+
+def test_bundle_sections_always_present():
+    # no obs, no flight: every section still present, degraded to empty
+    b = build_bundle(None)
+    assert set(BUNDLE_SECTIONS) <= set(b)
+    assert b["events"] == [] and b["spans"]["tail"] == []
+    assert b["faults"]["plan"] is None
+    assert "sites" in b["ledger"]
+    # with channels: events + metrics + span tails populated, extra merged
+    o = obs_lib.Observability.collecting(flight=True)
+    o.emit(RecompileStormEvent(site="s", key="k", compiles=9, threshold=8))
+    o.flight.record("phase.x", 0.0, 1.0, {"trace_id": "t1"})
+    b = o.flight.bundle(obs=o, reason="unit", extra={"context": 1})
+    assert b["reason"] == "unit" and b["context"] == 1
+    assert b["events"][0]["event"] == "ledger.recompile_storm"
+    assert b["spans"]["tail"][0]["name"] == "phase.x"
+    assert b["spans"]["tail"][0]["args"] == {"trace_id": "t1"}
+    assert b["spans"]["thread_tracks"] == 1
+    assert isinstance(b["metrics"], dict)
+
+
+def test_dump_writes_valid_json(tmp_path):
+    fr = FlightRecorder()
+    fr.record_event(
+        RecompileStormEvent(site="s", key="k", compiles=1, threshold=0)
+    )
+    path = tmp_path / "bundle.json"
+    got = fr.dump(path, reason="unit")
+    assert got == str(path)
+    bundle = json.loads(path.read_text())
+    assert set(BUNDLE_SECTIONS) <= set(bundle)
+    assert bundle["reason"] == "unit"
+    # the conftest fixture validates this dump again at teardown (it was
+    # registered) — that is part of the assertion
+
+
+def test_auto_dump_at_most_once_per_recorder(tmp_path):
+    fr = FlightRecorder(dump_dir=str(tmp_path))
+    o = obs_lib.Observability(flight=fr)
+    p1 = auto_dump(o, "retry-exhausted", exc=RuntimeError("boom"))
+    p2 = auto_dump(o, "retry-exhausted", exc=RuntimeError("again"))
+    assert p1 is not None and p2 is None
+    assert fr.auto_dumps == [p1]
+    bundle = json.loads(open(p1).read())
+    assert bundle["reason"] == "retry-exhausted"
+    assert bundle["error"] == "RuntimeError: boom"
+
+
+def test_auto_dump_without_flight_is_noop_and_never_raises(tmp_path):
+    assert auto_dump(None, "x") is None
+    assert auto_dump(obs_lib.Observability(), "x") is None
+    # a failing postmortem write must not mask the in-flight error
+    fr = FlightRecorder(dump_dir=str(tmp_path / "missing" / "dir"))
+    o = obs_lib.Observability(flight=fr)
+    assert auto_dump(o, "x") is None
+
+
+def test_span_fanout_feeds_trace_and_flight():
+    from mpi_k_selection_tpu.obs.wiring import attach_timer, span_recorder
+    from mpi_k_selection_tpu.utils.profiling import PhaseTimer
+
+    o = obs_lib.Observability(
+        trace=obs_lib.TraceRecorder(), flight=FlightRecorder()
+    )
+    timer, restore = attach_timer(o, None)
+    with timer.phase("p.one", args={"trace_id": "t"}):
+        pass
+    restore()
+    assert [s.name for s in o.trace.spans] == ["p.one"]
+    assert o.trace.spans[0].args == {"trace_id": "t"}
+    assert [s[0] for s in o.flight.spans_tail()] == ["p.one"]
+    assert o.flight.spans_tail()[0][5] == {"trace_id": "t"}
+    # single-channel forms short-circuit to the bare recorder
+    assert span_recorder(obs_lib.Observability(flight=o.flight)) is o.flight
+    assert span_recorder(obs_lib.Observability()) is None
+    # detach honored: a later phase records nowhere
+    t2 = PhaseTimer()
+    _, restore2 = attach_timer(o, t2)
+    restore2()
+    with t2.phase("p.two"):
+        pass
+    assert [s.name for s in o.trace.spans] == ["p.one"]
+
+
+def test_auto_dump_failed_write_does_not_consume_latch(tmp_path):
+    # ENOSPC-class failures often trigger the dump AND fail the write:
+    # the once-per-recorder latch must survive a failed attempt so the
+    # next terminal failure (after space frees) still gets its bundle
+    fr = FlightRecorder(dump_dir=str(tmp_path / "missing-dir"))
+    o = obs_lib.Observability(flight=fr)
+    assert auto_dump(o, "spill-damage") is None  # write fails, swallowed
+    fr.dump_dir = str(tmp_path)
+    path = auto_dump(o, "spill-damage")
+    assert path is not None
+    assert json.loads(open(path).read())["reason"] == "spill-damage"
+    # and the latch is consumed by the SUCCESSFUL dump
+    assert auto_dump(o, "spill-damage") is None
+    assert fr.auto_dumps == [path]
+
+
+def test_concurrent_release_subtracts_staging_bytes_exactly_once(monkeypatch):
+    # unwind paths (executor abort, pipeline close) race the normal
+    # release on the same chunk: the latch is atomic, so the byte gauge
+    # and the live-staged count each move exactly once
+    import threading
+
+    from mpi_k_selection_tpu.obs import ledger as ledger_mod
+    from mpi_k_selection_tpu.streaming import pipeline as pl
+
+    fresh = ProgramLedger()
+    monkeypatch.setattr(ledger_mod, "LEDGER", fresh)
+    for _ in range(20):  # racing windows are narrow: many rounds
+        staged = pl.stage_keys(np.arange(1000, dtype=np.uint32))
+        assert sum(fresh.device_bytes("staging").values()) > 0
+        barrier = threading.Barrier(8)
+
+        def rel():
+            barrier.wait()
+            staged.release()
+
+        ts = [threading.Thread(target=rel) for _ in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        live = fresh.device_bytes("staging")
+        assert all(v == 0 for v in live.values()), live
+    assert pl.live_staged_keys() == 0
+
+
+# ---------------------------------------------------------------------------
+# the postmortem gate: chaos -> exactly one auto-dumped bundle
+
+
+def test_retry_exhaustion_auto_dumps_one_bundle(rng, tmp_path):
+    chunks = _chunks(rng)
+    # fault on a LATE chunk so both pipeline threads have completed
+    # spans (producer produce/encode/stage, consumer stall) in the ring
+    # by the time the exhaustion freezes it
+    plan = faults.FaultPlan(
+        (faults.FaultSpec("stage", 3, "raise", attempts=tuple(range(99))),)
+    )
+    pol = faults.RetryPolicy(max_attempts=2, sleeper=faults.VirtualSleeper())
+    o = obs_lib.Observability.collecting(
+        flight=FlightRecorder(dump_dir=str(tmp_path))
+    )
+    with faults.inject(plan):
+        with pytest.raises(RetryExhaustedError):
+            streaming_kselect(
+                chunks, len(chunks[0]), pipeline_depth=2, retry=pol,
+                obs=o, **KW,
+            )
+    # exactly ONE bundle auto-dumped, wherever the exhaustion surfaced
+    assert len(o.flight.auto_dumps) == 1
+    bundle = json.loads(open(o.flight.auto_dumps[0]).read())
+    assert set(BUNDLE_SECTIONS) <= set(bundle)
+    assert bundle["reason"] == "retry-exhausted"
+    assert "RetryExhaustedError" in bundle["error"]
+    # the triggering FaultEvents are in the frozen tail (both views)
+    fault_kinds = [e for e in bundle["events"] if e["event"] == "fault"]
+    assert fault_kinds, "expected the injected/retry FaultEvents"
+    assert bundle["faults"]["events"] == fault_kinds
+    # the armed-plan description is best-effort (the dump may race the
+    # context exit on the other thread) but the key is always present
+    assert "plan" in bundle["faults"]
+    # the ledger and metrics snapshots rode along
+    assert bundle["ledger"]["sites"]
+    assert bundle["metrics"]
+    # >= 2 thread tracks: producer AND consumer span'd before the dump
+    assert bundle["spans"]["thread_tracks"] >= 2, bundle["spans"]
+    # the live sink saw the same faults (the ring is a tail, not a tap)
+    assert o.events.of_kind("fault")
+
+
+def test_dispatch_crash_auto_dumps_one_bundle(tmp_path):
+    from mpi_k_selection_tpu.serve import KSelectServer
+    from mpi_k_selection_tpu.serve.errors import DispatchCrashedError
+
+    fr = FlightRecorder(dump_dir=str(tmp_path))
+    with KSelectServer(
+        obs=obs_lib.Observability.collecting(), flight=fr, window=0.0
+    ) as srv:
+        srv.add_dataset("d", np.arange(100, dtype=np.int32))
+        plan = faults.FaultPlan(
+            (faults.FaultSpec("serve.dispatch", 0, "raise"),)
+        )
+        with faults.inject(plan):
+            with pytest.raises(DispatchCrashedError):
+                srv.kselect("d", 5, tier="exact")
+        # restarted in place; later queries answer
+        assert int(srv.kselect("d", 5, tier="exact").value) == 4
+    assert len(fr.auto_dumps) == 1
+    bundle = json.loads(open(fr.auto_dumps[0]).read())
+    assert bundle["reason"] == "dispatch-crashed"
+    # the error field carries the crash CAUSE the supervisor caught
+    assert "injected transient fault at serve.dispatch" in bundle["error"]
+    assert set(BUNDLE_SECTIONS) <= set(bundle)
+
+
+# ---------------------------------------------------------------------------
+# serve: steady state, shape churn, trace ids, debug bundle
+
+
+def _server(**kw):
+    from mpi_k_selection_tpu.serve import KSelectServer
+
+    kw.setdefault("obs", obs_lib.Observability.collecting())
+    kw.setdefault("window", 0.0)
+    return KSelectServer(**kw)
+
+
+def test_server_close_releases_resident_bytes(rng, monkeypatch):
+    # a server torn down WITHOUT per-dataset drop() calls must return
+    # its registry's bytes to the resident book: the process gauge would
+    # otherwise ratchet upward across server lifetimes and the eviction
+    # budgeting it feeds would act on phantom bytes
+    from mpi_k_selection_tpu.obs import ledger as ledger_mod
+    from mpi_k_selection_tpu.serve import KSelectServer, ServerClosedError
+
+    fresh = ProgramLedger()
+    monkeypatch.setattr(ledger_mod, "LEDGER", fresh)
+    x = rng.integers(-(2**31), 2**31 - 1, size=4096, dtype=np.int32)
+    srv = KSelectServer(window=0.0)
+    srv.add_dataset("a", x)
+    assert sum(fresh.device_bytes("resident").values()) > 0
+    srv.close()
+    live = fresh.device_bytes("resident")
+    assert all(v == 0 for v in live.values()), live
+    srv.close()  # idempotent: no double subtraction
+    assert all(v == 0 for v in fresh.device_bytes("resident").values())
+    # post-close registration can't re-enter the book unreleasable
+    with pytest.raises(ServerClosedError):
+        srv.add_dataset("b", x)
+    # a CALLER-owned registry stays the caller's: close leaves its book
+    from mpi_k_selection_tpu.serve.registry import DatasetRegistry
+
+    reg = DatasetRegistry()
+    reg.add_array("c", x)
+    held = sum(fresh.device_bytes("resident").values())
+    assert held > 0
+    srv2 = KSelectServer(registry=reg, window=0.0)
+    srv2.close()
+    assert sum(fresh.device_bytes("resident").values()) == held
+    reg.close()
+    assert all(v == 0 for v in fresh.device_bytes("resident").values())
+    # the close snapshot is final: a registration racing (or following)
+    # close fails instead of adding unreleasable bytes to the book
+    with pytest.raises(ServerClosedError):
+        reg.add_array("d", x)
+    assert all(v == 0 for v in fresh.device_bytes("resident").values())
+
+
+def test_serve_burst_steady_state_zero_recompiles(rng):
+    x = rng.integers(-(2**31), 2**31 - 1, size=40_000, dtype=np.int32)
+    with _server() as srv:
+        srv.add_dataset("d", x)
+        ks = [123, 4567, 39_000]
+        for k in ks:  # warmup: compile every shape the burst uses
+            srv.kselect("d", k, tier="exact")
+        before = LEDGER.snapshot()
+        for _ in range(10):  # the steady-state burst: same shapes only
+            for k in ks:
+                srv.kselect("d", k, tier="exact")
+        d = snapshot_delta(before, LEDGER.snapshot())
+        site = d["sites"].get("serve.programs", {})
+        assert site.get("compiles", 0) == 0, d["sites"]
+        assert site.get("recompiles", 0) == 0
+        assert site.get("hits", 0) > 0
+        assert d["compiles"] == 0, d["sites"]
+        # the program-cache mirror agrees
+        assert srv.registry.programs.hits > 0
+
+
+def test_serve_shape_churn_fires_recompile_storm(rng):
+    # the negative test: every query against a NEVER-REPEATING dataset —
+    # the program cache (keyed per dataset precisely so WIDTH churn
+    # cannot evict, test above) compiles fresh programs for each one,
+    # and past the process threshold the ledger fires the typed storm
+    # event into the server's sink. threshold+1 first-seen keys in THIS
+    # test guarantee at least one firing regardless of what earlier
+    # tests already compiled at the serve.programs site (the count is
+    # process-monotone).
+    with _server() as srv:
+        for i in range(LEDGER.storm_threshold + 1):
+            x = rng.integers(-(2**31), 2**31 - 1, size=4096, dtype=np.int32)
+            srv.add_dataset(f"churn-{i}", x)
+            srv.kselect(f"churn-{i}", 7 + i, tier="exact")
+        storms = srv.obs.events.of_kind("ledger.recompile_storm")
+        assert storms, "dataset churn past the threshold must fire"
+        assert all(e.site == "serve.programs" for e in storms)
+        assert all(e.compiles > e.threshold for e in storms)
+        assert all(isinstance(e, RecompileStormEvent) for e in storms)
+
+
+def test_trace_id_carried_through_events_and_spans(rng):
+    x = rng.integers(-(2**31), 2**31 - 1, size=40_000, dtype=np.int32)
+    with _server() as srv:
+        srv.add_dataset("d", x)
+        ans = srv.kselect("d", 777, tier="exact", trace_id="abc-123")
+        assert ans.exact is True
+        ev = srv.obs.events.of_kind("serve.query")[-1]
+        assert ev.trace_id == "abc-123"
+        batch = srv.obs.events.of_kind("serve.batch")[-1]
+        assert "abc-123" in batch.trace_ids
+        spans = {s.name: s for s in srv.obs.trace.spans}
+        assert spans["serve.request.exact"].args == {"trace_id": "abc-123"}
+        walk = spans["serve.walk"]
+        assert walk.args["dataset"] == "d"
+        assert "abc-123" in walk.args["trace_ids"]
+        # the flight ring is off here; with it on the same spans land
+        # in the ring too (test_span_fanout_feeds_trace_and_flight)
+
+
+def test_trace_id_minted_when_omitted(rng):
+    x = rng.integers(-(2**31), 2**31 - 1, size=40_000, dtype=np.int32)
+    with _server() as srv:
+        srv.add_dataset("d", x)
+        srv.kselect("d", 5, tier="exact")
+        tid = srv.obs.events.of_kind("serve.query")[-1].trace_id
+        assert isinstance(tid, str) and len(tid) == 16
+        int(tid, 16)  # hex
+        # a second query mints a DIFFERENT id
+        srv.kselect("d", 5, tier="exact")
+        assert srv.obs.events.of_kind("serve.query")[-1].trace_id != tid
+
+
+def test_trace_id_sanitized_for_header_echo():
+    # the id is echoed verbatim into response headers: CR/LF and other
+    # controls from an obs-folded inbound header must not survive into
+    # the echo (header-injection primitive), and the length is bounded
+    from mpi_k_selection_tpu.serve.server import KSelectServer
+
+    assert KSelectServer._trace_id("abc\r\n\tevil") == "abcevil"
+    assert KSelectServer._trace_id("ok-123") == "ok-123"
+    minted = KSelectServer._trace_id("\r\n\x00")
+    assert len(minted) == 16
+    int(minted, 16)  # all-control input falls back to a minted id
+    assert len(KSelectServer._trace_id("x" * 500)) == 128
+
+
+def _http(port, method, path, body=None, headers=None):
+    import http.client
+
+    c = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    try:
+        h = {"Content-Type": "application/json"}
+        h.update(headers or {})
+        c.request(
+            method, path, None if body is None else json.dumps(body), h
+        )
+        r = c.getresponse()
+        return r.status, r.read(), dict(r.getheaders())
+    finally:
+        c.close()
+
+
+def test_http_trace_id_honored_and_echoed(rng):
+    from mpi_k_selection_tpu.serve import start_http_server
+
+    x = rng.integers(-(2**31), 2**31 - 1, size=40_000, dtype=np.int32)
+    with _server() as srv:
+        srv.add_dataset("d", x)
+        with start_http_server(srv) as h:
+            # inbound id honored verbatim: response header + body + event
+            status, body, hdrs = _http(
+                h.port, "POST", "/v1/query",
+                {"dataset": "d", "op": "kselect", "k": 9, "tier": "exact"},
+                headers={"X-Ksel-Trace-Id": "client-id-42"},
+            )
+            assert status == 200
+            assert hdrs["X-Ksel-Trace-Id"] == "client-id-42"
+            assert json.loads(body)["trace_id"] == "client-id-42"
+            ev = srv.obs.events.of_kind("serve.query")[-1]
+            assert ev.trace_id == "client-id-42"
+            # no inbound id: one is minted, echoed on header AND body
+            status, body, hdrs = _http(
+                h.port, "POST", "/v1/query",
+                {"dataset": "d", "op": "kselect", "k": 9},
+            )
+            assert status == 200
+            minted = hdrs["X-Ksel-Trace-Id"]
+            assert json.loads(body)["trace_id"] == minted
+            assert minted != "client-id-42" and len(minted) == 16
+            # error bodies carry the id too (the postmortem handle)
+            status, body, hdrs = _http(
+                h.port, "POST", "/v1/query",
+                {"dataset": "ghost", "op": "kselect", "k": 1},
+                headers={"X-Ksel-Trace-Id": "err-7"},
+            )
+            assert status == 404
+            assert hdrs["X-Ksel-Trace-Id"] == "err-7"
+            assert json.loads(body)["trace_id"] == "err-7"
+
+
+def test_server_debug_bundle_and_http_surface(rng):
+    from mpi_k_selection_tpu.serve import start_http_server
+
+    x = rng.integers(-(2**31), 2**31 - 1, size=40_000, dtype=np.int32)
+    with _server(flight=True) as srv:
+        assert isinstance(srv.flight, FlightRecorder)
+        srv.add_dataset("d", x)
+        srv.kselect("d", 10, tier="exact", trace_id="bundle-t")
+        b = srv.debug_bundle()
+        assert set(BUNDLE_SECTIONS) <= set(b)
+        assert any(e["event"] == "serve.query" for e in b["events"])
+        assert b["server"]["datasets"][0]["dataset"] == "d"
+        assert b["server"]["program_cache"]["misses"] >= 1
+        assert b["server"]["closed"] is False
+        # span args survived into the ring tail
+        walk = [s for s in b["spans"]["tail"] if s["name"] == "serve.walk"]
+        assert walk and "bundle-t" in walk[0]["args"]["trace_ids"]
+        with start_http_server(srv) as h:
+            status, body, _ = _http(h.port, "GET", "/debug/bundle")
+            assert status == 200
+            wire = json.loads(body)
+            assert set(BUNDLE_SECTIONS) <= set(wire)
+            assert wire["reason"] == "http"
+    # flightless servers degrade gracefully on the same surfaces
+    with _server(obs=None) as srv2:
+        srv2.add_dataset("d", x)
+        b2 = srv2.debug_bundle()
+        assert set(BUNDLE_SECTIONS) <= set(b2)
+        assert b2["events"] == []
+        with start_http_server(srv2) as h2:
+            status, body, _ = _http(h2.port, "GET", "/debug/bundle")
+            assert status == 200
+            assert set(BUNDLE_SECTIONS) <= set(json.loads(body))
+
+
+def test_server_flight_knob_attaches_to_existing_obs():
+    o = obs_lib.Observability.collecting()
+    assert o.flight is None
+    with _server(obs=o, flight=16) as srv:
+        assert srv.flight is o.flight is not None
+        assert srv.flight._events.maxlen == 16
+
+
+# ---------------------------------------------------------------------------
+# CLI --debug-bundle
+
+
+def test_cli_debug_bundle_written_on_success(tmp_path, capsys):
+    from mpi_k_selection_tpu.cli import main
+
+    path = tmp_path / "bundle.json"
+    rc = main([
+        "--streaming", "--backend", "tpu", "--n", "40000",
+        "--chunk-elems", "8192", "--json", "--debug-bundle", str(path),
+    ])
+    assert rc == 0
+    rec = json.loads(capsys.readouterr().out)
+    assert rec["extra"]["debug_bundle"] == str(path)
+    bundle = json.loads(path.read_text())
+    assert set(BUNDLE_SECTIONS) <= set(bundle)
+    assert bundle["reason"] == "cli"
+    assert any(e["event"] == "stream.pass" for e in bundle["events"])
+    assert bundle["spans"]["thread_tracks"] >= 2  # producer + consumer
+    assert bundle["ledger"]["sites"]
+
+
+def test_cli_trace_events_and_debug_bundle_compose(tmp_path, capsys):
+    # --trace-events must not starve the flight ring of spans: the CLI
+    # timer feeds the trace+flight FAN recorder, so the bundle's spans
+    # section stays populated when both flags are on
+    from mpi_k_selection_tpu.cli import main
+
+    bundle_path = tmp_path / "bundle.json"
+    trace_path = tmp_path / "trace.json"
+    rc = main([
+        "--streaming", "--backend", "tpu", "--n", "40000",
+        "--chunk-elems", "8192", "--json",
+        "--trace-events", str(trace_path), "--debug-bundle", str(bundle_path),
+    ])
+    assert rc == 0
+    capsys.readouterr()
+    bundle = json.loads(bundle_path.read_text())
+    assert bundle["spans"]["tail"], "flight ring must see spans"
+    assert bundle["spans"]["thread_tracks"] >= 2  # producer + consumer
+    # and the trace export still works alongside
+    trace = json.loads(trace_path.read_text())
+    assert trace["traceEvents"]
+
+
+def test_cli_serve_shutdown_bundle_has_server_section(tmp_path):
+    # the shutdown artifact must carry the documented `server` section
+    # (datasets, program-cache counters, restarts) — the same bundle
+    # GET /debug/bundle serves, not a bare FlightRecorder dump
+    import threading
+    import time
+
+    from mpi_k_selection_tpu.cli import main
+
+    port_file = tmp_path / "port"
+    bundle_path = tmp_path / "bundle.json"
+    rc = []
+    t = threading.Thread(
+        target=lambda: rc.append(main([
+            "serve", "--n", "4096", "--dtype", "int32",
+            "--port", "0", "--port-file", str(port_file),
+            "--batch-window", "0", "--quit-after", "1",
+            "--debug-bundle", str(bundle_path),
+        ])),
+        name="cli-serve-bundle",
+    )
+    t.start()
+    for _ in range(400):
+        if port_file.exists() and port_file.read_text():
+            break
+        time.sleep(0.05)
+    else:
+        pytest.fail("serve CLI never wrote its port file")
+    port = int(port_file.read_text())
+    status, _, _ = _http(
+        port, "POST", "/v1/query",
+        {"dataset": "default", "op": "kselect", "k": 1, "tier": "exact"},
+    )
+    assert status == 200
+    t.join(timeout=60)
+    assert not t.is_alive() and rc == [0]
+    bundle = json.loads(bundle_path.read_text())
+    assert set(BUNDLE_SECTIONS) <= set(bundle)
+    assert bundle["reason"] == "serve-shutdown"
+    assert [d["dataset"] for d in bundle["server"]["datasets"]] == ["default"]
+    assert "program_cache" in bundle["server"]
+
+
+def test_cli_serve_parser_accepts_debug_bundle():
+    from mpi_k_selection_tpu.cli import build_serve_parser
+
+    args = build_serve_parser().parse_args(
+        ["--n", "1000", "--debug-bundle", "/tmp/b.json"]
+    )
+    assert args.debug_bundle == "/tmp/b.json"

@@ -271,3 +271,52 @@ def test_enable_windowed_after_creation_raises():
     reg.histogram("serve.latency_seconds", labels={"tier": "exact"})
     with pytest.raises(TypeError, match="before the first observation"):
         reg.enable_windowed("serve.latency_seconds")
+
+
+def test_live_serve_scrape_with_ledger_gauges_conformant():
+    """ISSUE 14 satellite: a LIVE HTTP scrape of a query server — the
+    runtime ledger's compile counters and device-byte gauges riding it —
+    passes the strict grammar parser, and the ledger families are
+    actually present in the scraped text."""
+    import http.client
+
+    from mpi_k_selection_tpu.serve import KSelectServer, start_http_server
+
+    rng = np.random.default_rng(7)
+    x = rng.integers(-(2**31), 2**31 - 1, size=40_000, dtype=np.int32)
+    o = obs_lib.Observability(metrics=obs_lib.MetricsRegistry())
+    with KSelectServer(window=0.0, obs=o) as srv:
+        srv.add_dataset("scrape", x)
+        for k in (5, 5, 1234):  # compile + repeat hits at serve.programs
+            srv.kselect("scrape", k, tier="exact")
+        with start_http_server(srv) as h:
+            c = http.client.HTTPConnection("127.0.0.1", h.port, timeout=30)
+            try:
+                c.request("GET", "/metrics")
+                r = c.getresponse()
+                assert r.status == 200
+                text = r.read().decode()
+            finally:
+                c.close()
+    types, _, samples = parse_exposition(text)
+    names = {n for n, _, _ in samples}
+    assert "ksel_ledger_compiles" in names
+    assert "ksel_ledger_cache_hits" in names
+    assert "ksel_ledger_recompiles" in names
+    assert "ksel_ledger_compile_seconds" in names
+    assert "ksel_ledger_device_bytes" in names
+    assert "ksel_ledger_device_bytes_peak" in names
+    # the site label rides each program-book sample; the resident pool
+    # gauge carries this server's registered bytes
+    sites = {
+        labels.get("site")
+        for n, labels, _ in samples
+        if n == "ksel_ledger_compiles"
+    }
+    assert "serve.programs" in sites
+    resident = [
+        v
+        for n, labels, v in samples
+        if n == "ksel_ledger_device_bytes" and labels.get("pool") == "resident"
+    ]
+    assert resident and max(resident) >= x.nbytes

@@ -623,12 +623,24 @@ def test_http_front_roundtrip(x_int32):
                 assert status == 400, bad
             status, _ = _http(h.port, "GET", "/nope")
             assert status == 404
-            # /metrics: live Prometheus text of the server namespace
-            status, body = _http(h.port, "GET", "/metrics")
-            assert status == 200
-            text = body.decode()
+            # /metrics: live Prometheus text of the server namespace,
+            # shipped under the exposition content type (ISSUE 14)
+            c = http.client.HTTPConnection("127.0.0.1", h.port, timeout=30)
+            try:
+                c.request("GET", "/metrics")
+                r = c.getresponse()
+                assert r.status == 200
+                assert (
+                    r.getheader("Content-Type")
+                    == "text/plain; version=0.0.4; charset=utf-8"
+                )
+                text = r.read().decode()
+            finally:
+                c.close()
             assert "ksel_serve_queries" in text
             assert "ksel_serve_latency_seconds_bucket" in text
+            # the runtime ledger rides every scrape (obs/ledger.py)
+            assert "ksel_ledger_compiles" in text
     # context exits joined the HTTP serve loop, request threads, and the
     # dispatch thread — the conftest fixture verifies nothing leaked
 

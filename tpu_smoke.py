@@ -480,6 +480,11 @@ def main() -> int:
     ob_k = ob_n // 2
     ob_kw = dict(radix_bits=4, collect_budget=512)
     want_ob = int(_obs_ksel(ob_chunks, ob_k, **ob_kw))
+    # ledger reading (ISSUE 14): snapshot the process ProgramLedger
+    # around the instrumented run, so the same silicon run records
+    # per-site compile walls and per-device peak staging bytes next to
+    # the occupancy snapshot below
+    ob_led0 = _obs_lib.LEDGER.snapshot()
     o = _obs_lib.Observability.collecting()
     ob_timer = _PhaseTimer(recorder=o.trace)
     with _ObsSpillStore() as ob_store:
@@ -534,6 +539,17 @@ def main() -> int:
         "trace_threads": len(o.trace.thread_ids()),
     }
     print(f"  obs snapshot: {snapshot}")
+    ob_led = _obs_lib.snapshot_delta(ob_led0, _obs_lib.LEDGER.snapshot())
+    ledger_snapshot = {
+        "compiles": ob_led["compiles"],
+        "recompiles": ob_led["recompiles"],
+        "compile_seconds_by_site": {
+            site: d["compile_seconds"]
+            for site, d in ob_led["sites"].items()
+        },
+        "device_bytes_peak": ob_led["device_bytes_peak"],
+    }
+    print(f"  ledger snapshot: {ledger_snapshot}")
 
     # --- resident-dataset query server (serve/): in-process smoke — a
     # mixed query burst across tiers over two datasets (spread int32 =
