@@ -36,9 +36,11 @@ from mpi_k_selection_tpu.analysis.core import (
 )
 from mpi_k_selection_tpu.analysis import ast_rules as _ast_rules  # registers KSL rules
 from mpi_k_selection_tpu.analysis import concurrency as _concurrency  # KSL015-017
+from mpi_k_selection_tpu.analysis import lifecycle as _lifecycle  # KSL019-021
 from mpi_k_selection_tpu.analysis.concurrency import build_concurrency_report
 from mpi_k_selection_tpu.analysis.core import all_rules
 from mpi_k_selection_tpu.analysis.jaxpr_checks import CONTRACT_CHECKS
+from mpi_k_selection_tpu.analysis.lifecycle import build_lifecycle_report
 from mpi_k_selection_tpu.analysis.lockorder import LockOrderSanitizer
 from mpi_k_selection_tpu.analysis.reporters import render_json, render_text
 
@@ -53,6 +55,7 @@ __all__ = [
     "CONTRACT_CHECKS",
     "LockOrderSanitizer",
     "build_concurrency_report",
+    "build_lifecycle_report",
     "render_json",
     "render_text",
 ]

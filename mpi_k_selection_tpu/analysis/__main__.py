@@ -44,6 +44,15 @@ def build_parser() -> argparse.ArgumentParser:
         ),
     )
     p.add_argument(
+        "--lifecycle-report", default=None, metavar="PATH",
+        help=(
+            "also write the resource-ownership graph (KSL019-021's "
+            "acquire sites, release sites, escape edges, the owner-site "
+            "registry, and the `# ksel: owner[...]` annotation ledger) "
+            "as JSON to PATH"
+        ),
+    )
+    p.add_argument(
         "--verbose", action="store_true",
         help="show suppressed findings in text output too",
     )
@@ -90,6 +99,18 @@ def main(argv=None) -> int:
         with open(args.concurrency_report, "w") as fh:
             json.dump(
                 build_concurrency_report(args.paths, mods=report.modules),
+                fh, indent=2, sort_keys=True,
+            )
+    if args.lifecycle_report:
+        import json
+
+        from mpi_k_selection_tpu.analysis.lifecycle import (
+            build_lifecycle_report,
+        )
+
+        with open(args.lifecycle_report, "w") as fh:
+            json.dump(
+                build_lifecycle_report(args.paths, mods=report.modules),
                 fh, indent=2, sort_keys=True,
             )
     if args.output:

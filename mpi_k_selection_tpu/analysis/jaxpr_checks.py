@@ -1,4 +1,4 @@
-"""jaxpr-level contract checks (KSC101-KSC103).
+"""jaxpr-level contract checks (KSC101-KSC104).
 
 The AST rules see syntax; these see the traced program. Each check
 abstractly traces public kernels from ``ops/`` and ``parallel/`` over a
@@ -28,6 +28,14 @@ asserts a property every review round has had to re-derive by hand:
   Covers the staged-ingest device programs at two adjacent pow2 staging
   buckets (the exact shapes streaming/pipeline.py pads chunks to — and
   the programs every round-robin ingest device compiles per bucket).
+- **KSC104 host-transfer census**: every streaming surface program on
+  the KSC102/KSC103 case grids stays inside the deferred-transfer
+  budget PR 8 promised — ZERO host<->device crossing primitives inside
+  the traced program (callbacks, infeed/outfeed, traced device_put),
+  and a host-materialized output surface that is a small DECLARED leaf
+  budget per program, identical across staging buckets — i.e. one
+  materialization per bucket at pop time, never a per-element or
+  per-survivor-count trickle mid-pass.
 
 Checks report :class:`~mpi_k_selection_tpu.analysis.core.Finding`s
 against the module that owns the kernel; they have no line-level noqa
@@ -80,26 +88,30 @@ def _spec(n, dtype):
     return jax.ShapeDtypeStruct((n,), dtype)
 
 
-def _primitive_trail(jaxpr) -> list[str]:
-    """Flattened primitive-name sequence of a (closed) jaxpr, recursing
-    into call/pjit/cond/scan sub-jaxprs — the shape-free program
-    fingerprint KSC103 compares across batch sizes."""
-    trail: list[str] = []
+def _iter_eqns(jaxpr):
+    """Every equation of a (closed) jaxpr, recursing into call/pjit/
+    cond/scan sub-jaxprs — the shared walk under both the KSC103
+    primitive trail and the KSC104 crossing census."""
 
     def walk(jx):
         for eqn in jx.eqns:
-            trail.append(eqn.primitive.name)
+            yield eqn
             for v in eqn.params.values():
                 vals = v if isinstance(v, (list, tuple)) else [v]
                 for item in vals:
                     inner = getattr(item, "jaxpr", None)
                     if inner is not None:
-                        walk(inner)
+                        yield from walk(inner)
                     elif hasattr(item, "eqns"):
-                        walk(item)
+                        yield from walk(item)
 
-    walk(jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr)
-    return trail
+    yield from walk(jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr)
+
+
+def _primitive_trail(jaxpr) -> list[str]:
+    """Flattened primitive-name sequence of a (closed) jaxpr — the
+    shape-free program fingerprint KSC103 compares across batch sizes."""
+    return [eqn.primitive.name for eqn in _iter_eqns(jaxpr)]
 
 
 # the dtype grid: every key width class the transform table supports
@@ -722,3 +734,180 @@ def check_jaxpr_stability() -> list[Finding]:
                 )
             )
     return findings
+
+
+# ---------------------------------------------------------------------------
+# KSC104 — host-transfer census over the streaming surface programs
+
+
+#: Primitives that cross the host<->device boundary from INSIDE a traced
+#: program. A streaming surface program containing one pays a host sync
+#: per staged bucket per pass — exactly the mid-pass crossing the
+#: deferred executor design (PR 8) eliminated; the callback family also
+#: catches any future "just call back to numpy for this part" shortcut.
+_CROSSING_PRIMITIVES = frozenset(
+    {
+        "device_put",
+        "infeed",
+        "outfeed",
+        "copy_to_host_async",
+    }
+)
+
+
+def _is_crossing_primitive(name: str) -> bool:
+    # jax's callback family has churned names across versions
+    # (pure_callback / io_callback / debug_callback / host_callback's
+    # outside_call) — match the family, not a version's spelling
+    return name in _CROSSING_PRIMITIVES or "callback" in name or name.endswith(
+        "outside_call"
+    )
+
+
+def _transfer_census(jaxpr) -> list:
+    """The mid-pass host<->device crossings in a traced program. A
+    ``device_put`` whose every operand is a compile-time LITERAL is
+    constant placement — baked once per compile, cached by jit, zero
+    per-pop cost (the ``jnp.asarray(scalar)`` idiom) — and does not
+    count; a callback always does."""
+    from jax import core as jax_core
+
+    out = []
+    for eqn in _iter_eqns(jaxpr):
+        name = eqn.primitive.name
+        if not _is_crossing_primitive(name):
+            continue
+        if name == "device_put" and all(
+            isinstance(v, jax_core.Literal) for v in eqn.invars
+        ):
+            continue
+        out.append(name)
+    return out
+
+
+#: The pop-time materialization budget: per streaming surface program
+#: (keyed by its case-grid label), the number of host-materialized
+#: output leaves ONE FIFO pop transfers. The budget is each program's
+#: documented consumer-product count — anything above it means a surface
+#: grew an undeclared host-facing output; a label missing from this
+#: table is itself a finding (a new surface must declare its budget, the
+#: doc-drift posture applied to transfers).
+_POP_MATERIALIZATION_BUDGET = {
+    # one int32 histogram partial per bucket
+    "streaming chunked ingest[uint32, single-prefix]": 1,
+    "streaming chunked ingest[uint32, multi-prefix shared sweep]": 1,
+    # one deepest-level partial per bucket (host fold at pop)
+    "streaming sketch deep fold[uint32, rb=16]": 1,
+    # the eager filter predicate: one bool mask (the deferred="off"
+    # oracle's single device product per bucket)
+    "streaming collect filter[uint32, mask]": 1,
+    # compacted survivors + the int32 count scalar
+    "streaming deferred compaction[uint32, 2 specs]": 2,
+    # hist + 2 x (collect out, count) + (tee out, count)
+    "streaming fused ingest[uint32, 2 prefixes + 2 collect + tee]": 7,
+    # hist + 2 x (collect out, count) + (tee out, count) + (less, leq)
+    # + (deep, kmin, kmax)
+    "streaming sweep ingest[uint32, hist+collect+tee+cert+sketch]": 12,
+}
+
+
+def _census_cases():
+    """Every streaming surface program on the contract grids — the same
+    case lists KSC102/KSC103 trace, so a new ingest program lands on the
+    census the moment it lands on the width/stability grids."""
+    return (
+        _streaming_ingest_cases()
+        + _streaming_collect_mask_cases()
+        + _streaming_compaction_cases()
+        + _streaming_fused_ingest_cases()
+        + _streaming_sweep_ingest_cases()
+    )
+
+
+def _census_findings(cases, budgets) -> list[Finding]:
+    """The census body over explicit cases/budgets (tests plant
+    violating cases through this seam)."""
+    import jax
+
+    findings: list[Finding] = []
+    seen_labels = set()
+    for path, label, fn, dt, sizes in cases:
+        seen_labels.add(label)
+        budget = budgets.get(label)
+        if budget is None:
+            findings.append(
+                Finding(
+                    "KSC104", path, 0,
+                    f"{label}: streaming surface program has no declared "
+                    "pop-time materialization budget — register the label "
+                    "in _POP_MATERIALIZATION_BUDGET with its consumer-"
+                    "product leaf count",
+                )
+            )
+            continue
+        leaf_counts = []
+        for n in sizes:
+            spec = _spec(n, dt)
+            jaxpr = jax.make_jaxpr(fn)(spec)
+            crossings = _transfer_census(jaxpr)
+            if crossings:
+                findings.append(
+                    Finding(
+                        "KSC104", path, 0,
+                        f"{label} n={n}: {len(crossings)} mid-pass "
+                        f"host<->device crossing(s) inside the traced "
+                        f"program ({', '.join(sorted(set(crossings)))}) — "
+                        "the deferred-transfer budget is ZERO crossings "
+                        "mid-pass; materialize at FIFO pop time instead",
+                    )
+                )
+            # the jaxpr in hand already carries the output surface — one
+            # trace serves both the census and the leaf count
+            leaves = jaxpr.out_avals
+            leaf_counts.append(len(leaves))
+            if len(leaves) > budget:
+                findings.append(
+                    Finding(
+                        "KSC104", path, 0,
+                        f"{label} n={n}: {len(leaves)} host-materialized "
+                        f"output leaves exceed the declared pop-time "
+                        f"budget of {budget} — an undeclared host-facing "
+                        "output grew on this surface; declare it (and its "
+                        "pop-time transfer cost) or fuse it",
+                    )
+                )
+        if len(set(leaf_counts)) > 1:
+            findings.append(
+                Finding(
+                    "KSC104", path, 0,
+                    f"{label}: output surface varies across staging "
+                    f"buckets {tuple(sizes)} ({leaf_counts} leaves) — a "
+                    "bucket-size-dependent materialization surface "
+                    "transfers per shape, not once per pop",
+                )
+            )
+    for label in sorted(set(budgets) - seen_labels):
+        findings.append(
+            Finding(
+                "KSC104", "mpi_k_selection_tpu/analysis/jaxpr_checks.py", 0,
+                f"_POP_MATERIALIZATION_BUDGET declares `{label}` but no "
+                "case-grid program carries that label — stale budget row "
+                "(the suppression-staleness posture applied to the "
+                "transfer ledger)",
+            )
+        )
+    return findings
+
+
+@contract(
+    "KSC104",
+    "streaming surface programs stay inside the deferred-transfer budget",
+    "PR 8's deferral contract is one materialization per bucket at pop "
+    "time and zero mid-pass crossings — a callback or traced transfer "
+    "inside an ingest program re-serializes the p-wide in-flight window "
+    "on a per-bucket host sync (the review-r6 class the executor "
+    "retired), and an undeclared host-facing output is a silent "
+    "per-pop bandwidth tax no benchmark is watching",
+)
+def check_host_transfer_census() -> list[Finding]:
+    return _census_findings(_census_cases(), _POP_MATERIALIZATION_BUDGET)

@@ -440,37 +440,42 @@ def _run_streaming(args, obs=None):
         if args.spill == "force" and args.repeats <= 1
         else None
     )
-    # --chaos SEED: arm the seeded fault-injection harness around the
-    # solve (faults/). The solve's source is wrapped so scheduled pulls
-    # fail; --verify/--check below use the UNWRAPPED source, so the
-    # exactness checks judge the RECOVERED answer against clean reads.
-    import contextlib
-
-    injector = None
-    solve_source = source
-    inject_ctx = contextlib.nullcontext()
-    if args.chaos is not None:
-        from mpi_k_selection_tpu.faults import FaultInjector, FaultPlan
-        from mpi_k_selection_tpu.faults import inject as _arm
-
-        nchunks_plan = max(1, -(-n // args.chunk_elems))
-        injector = FaultInjector(
-            FaultPlan.seeded(args.chaos, n_chunks=nchunks_plan), obs=obs
-        )
-        solve_source = injector.wrap_chunk_source(source)
-        inject_ctx = _arm(injector)
-    fn = lambda: kselect_streaming(
-        solve_source, k, hist_method=hist_method, pipeline_depth=depth,
-        timer=ptimer,
-        devices=devices,
-        spill=spill_store if spill_store is not None else args.spill,
-        spill_dir=args.spill_dir,
-        deferred=args.deferred,
-        fused=args.fused,
-        retry=args.retry,
-        obs=obs,
-    )
+    # the try owns the store from the moment it exists: a failure while
+    # ARMING the solve (FaultPlan seeding, a chaos-armed constructor)
+    # used to strand the fresh ksel-spill-* dir — the store was built
+    # before the try whose finally closes it (KSL020's first whole-repo
+    # run caught this; tests/test_lifecycle.py holds the regression)
     try:
+        # --chaos SEED: arm the seeded fault-injection harness around the
+        # solve (faults/). The solve's source is wrapped so scheduled pulls
+        # fail; --verify/--check below use the UNWRAPPED source, so the
+        # exactness checks judge the RECOVERED answer against clean reads.
+        import contextlib
+
+        injector = None
+        solve_source = source
+        inject_ctx = contextlib.nullcontext()
+        if args.chaos is not None:
+            from mpi_k_selection_tpu.faults import FaultInjector, FaultPlan
+            from mpi_k_selection_tpu.faults import inject as _arm
+
+            nchunks_plan = max(1, -(-n // args.chunk_elems))
+            injector = FaultInjector(
+                FaultPlan.seeded(args.chaos, n_chunks=nchunks_plan), obs=obs
+            )
+            solve_source = injector.wrap_chunk_source(source)
+            inject_ctx = _arm(injector)
+        fn = lambda: kselect_streaming(
+            solve_source, k, hist_method=hist_method, pipeline_depth=depth,
+            timer=ptimer,
+            devices=devices,
+            spill=spill_store if spill_store is not None else args.spill,
+            spill_dir=args.spill_dir,
+            deferred=args.deferred,
+            fused=args.fused,
+            retry=args.retry,
+            obs=obs,
+        )
         with inject_ctx:
             seconds, answer = time_fn(fn, repeats=args.repeats, warmup=0)
         record = ResultRecord(

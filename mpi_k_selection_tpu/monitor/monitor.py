@@ -34,12 +34,13 @@ import numpy as np
 
 from mpi_k_selection_tpu.monitor.decay import DecayedWindowedSketch
 from mpi_k_selection_tpu.monitor.windows import WindowedSketch
+from mpi_k_selection_tpu.resource_protocols import MONITOR_THREAD_PREFIX
 
 DEFAULT_QS = (0.5, 0.9, 0.99)
 
-#: Thread-name prefix of the metrics exporter (the ``ksel-`` family the
-#: leaked-thread fixture tracks — every thread is joined at close()).
-MONITOR_THREAD_PREFIX = "ksel-monitor"
+# MONITOR_THREAD_PREFIX (imported above) names the metrics exporter's
+# threads (the ``ksel-`` family the leaked-thread fixture tracks — every
+# thread is joined at close()). Canonical value: resource_protocols.py.
 
 
 def q_label(q: float) -> str:

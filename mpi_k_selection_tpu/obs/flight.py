@@ -47,8 +47,10 @@ import os
 import tempfile
 import threading
 
-#: Auto-dump file prefix (conftest leak discipline, like ksel-spill-*).
-FLIGHT_FILE_PREFIX = "ksel-flight-"
+from mpi_k_selection_tpu.resource_protocols import FLIGHT_FILE_PREFIX
+
+# FLIGHT_FILE_PREFIX (imported above): auto-dump file prefix (conftest
+# leak discipline, like ksel-spill-*). Canonical: resource_protocols.py.
 
 #: Default ring capacities (events / spans kept). Sized for "the last
 #: few seconds of a busy run": a streamed pass emits O(chunks) events,

@@ -1,0 +1,134 @@
+"""Canonical resource-protocol registry — the ONE importable source of
+truth for every leak-tracked resource family in this package.
+
+Three enforcement layers key on the names below, and before this module
+each kept its own copy — a new resource kind could be tracked at runtime
+yet invisible statically (or vice versa) with no test noticing:
+
+1. **runtime** — the tests/conftest.py leak fixtures match worker
+   threads, spill temp dirs and flight-recorder files by these prefixes
+   after every test;
+2. **static** — the resource-lifecycle dataflow pass
+   (analysis/lifecycle.py, rules KSL019-KSL021) proves every acquire
+   reaches its release on every CFG path, with the SAME owner/prefix
+   vocabulary;
+3. **the owning modules** — streaming/pipeline.py, serve/batcher.py,
+   monitor/monitor.py, streaming/spill.py and obs/flight.py re-export
+   their prefix constants FROM here (their public names are unchanged),
+   so a subsystem cannot drift its naming away from the fixtures.
+
+Stdlib-only on purpose: the static pass must import this registry in
+environments without jax (``kselect-lint --no-contracts``), and the
+conftest reads it before the first jax import.
+"""
+
+from __future__ import annotations
+
+#: Every package-owned leakable artifact carries this prefix; the
+#: conftest straggler sweep matches the family, not an allowlist.
+KSEL_PREFIX = "ksel-"
+
+# -- worker-thread name prefixes (the KSL021 / conftest thread family) ------
+
+#: streaming/pipeline.py ChunkPipeline producer threads.
+PIPELINE_THREAD_PREFIX = "ksel-pipeline"
+#: serve/ threads: the batcher's supervised dispatch thread, the HTTP
+#: accept loop and per-request handlers.
+SERVE_THREAD_PREFIX = "ksel-serve"
+#: monitor/ metrics-server threads (accept loop + per-request handlers).
+MONITOR_THREAD_PREFIX = "ksel-monitor"
+
+THREAD_PREFIXES = (
+    PIPELINE_THREAD_PREFIX,
+    SERVE_THREAD_PREFIX,
+    MONITOR_THREAD_PREFIX,
+)
+
+# -- on-disk artifact prefixes ----------------------------------------------
+
+#: streaming/spill.py internally-created store directories.
+SPILL_DIR_PREFIX = "ksel-spill-"
+#: obs/flight.py debug-bundle temp files.
+FLIGHT_FILE_PREFIX = "ksel-flight-"
+
+#: The full leak-tracked prefix family (threads + disk artifacts).
+RESOURCE_PREFIXES = THREAD_PREFIXES + (SPILL_DIR_PREFIX, FLIGHT_FILE_PREFIX)
+
+# ---------------------------------------------------------------------------
+# static lifecycle protocols (analysis/lifecycle.py)
+#
+# Each protocol names, for one resource family: the calls that ACQUIRE a
+# tracked resource, the calls that RELEASE it, the calls/attributes that
+# constitute a sanctioned OWNERSHIP TRANSFER (after which the owner's own
+# lifecycle discipline — itself conftest-enforced — is responsible), and
+# the class names the engine uses for isinstance() path narrowing.
+
+# -- staged key buffers (KSL019): streaming/pipeline.py ---------------------
+
+#: Calls whose result is a live StagedKeys ring slot.
+STAGED_ACQUIRE_CALLS = frozenset({"stage_keys", "stage_device_keys"})
+#: ``staged.release()`` — the ring-slot donation (idempotent).
+STAGED_RELEASE_METHODS = frozenset({"release"})
+#: ``release_staged(x)`` — the idempotent unwind helper (executor.py).
+STAGED_RELEASE_FUNCS = frozenset({"release_staged"})
+#: Method names whose call takes ownership of a staged buffer passed to
+#: them: the executor/window FIFO (``push``) releases at bundle-finish
+#: time; the pipeline queue (``put``/``_put``) hands the slot to the
+#: consumer (ChunkPipeline.close() drains and releases unconsumed ones).
+STAGED_OWNER_CALLS = frozenset({"push", "put", "_put"})
+STAGED_TYPES = frozenset({"StagedKeys"})
+
+# -- spill stores / writers / temp dirs (KSL020): streaming/spill.py --------
+
+#: Constructors of caller-cleaned disk resources: a store (close()
+#: removes its ksel-spill-* dir), a raw temp dir, or tempfile.mkdtemp.
+SPILL_ACQUIRE_CALLS = frozenset(
+    {"SpillStore", "SpillWriter", "TemporaryDirectory", "mkdtemp",
+     # a store's generation writer: commit() hands its records to the
+     # store, abort() drops them — one of the two must run on every path
+     "new_generation"}
+)
+#: The cleanup surface: ``store.close()`` / ``writer.abort()`` /
+#: ``writer.commit()`` (commit IS the writer's release — ownership of
+#: the records passes to the store) / ``TemporaryDirectory.cleanup()`` /
+#: ``store.drop_generation(...)``.
+SPILL_RELEASE_METHODS = frozenset({"close", "abort", "commit", "cleanup"})
+SPILL_RELEASE_FUNCS = frozenset()
+SPILL_OWNER_CALLS = frozenset()
+#: ``self.root = tempfile.mkdtemp(...)`` — the store owns its directory.
+SPILL_OWNER_ATTRS = frozenset({"root"})
+SPILL_TYPES = frozenset({"SpillStore", "SpillWriter", "TemporaryDirectory"})
+
+# -- package worker threads (KSL021) ----------------------------------------
+
+#: Only ``ksel-``-named threads are tracked (the conftest family); an
+#: unstarted Thread object holds no OS resources, so the lifecycle
+#: obligation arms at ``.start()``.
+THREAD_ACQUIRE_CALLS = frozenset({"Thread"})
+THREAD_RELEASE_METHODS = frozenset({"join"})
+THREAD_RELEASE_FUNCS = frozenset()
+THREAD_OWNER_CALLS = frozenset()
+#: The conftest-recognized supervisor slots: attributes whose owners
+#: join their threads on every close path (ChunkPipeline._thread,
+#: QueryBatcher._thread, the HTTP servers' _serve_thread and tracked
+#: _req_threads list in serve/http.py and monitor/monitor.py).
+THREAD_OWNER_ATTRS = frozenset({"_thread", "_serve_thread", "_req_threads"})
+THREAD_TYPES = frozenset({"Thread"})
+
+# ---------------------------------------------------------------------------
+# `# ksel: owner[<site>]` annotation vocabulary
+#
+# A declared ownership transfer must name one of these sites; naming
+# anything else — or annotating a line where no tracked resource moves —
+# is itself a finding (the guarded-by staleness contract applied to
+# ownership). Keep descriptions current: the lifecycle report exports
+# this table verbatim.
+
+OWNER_SITES = {
+    "InflightWindow": "the executor FIFO window releases at bundle finish",
+    "StreamExecutor": "the stream executor owns staged-buffer lifetime",
+    "ChunkPipeline": "the pipeline queue: close() drains and releases",
+    "SpillStore": "the store owns committed generations (drop/close)",
+    "supervisor": "a conftest-recognized thread supervisor joins it",
+    "caller": "ownership returns to the caller (documented contract)",
+}

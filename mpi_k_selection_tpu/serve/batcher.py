@@ -64,6 +64,7 @@ import queue
 import threading
 
 from mpi_k_selection_tpu.faults.inject import maybe_fault as _maybe_fault
+from mpi_k_selection_tpu.resource_protocols import SERVE_THREAD_PREFIX
 from mpi_k_selection_tpu.serve.errors import (
     DeadlineExceededError,
     DispatchCrashedError,
@@ -71,9 +72,10 @@ from mpi_k_selection_tpu.serve.errors import (
     ServerOverloadedError,
 )
 
-#: Every serving-layer thread (dispatch, HTTP serve loop, HTTP request
-#: handlers) carries this prefix; tests assert none outlives its server.
-SERVE_THREAD_PREFIX = "ksel-serve"
+# SERVE_THREAD_PREFIX (imported above) names every serving-layer thread
+# (dispatch, HTTP serve loop, HTTP request handlers); tests assert none
+# outlives its server. Canonical value: resource_protocols.py (the one
+# registry the conftest leak fixtures and the KSL021 pass both import).
 
 #: Coalescing-window ceiling (seconds) — a minute-long window is a
 #: misconfiguration, not a batching strategy.

@@ -973,11 +973,16 @@ def streaming_kselect_many(
                         )
                     hist0 = hist_c.hists[None]
                 except BaseException:
-                    if ex is not None:
-                        ex.abort()
-                    _ex.release_staged(keys)  # the chunk in hand (idempotent)
-                    if writer is not None:
-                        writer.abort()
+                    # the writer's abort rides a finally: an executor
+                    # abort (or the staged-chunk release) raising must
+                    # not strand the generation's ksel-spill records
+                    try:
+                        if ex is not None:
+                            ex.abort()
+                        _ex.release_staged(keys)  # chunk in hand (idempotent)
+                    finally:
+                        if writer is not None:
+                            writer.abort()
                     raise
                 gen = writer.commit() if writer is not None else None
                 return hist0, gen, chunk_i0
@@ -1103,42 +1108,45 @@ def streaming_kselect_many(
                     or (src_override is not None and one_shot)
                     else "source"
                 )
-                # ONE executor bundle per chunk: the spill tee (first, so
-                # its eager form writes before the histogram handle can
-                # finish) and the histogram dispatch share the FIFO
-                # window, and the staged buffer is released when the LAST
-                # of the two results materializes — not before. Under
-                # ``fused`` the tee + histogram collapse further into ONE
-                # device program per staged bucket (the single-read
-                # ingest, ops/pallas/fused_ingest.py) — the unfused
-                # bundle stays the bit-for-bit oracle (fused="off")
-                hist_c = _ex.HistogramConsumer(
-                    shift, radix_bits, prefixes, method, kdt, obs=obs
-                )
-                tee_c = (
-                    _ex.SpillTeeConsumer(
-                        writer, filter_specs, dtype, kdt, total_bits,
-                        devs, deferred=defer, obs=obs,
-                    )
-                    if writer is not None
-                    else None
-                )
-                if tee_c is not None and fuse:
-                    consumers = [
-                        _ex.FusedIngestConsumer(
-                            hist=hist_c, tee=tee_c, kdt=kdt,
-                            total_bits=total_bits, tier=fuse, obs=obs,
-                        )
-                    ]
-                elif tee_c is not None:
-                    consumers = [tee_c, hist_c]
-                else:
-                    consumers = [hist_c]
-                ex = _ex.StreamExecutor(
-                    consumers, window=window, occupancy=occupancy
-                )
-                keys = None
+                ex = keys = None
                 try:
+                    # ONE executor bundle per chunk: the spill tee (first,
+                    # so its eager form writes before the histogram handle
+                    # can finish) and the histogram dispatch share the
+                    # FIFO window, and the staged buffer is released when
+                    # the LAST of the two results materializes — not
+                    # before. Under ``fused`` the tee + histogram collapse
+                    # further into ONE device program per staged bucket
+                    # (the single-read ingest, ops/pallas/fused_ingest.py)
+                    # — the unfused bundle stays the bit-for-bit oracle
+                    # (fused="off"). Built INSIDE the try: a consumer/
+                    # executor constructor raising must still abort the
+                    # generation, or its records strand on disk (KSL020)
+                    hist_c = _ex.HistogramConsumer(
+                        shift, radix_bits, prefixes, method, kdt, obs=obs
+                    )
+                    tee_c = (
+                        _ex.SpillTeeConsumer(
+                            writer, filter_specs, dtype, kdt, total_bits,
+                            devs, deferred=defer, obs=obs,
+                        )
+                        if writer is not None
+                        else None
+                    )
+                    if tee_c is not None and fuse:
+                        consumers = [
+                            _ex.FusedIngestConsumer(
+                                hist=hist_c, tee=tee_c, kdt=kdt,
+                                total_bits=total_bits, tier=fuse, obs=obs,
+                            )
+                        ]
+                    elif tee_c is not None:
+                        consumers = [tee_c, hist_c]
+                    else:
+                        consumers = [hist_c]
+                    ex = _ex.StreamExecutor(
+                        consumers, window=window, occupancy=occupancy
+                    )
                     with _pl._phase(timer, "descent.pass"), _key_chunk_stream(
                         src_override if src_override is not None else _gen_src(),
                         dtype, hist_method=method, **stream_kw
@@ -1152,31 +1160,41 @@ def streaming_kselect_many(
                             pass_keys += int(keys.size)
                             ex.push(keys)
                         ex.drain()
+                    hists = hist_c.hists
+                    for p in prefixes:
+                        # replay-stability check, mirroring
+                        # _collect_survivors': this pass's population under
+                        # each surviving prefix must equal the bucket count
+                        # the PREVIOUS pass (or the seeding sketch)
+                        # established — a drifting source fails loudly here
+                        # instead of walking a corrupt histogram to a wrong
+                        # answer. On the spill path the read is a
+                        # checksummed generation, so this is unreachable
+                        # short of a store bug; it stays as the belt to the
+                        # spill records' braces (and holds the recovery
+                        # ladder's REBUILT reads to the same books). Inside
+                        # the try: this raise used to strand the writer's
+                        # uncommitted generation (KSL020's first run)
+                        if int(hists[p].sum()) != expected[p]:
+                            raise RuntimeError(
+                                f"chunk source is not replay-stable: prefix "
+                                f"{p:#x} holds {int(hists[p].sum())} elements "
+                                f"this pass, previous pass counted "
+                                f"{expected[p]}. The source callable must "
+                                "yield identical data on every invocation."
+                            )
                 except BaseException:
-                    ex.abort()
-                    _ex.release_staged(keys)  # the chunk in hand (idempotent)
-                    if writer is not None:
-                        writer.abort()
+                    # writer.abort() rides a finally: the executor abort
+                    # (or staged-chunk release) raising must not strand
+                    # the generation's ksel-spill records
+                    try:
+                        if ex is not None:
+                            ex.abort()
+                        _ex.release_staged(keys)  # chunk in hand (idempotent)
+                    finally:
+                        if writer is not None:
+                            writer.abort()
                     raise
-                hists = hist_c.hists
-                for p in prefixes:
-                    # replay-stability check, mirroring _collect_survivors':
-                    # this pass's population under each surviving prefix must
-                    # equal the bucket count the PREVIOUS pass (or the seeding
-                    # sketch) established — a drifting source fails loudly here
-                    # instead of walking a corrupt histogram to a wrong answer.
-                    # On the spill path the read is a checksummed generation,
-                    # so this is unreachable short of a store bug; it stays as
-                    # the belt to the spill records' braces (and holds the
-                    # recovery ladder's REBUILT reads to the same books).
-                    if int(hists[p].sum()) != expected[p]:
-                        raise RuntimeError(
-                            f"chunk source is not replay-stable: prefix {p:#x} "
-                            f"holds {int(hists[p].sum())} elements this pass, "
-                            f"previous pass counted {expected[p]}. The source "
-                            "callable must yield identical data on every "
-                            "invocation."
-                        )
                 gen = writer.commit() if writer is not None else None
                 return hists, gen, chunk_i, pass_keys, read_from
 

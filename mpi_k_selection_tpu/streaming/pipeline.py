@@ -81,6 +81,7 @@ import numpy as np
 from mpi_k_selection_tpu.faults import policy as _fpol
 from mpi_k_selection_tpu.faults.inject import maybe_fault as _maybe_fault
 from mpi_k_selection_tpu.obs import ledger as _ledger
+from mpi_k_selection_tpu.resource_protocols import PIPELINE_THREAD_PREFIX
 
 #: Classic double buffering: chunk i+1 staged while chunk i computes.
 DEFAULT_PIPELINE_DEPTH = 2
@@ -89,7 +90,9 @@ DEFAULT_PIPELINE_DEPTH = 2
 MAX_PIPELINE_DEPTH = 64
 
 #: Worker threads carry this prefix; tests assert none outlive their pass.
-THREAD_NAME_PREFIX = "ksel-pipeline"
+#: Canonical value lives in resource_protocols.py (the one registry the
+#: conftest leak fixtures and the KSL021 lifecycle pass both import).
+THREAD_NAME_PREFIX = PIPELINE_THREAD_PREFIX
 
 #: Phases the producer thread accounts against the shared PhaseTimer
 #: (``pipeline.spill`` is the pass-0 tee writing encoded keys to the
@@ -688,6 +691,7 @@ class ChunkPipeline:
         method = None
         slot = 0  # round-robin staging cursor over the resolved devices
         staged_i = 0  # stable per-chunk fault key (retries share it)
+        keys = None  # the chunk in hand; None once the consumer owns it
         try:
             it = iter(self._src())
             while not self._stop.is_set():
@@ -799,8 +803,17 @@ class ChunkPipeline:
                     if isinstance(keys, StagedKeys):
                         keys.release()
                     return
+                keys = None  # the consumer owns it now (close() drains)
             self._put(_DONE)
         except BaseException as e:  # re-raised by the consumer
+            # the chunk in hand never reached the queue: release its ring
+            # slot before reporting (idempotent — the spill tee's unwind
+            # may have released it already). close() drains only what was
+            # ENQUEUED, so this handler is the one place that can see it;
+            # before this release, any raise between staging and the put
+            # leaked the slot (KSL019's first whole-repo run caught it)
+            if isinstance(keys, StagedKeys):
+                keys.release()
             self._put(_Raised(e))
 
     # -- consumer side -----------------------------------------------------

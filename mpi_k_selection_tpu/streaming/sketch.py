@@ -273,14 +273,17 @@ class RadixSketch:
             )
         src = as_chunk_source(source, one_shot_ok=spill is not None)
         writer = spill.new_generation() if spill is not None else None
-        consumer = _SketchFoldConsumer(self, obs=obs, fused=fuse)
-        ex = _exec.StreamExecutor(
-            [consumer], window=len(devs),
-            occupancy=_wr.window_occupancy(obs, phase="sketch"),
-        )
         chunk_i = keys_read = 0
-        keys = None
+        ex = keys = None
         try:
+            # consumer/executor built INSIDE the try: a constructor
+            # raising must still abort the generation, or its records
+            # strand on disk (KSL020)
+            consumer = _SketchFoldConsumer(self, obs=obs, fused=fuse)
+            ex = _exec.StreamExecutor(
+                [consumer], window=len(devs),
+                occupancy=_wr.window_occupancy(obs, phase="sketch"),
+            )
             with _pl._phase(timer, "sketch.pass"), _key_chunk_stream(
                 src, self.dtype, pipeline_depth=pipeline_depth, timer=timer,
                 # "scatter" handles the deepest level's 2**resolution_bits
@@ -300,18 +303,27 @@ class RadixSketch:
                     keys_read += int(keys.size)
                     ex.push(keys)
                 ex.drain()
-        except BaseException:
-            ex.abort()
-            _exec.release_staged(keys)  # the chunk in hand (idempotent)
+            # commit INSIDE the try: anything raising between the drain
+            # and the commit (the recorder detach below included) must
+            # abort the generation, not strand it uncommitted
             if writer is not None:
-                writer.abort()
+                writer.commit()
+        except BaseException:
+            # writer.abort() rides a finally: an executor abort (or the
+            # staged-chunk release) raising must not strand the
+            # generation's ksel-spill records
+            try:
+                if ex is not None:
+                    ex.abort()
+                _exec.release_staged(keys)  # the chunk in hand (idempotent)
+            finally:
+                if writer is not None:
+                    writer.abort()
             raise
         finally:
             # detach a recorder this call attached to a caller-owned timer
             # (no phase records outside the stream context above)
             _restore_recorder()
-        if writer is not None:
-            writer.commit()
         if obs is not None:
             obs.emit(
                 _ev.SketchPassEvent(

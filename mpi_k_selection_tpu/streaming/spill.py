@@ -68,11 +68,13 @@ import numpy as np
 from mpi_k_selection_tpu.errors import SpillError, SpillRecordError
 from mpi_k_selection_tpu.faults.inject import maybe_fault as _maybe_fault
 from mpi_k_selection_tpu.obs import ledger as _ledger
+from mpi_k_selection_tpu.resource_protocols import SPILL_DIR_PREFIX
 from mpi_k_selection_tpu.streaming.pipeline import _bucket_elems
 
-#: Temp-directory prefix for internally-created stores; tests assert none
-#: outlive their call (the spill twin of pipeline.THREAD_NAME_PREFIX).
-SPILL_DIR_PREFIX = "ksel-spill-"
+# SPILL_DIR_PREFIX (imported above): temp-directory prefix for
+# internally-created stores; tests assert none outlive their call (the
+# spill twin of pipeline.THREAD_NAME_PREFIX). Canonical value:
+# resource_protocols.py (conftest + KSL020 registry).
 
 #: The ``spill=`` knob's string modes (a SpillStore instance is also legal).
 SPILL_MODES = ("auto", "off", "force")

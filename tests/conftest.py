@@ -38,22 +38,32 @@ def _no_leaked_pipeline_threads():
     All such threads carry the ``ksel-`` name prefix (``ksel-pipeline-*``
     producers, ``ksel-serve-*``: the batcher's SUPERVISED dispatch
     thread — restarts reuse the same thread, so its name survives a
-    crash-recover cycle — the HTTP serve loop, per-request handlers, and
-    any future faults/-layer worker), so the fixture matches the prefix
-    family rather than an allowlist a new subsystem could silently fall
-    out of. A thread surviving a test is a shutdown bug in
-    streaming/pipeline.py, serve/, or faults/, not test noise."""
+    crash-recover cycle — the HTTP serve loop, per-request handlers,
+    ``ksel-monitor-*`` exporters, and any future faults/-layer worker),
+    so the fixture matches the prefix family rather than an allowlist a
+    new subsystem could silently fall out of. A thread surviving a test
+    is a shutdown bug in streaming/pipeline.py, serve/, monitor/ or
+    faults/, not test noise. The prefix vocabulary is the SAME registry
+    the static lifecycle pass (KSL021) enforces against —
+    mpi_k_selection_tpu/resource_protocols.py — so a resource kind
+    cannot be tracked at runtime yet invisible statically."""
     yield
-    # the canonical prefixes both start with "ksel-"; assert that stays
-    # true so a renamed subsystem cannot dodge the generic match
+    from mpi_k_selection_tpu import resource_protocols as _rp
+    # the owning modules re-export the registry's prefixes; assert the
+    # canonical family stays ksel- so a renamed subsystem cannot dodge
+    # the generic match, and that the live constants ARE the registry's
+    from mpi_k_selection_tpu.monitor.monitor import MONITOR_THREAD_PREFIX
     from mpi_k_selection_tpu.serve.batcher import SERVE_THREAD_PREFIX
     from mpi_k_selection_tpu.streaming.pipeline import THREAD_NAME_PREFIX
 
-    assert THREAD_NAME_PREFIX.startswith("ksel-")
-    assert SERVE_THREAD_PREFIX.startswith("ksel-")
+    assert set(_rp.THREAD_PREFIXES) == {
+        THREAD_NAME_PREFIX, SERVE_THREAD_PREFIX, MONITOR_THREAD_PREFIX
+    }
+    for prefix in _rp.RESOURCE_PREFIXES:
+        assert prefix.startswith(_rp.KSEL_PREFIX)
     stragglers = [
         t for t in threading.enumerate()
-        if t.name.startswith("ksel-")
+        if t.name.startswith(_rp.KSEL_PREFIX)
     ]
     for t in stragglers:  # grace for a close() racing the fixture
         t.join(timeout=5.0)
@@ -132,7 +142,7 @@ def _no_leaked_spill_dirs():
     import glob
     import tempfile
 
-    from mpi_k_selection_tpu.streaming.spill import SPILL_DIR_PREFIX
+    from mpi_k_selection_tpu.resource_protocols import SPILL_DIR_PREFIX
 
     pattern = os.path.join(tempfile.gettempdir(), SPILL_DIR_PREFIX + "*")
     before = set(glob.glob(pattern))

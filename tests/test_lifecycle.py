@@ -1,0 +1,915 @@
+"""Resource-lifecycle dataflow pass (KSL019-KSL021) + the KSC104
+host-transfer census.
+
+Five layers of coverage:
+
+- **rule fixtures** — positive/negative/escape/owner-annotation/
+  stale-annotation/noqa sources per rule (staged buffers KSL019, spill
+  stores/writers/temp dirs KSL020, ksel- worker threads KSL021);
+- **CFG-engine units** — try/finally, the except-release-reraise unwind
+  (with isinstance narrowing), loop-carried acquires, conditional
+  releases, del/rebind overwrites, the retry_call immediate wrapper and
+  the one-hop interprocedural acquire;
+- **planted pre-fix leak shapes** — the exact code shapes the first
+  whole-repo run found live (the producer's chunk-in-hand on the raise
+  edge, the CLI's store-built-before-its-try) each demonstrably caught,
+  next to their fixed forms proving clean;
+- **runtime regressions** — the fixed paths exercised for real: a hard
+  pass-0 tee fault leaves no staged buffer behind, a mid-stream source
+  raise aborts the sketch tee's generation (no stranded records);
+- **the gate** — zero KSL019-021 findings repo-wide, the ownership
+  graph exported to kselect_lifecycle.json (package-relative,
+  cwd-independent), the conftest leak-fixture vocabulary proven to BE
+  the static pass's registry (resource_protocols.py), and the KSC104
+  census clean over every streaming surface program.
+"""
+
+import glob
+import json
+import os
+import pathlib
+import tempfile
+import textwrap
+
+import numpy as np
+import pytest
+
+from mpi_k_selection_tpu import resource_protocols as rp
+from mpi_k_selection_tpu.analysis import run_analysis
+from mpi_k_selection_tpu.analysis.__main__ import main as lint_main
+from mpi_k_selection_tpu.analysis.lifecycle import build_lifecycle_report
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+PKG = "mpi_k_selection_tpu"
+
+
+def _lint_source(tmp_path, source, name="mod.py", **kwargs):
+    f = tmp_path / name
+    f.parent.mkdir(parents=True, exist_ok=True)
+    f.write_text(textwrap.dedent(source))
+    kwargs.setdefault("contracts", False)
+    return run_analysis([f], **kwargs)
+
+
+def _rules_hit(report):
+    return {f.rule for f in report.unsuppressed}
+
+
+def _hits(report, rule):
+    return [f for f in report.unsuppressed if f.rule == rule]
+
+
+# ---------------------------------------------------------------------------
+# KSL019 — staged key buffers
+
+
+KSL019_POSITIVE = """
+    def ingest(chunk, bucket, dtype, device):
+        keys = stage_keys(chunk, bucket, dtype, device)
+        if chunk.size:
+            histogram(keys)
+            keys.release()
+        # the empty-chunk branch falls through with the slot live
+"""
+
+KSL019_NEGATIVE = """
+    def ingest(chunk, bucket, dtype, device):
+        keys = stage_keys(chunk, bucket, dtype, device)
+        try:
+            histogram(keys)
+        finally:
+            keys.release()
+"""
+
+KSL019_ESCAPES = """
+    def produce(chunk, window, q, bucket, dtype, device):
+        a = stage_keys(chunk, bucket, dtype, device)
+        window.push(a)      # executor FIFO: releases at bundle finish
+        b = stage_device_keys(chunk, bucket, dtype, device)
+        q.put(b)            # pipeline queue: close() drains and releases
+        c = stage_keys(chunk, bucket, dtype, device)
+        return c            # the caller owns it
+"""
+
+KSL019_OWNER_ANNOTATION = """
+    def produce(chunk, sink, bucket, dtype, device):
+        keys = stage_keys(chunk, bucket, dtype, device)
+        sink.offer(keys)  # ksel: owner[StreamExecutor]
+"""
+
+KSL019_STALE_NO_RESOURCE = """
+    def produce(sink):
+        sink.offer(1)  # ksel: owner[StreamExecutor]
+"""
+
+KSL019_UNKNOWN_SITE = """
+    def produce(chunk, sink, bucket, dtype, device):
+        keys = stage_keys(chunk, bucket, dtype, device)
+        sink.offer(keys)  # ksel: owner[NotARegisteredOwner]
+"""
+
+
+def test_ksl019_positive(tmp_path):
+    report = _lint_source(
+        tmp_path, KSL019_POSITIVE, name=f"{PKG}/streaming/mod.py"
+    )
+    hits = _hits(report, "KSL019")
+    assert len(hits) == 1
+    assert "staged key buffer" in hits[0].message
+    assert "fall-through" in hits[0].message
+
+
+def test_ksl019_negative(tmp_path):
+    report = _lint_source(
+        tmp_path, KSL019_NEGATIVE, name=f"{PKG}/streaming/mod.py"
+    )
+    assert "KSL019" not in _rules_hit(report)
+
+
+def test_ksl019_sanctioned_escapes(tmp_path):
+    report = _lint_source(
+        tmp_path, KSL019_ESCAPES, name=f"{PKG}/streaming/mod.py"
+    )
+    assert "KSL019" not in _rules_hit(report)
+
+
+def test_ksl019_owner_annotation(tmp_path):
+    report = _lint_source(
+        tmp_path, KSL019_OWNER_ANNOTATION, name=f"{PKG}/streaming/mod.py"
+    )
+    assert "KSL019" not in _rules_hit(report)
+
+
+def test_ksl019_stale_annotation_no_resource(tmp_path):
+    report = _lint_source(
+        tmp_path, KSL019_STALE_NO_RESOURCE, name=f"{PKG}/streaming/mod.py"
+    )
+    hits = _hits(report, "KSL019")
+    assert len(hits) == 1
+    assert "stale" in hits[0].message
+    assert "no tracked resource moves" in hits[0].message
+
+
+def test_ksl019_unknown_owner_site(tmp_path):
+    report = _lint_source(
+        tmp_path, KSL019_UNKNOWN_SITE, name=f"{PKG}/streaming/mod.py"
+    )
+    hits = _hits(report, "KSL019")
+    assert len(hits) == 1
+    assert "unregistered owner" in hits[0].message
+    assert "NotARegisteredOwner" in hits[0].message
+
+
+def test_ksl019_unknown_owner_site_on_attribute_transfer(tmp_path):
+    # the attribute-assignment transfer path validates the site too
+    # (review regression: it used to accept any name silently)
+    src = """
+    class Holder:
+        def take(self, chunk, bucket, dtype, device):
+            keys = stage_keys(chunk, bucket, dtype, device)
+            self._w = keys  # ksel: owner[BogusSite]
+    """
+    report = _lint_source(tmp_path, src, name=f"{PKG}/streaming/mod.py")
+    hits = _hits(report, "KSL019")
+    assert len(hits) == 1
+    assert "unregistered owner" in hits[0].message
+    assert "BogusSite" in hits[0].message
+
+
+def test_ksl019_scope_and_noqa(tmp_path):
+    # outside the package: quiet
+    report = _lint_source(tmp_path, KSL019_POSITIVE, name="scripts/mod.py")
+    assert "KSL019" not in _rules_hit(report)
+    # test files poke lifecycles freely
+    report = _lint_source(
+        tmp_path, KSL019_POSITIVE, name=f"{PKG}/streaming/test_mod.py"
+    )
+    assert "KSL019" not in _rules_hit(report)
+    # suppression lands on the ACQUIRE line (where the leak is reported)
+    src = KSL019_POSITIVE.replace(
+        "keys = stage_keys(chunk, bucket, dtype, device)",
+        "keys = stage_keys(chunk, bucket, dtype, device)"
+        "  # ksel: noqa[KSL019] -- fixture justification",
+    )
+    report = _lint_source(tmp_path, src, name=f"{PKG}/streaming/mod.py")
+    assert "KSL019" not in _rules_hit(report)
+    sup = [f for f in report.findings if f.rule == "KSL019" and f.suppressed]
+    assert sup and sup[0].justification == "fixture justification"
+
+
+# ---------------------------------------------------------------------------
+# KSL020 — spill stores / writers / temp dirs
+
+
+KSL020_POSITIVE = """
+    def build(chunks):
+        store = SpillStore()
+        for c in chunks:
+            store.append(c)   # a raise here strands the ksel-spill dir
+        store.close()
+"""
+
+KSL020_NEGATIVE = """
+    def build(chunks):
+        store = SpillStore()
+        try:
+            for c in chunks:
+                store.append(c)
+        finally:
+            store.close()
+"""
+
+KSL020_WITH_BLOCK = """
+    def build(chunks):
+        with SpillStore() as store:
+            for c in chunks:
+                store.append(c)
+"""
+
+KSL020_WRITER_POSITIVE = """
+    def tee(store, chunks):
+        w = store.new_generation()
+        for c in chunks:
+            w.append(c)       # a raise strands the uncommitted records
+        return w.commit()
+"""
+
+KSL020_WRITER_NEGATIVE = """
+    def tee(store, chunks):
+        w = store.new_generation()
+        try:
+            for c in chunks:
+                w.append(c)
+        except BaseException:
+            w.abort()
+            raise
+        return w.commit()
+"""
+
+KSL020_OWNER_ATTR = """
+    import tempfile
+
+    class Store:
+        def __init__(self):
+            self.root = tempfile.mkdtemp(prefix="ksel-spill-")
+"""
+
+KSL020_UNSANCTIONED_ATTR = """
+    import tempfile
+
+    class Store:
+        def __init__(self):
+            self.workdir = tempfile.mkdtemp(prefix="ksel-spill-")
+"""
+
+
+def test_ksl020_positive(tmp_path):
+    report = _lint_source(
+        tmp_path, KSL020_POSITIVE, name=f"{PKG}/streaming/mod.py"
+    )
+    hits = _hits(report, "KSL020")
+    assert len(hits) == 1
+    assert "spill store/writer/temp dir" in hits[0].message
+    assert "exception" in hits[0].message
+
+
+def test_ksl020_negative(tmp_path):
+    report = _lint_source(
+        tmp_path, KSL020_NEGATIVE, name=f"{PKG}/streaming/mod.py"
+    )
+    assert "KSL020" not in _rules_hit(report)
+
+
+def test_ksl020_with_block_is_sanctioned(tmp_path):
+    report = _lint_source(
+        tmp_path, KSL020_WITH_BLOCK, name=f"{PKG}/streaming/mod.py"
+    )
+    assert "KSL020" not in _rules_hit(report)
+
+
+def test_engine_with_constructor_raise_edge_keeps_other_resources(tmp_path):
+    # a with-acquired constructor raising still carries OTHER live
+    # resources out on the exception edge (review regression: the
+    # managed acquire used to suppress the whole raise edge)
+    src = """
+    def f(c, x, bucket, dtype, device):
+        keys = stage_keys(c, bucket, dtype, device)
+        with SpillStore(x) as s:
+            fill(s, keys)
+        keys.release()
+    """
+    report = _lint_source(tmp_path, src, name=f"{PKG}/streaming/mod.py")
+    hits = _hits(report, "KSL019")
+    assert len(hits) == 1 and "exception" in hits[0].message
+    assert "KSL020" not in _rules_hit(report)  # the with stays sanctioned
+
+
+def test_ksl020_writer_raise_edge(tmp_path):
+    report = _lint_source(
+        tmp_path, KSL020_WRITER_POSITIVE, name=f"{PKG}/streaming/mod.py"
+    )
+    hits = _hits(report, "KSL020")
+    assert len(hits) == 1
+    report = _lint_source(
+        tmp_path, KSL020_WRITER_NEGATIVE, name=f"{PKG}/streaming/mod.py"
+    )
+    assert "KSL020" not in _rules_hit(report)
+
+
+def test_ksl020_owner_attr(tmp_path):
+    # `self.root = mkdtemp(...)`: the store owns its directory
+    report = _lint_source(
+        tmp_path, KSL020_OWNER_ATTR, name=f"{PKG}/streaming/mod.py"
+    )
+    assert "KSL020" not in _rules_hit(report)
+    report = _lint_source(
+        tmp_path, KSL020_UNSANCTIONED_ATTR, name=f"{PKG}/streaming/mod.py"
+    )
+    hits = _hits(report, "KSL020")
+    assert len(hits) == 1
+    assert "not a sanctioned owner slot" in hits[0].message
+
+
+# ---------------------------------------------------------------------------
+# KSL021 — ksel- worker threads
+
+
+KSL021_POSITIVE = """
+    import threading
+
+    def serve(handler):
+        t = threading.Thread(target=handler, name="ksel-serve-dispatch")
+        t.start()
+        handler.wait()
+        # never joined, never registered with a supervisor
+"""
+
+KSL021_NEGATIVE = """
+    import threading
+
+    def serve(handler):
+        t = threading.Thread(target=handler, name="ksel-serve-req")
+        t.start()
+        try:
+            handler.wait()
+        finally:
+            t.join()
+"""
+
+KSL021_SUPERVISOR = """
+    import threading
+
+    class Pipeline:
+        def start(self, target):
+            t = threading.Thread(target=target, name="ksel-pipeline-0")
+            t.start()
+            self._thread = t        # the tracked supervisor slot
+
+    class Server:
+        def handle(self, target):
+            t = threading.Thread(target=target, name="ksel-serve-req")
+            t.start()
+            self._req_threads.append(t)   # the tracked thread list
+"""
+
+KSL021_UNSTARTED = """
+    import threading
+
+    def build(target, maybe):
+        t = threading.Thread(target=target, name="ksel-pipeline-0")
+        maybe(t)
+        # unstarted: no OS thread exists, nothing to leak
+"""
+
+KSL021_NOT_KSEL = """
+    import threading
+
+    def helper(target):
+        t = threading.Thread(target=target)
+        t.start()
+"""
+
+KSL021_UNSANCTIONED_ATTR = """
+    import threading
+
+    class Pipeline:
+        def start(self, target):
+            t = threading.Thread(target=target, name="ksel-pipeline-0")
+            t.start()
+            self.worker = t   # not a registered supervisor slot
+"""
+
+
+def test_ksl021_positive(tmp_path):
+    report = _lint_source(
+        tmp_path, KSL021_POSITIVE, name=f"{PKG}/serve/mod.py"
+    )
+    hits = _hits(report, "KSL021")
+    assert len(hits) == 1
+    assert "ksel- worker thread" in hits[0].message
+
+
+def test_ksl021_negative(tmp_path):
+    report = _lint_source(
+        tmp_path, KSL021_NEGATIVE, name=f"{PKG}/serve/mod.py"
+    )
+    assert "KSL021" not in _rules_hit(report)
+
+
+def test_ksl021_supervisor_slots(tmp_path):
+    report = _lint_source(
+        tmp_path, KSL021_SUPERVISOR, name=f"{PKG}/serve/mod.py"
+    )
+    assert "KSL021" not in _rules_hit(report)
+
+
+def test_ksl021_obligation_arms_at_start(tmp_path):
+    # an unstarted Thread object holds no OS resources
+    report = _lint_source(
+        tmp_path, KSL021_UNSTARTED, name=f"{PKG}/streaming/mod.py"
+    )
+    assert "KSL021" not in _rules_hit(report)
+
+
+def test_ksl021_only_ksel_named_threads_tracked(tmp_path):
+    report = _lint_source(
+        tmp_path, KSL021_NOT_KSEL, name=f"{PKG}/streaming/mod.py"
+    )
+    assert "KSL021" not in _rules_hit(report)
+
+
+def test_ksl021_unsanctioned_attr(tmp_path):
+    report = _lint_source(
+        tmp_path, KSL021_UNSANCTIONED_ATTR, name=f"{PKG}/streaming/mod.py"
+    )
+    hits = _hits(report, "KSL021")
+    assert len(hits) == 1
+    assert "not a sanctioned owner slot" in hits[0].message
+
+
+# ---------------------------------------------------------------------------
+# CFG-engine units
+
+
+def test_engine_conditional_release(tmp_path):
+    src = """
+    def f(c, bucket, dtype, device, ok):
+        keys = stage_keys(c, bucket, dtype, device)
+        if ok:
+            keys.release()
+    """
+    report = _lint_source(tmp_path, src, name=f"{PKG}/streaming/mod.py")
+    hits = _hits(report, "KSL019")
+    assert len(hits) == 1 and "fall-through" in hits[0].message
+
+
+def test_engine_loop_carried_acquire(tmp_path):
+    src = """
+    def f(chunks, bucket, dtype, device):
+        for c in chunks:
+            keys = stage_keys(c, bucket, dtype, device)
+            consume(keys)
+        keys.release()   # only the LAST iteration's slot
+    """
+    report = _lint_source(tmp_path, src, name=f"{PKG}/streaming/mod.py")
+    hits = _hits(report, "KSL019")
+    assert hits and any("rebound" in h.message for h in hits)
+    # releasing inside the body proves clean
+    src_ok = """
+    def f(chunks, bucket, dtype, device):
+        for c in chunks:
+            keys = stage_keys(c, bucket, dtype, device)
+            try:
+                consume(keys)
+            finally:
+                keys.release()
+    """
+    report = _lint_source(tmp_path, src_ok, name=f"{PKG}/streaming/mod.py")
+    assert "KSL019" not in _rules_hit(report)
+
+
+def test_engine_narrow_unwind_idiom(tmp_path):
+    # the pipeline.py producer shape AFTER the fix: isinstance-narrowed
+    # release in the broad handler proves clean on the re-raise path
+    src = """
+    def producer(src, q, bucket, dtype, device):
+        keys = None
+        try:
+            for c in src:
+                keys = stage_keys(c, bucket, dtype, device)
+                tee(keys)
+                q.put(keys)
+                keys = None
+        except BaseException as e:
+            if isinstance(keys, StagedKeys):
+                keys.release()
+            q.put(e)
+    """
+    report = _lint_source(tmp_path, src, name=f"{PKG}/streaming/mod.py")
+    assert "KSL019" not in _rules_hit(report)
+
+
+def test_engine_del_while_live(tmp_path):
+    src = """
+    def f(c, bucket, dtype, device):
+        keys = stage_keys(c, bucket, dtype, device)
+        del keys
+    """
+    report = _lint_source(tmp_path, src, name=f"{PKG}/streaming/mod.py")
+    hits = _hits(report, "KSL019")
+    assert hits and "del" in hits[0].message
+
+
+def test_engine_retry_call_wrapper(tmp_path):
+    # the staging-retry idiom: the acquire is recognized THROUGH the
+    # immediately-invoked retry_call lambda
+    src = """
+    def produce(c, bucket, dtype, device, policy):
+        keys = retry_call(lambda: stage_keys(c, bucket, dtype, device), policy)
+        consume(keys)
+    """
+    report = _lint_source(tmp_path, src, name=f"{PKG}/streaming/mod.py")
+    assert _hits(report, "KSL019")
+    src_ok = """
+    def produce(c, bucket, dtype, device, policy):
+        keys = retry_call(lambda: stage_keys(c, bucket, dtype, device), policy)
+        try:
+            consume(keys)
+        finally:
+            keys.release()
+    """
+    report = _lint_source(tmp_path, src_ok, name=f"{PKG}/streaming/mod.py")
+    assert "KSL019" not in _rules_hit(report)
+
+
+def test_engine_interprocedural_one_hop(tmp_path):
+    # a module-local function that returns a live resource is an
+    # acquire site for its callers
+    src = """
+    def make(chunk, bucket, dtype, device):
+        keys = stage_keys(chunk, bucket, dtype, device)
+        return keys
+
+    def use(chunk, bucket, dtype, device):
+        k = make(chunk, bucket, dtype, device)
+        consume(k)
+    """
+    report = _lint_source(tmp_path, src, name=f"{PKG}/streaming/mod.py")
+    hits = _hits(report, "KSL019")
+    assert len(hits) == 1 and "`use`" in hits[0].message
+    src_ok = src.replace(
+        "        consume(k)",
+        "        try:\n"
+        "            consume(k)\n"
+        "        finally:\n"
+        "            k.release()",
+    )
+    report = _lint_source(tmp_path, src_ok, name=f"{PKG}/streaming/mod.py")
+    assert "KSL019" not in _rules_hit(report)
+
+
+def test_engine_typed_handler_propagates(tmp_path):
+    # a TYPED handler may not match: the raise edge still carries the
+    # live resource past it — only release-then-reraise (or a finally)
+    # proves the exception path
+    src = """
+    def build(chunks):
+        store = SpillStore()
+        try:
+            fill(store, chunks)
+        except ValueError:
+            store.close()
+            raise
+        store.close()
+    """
+    report = _lint_source(tmp_path, src, name=f"{PKG}/streaming/mod.py")
+    hits = _hits(report, "KSL020")
+    assert len(hits) == 1 and "exception" in hits[0].message
+
+
+def test_engine_return_inside_try_finally(tmp_path):
+    src = """
+    def build(chunks):
+        store = SpillStore()
+        try:
+            if not chunks:
+                return None
+            return fill(store, chunks)
+        finally:
+            store.close()
+    """
+    report = _lint_source(tmp_path, src, name=f"{PKG}/streaming/mod.py")
+    assert "KSL020" not in _rules_hit(report)
+
+
+# ---------------------------------------------------------------------------
+# the planted pre-fix leak shapes (each rule demonstrably catches the
+# class it was built for)
+
+
+def test_planted_prefix_producer_shape_caught(tmp_path):
+    # the pipeline.py producer BEFORE the fix: a raise between staging
+    # and the queue put (the spill tee) dropped the chunk in hand — the
+    # broad handler reported the error but never released the slot
+    src = """
+    def producer(src, q, bucket, dtype, device):
+        try:
+            for c in src:
+                keys = stage_keys(c, bucket, dtype, device)
+                tee(keys)
+                q.put(keys)
+        except BaseException as e:
+            q.put(e)
+    """
+    report = _lint_source(tmp_path, src, name=f"{PKG}/streaming/mod.py")
+    assert _hits(report, "KSL019")
+
+
+def test_planted_prefix_cli_store_shape_caught(tmp_path):
+    # cli.py BEFORE the fix: the --spill=force store was built before
+    # the try whose finally closes it, so a failure while ARMING the
+    # solve (chaos plan seeding) stranded the fresh ksel-spill-* dir
+    src = """
+    def run(args):
+        store = SpillStore()
+        injector = arm(args)
+        try:
+            solve(store, injector)
+        finally:
+            store.close()
+    """
+    report = _lint_source(tmp_path, src, name=f"{PKG}/streaming/mod.py")
+    hits = _hits(report, "KSL020")
+    assert len(hits) == 1 and "exception" in hits[0].message
+    # the fixed shape — the try owns the store from the moment it exists
+    src_ok = """
+    def run(args):
+        store = SpillStore()
+        try:
+            injector = arm(args)
+            solve(store, injector)
+        finally:
+            store.close()
+    """
+    report = _lint_source(tmp_path, src_ok, name=f"{PKG}/streaming/mod.py")
+    assert "KSL020" not in _rules_hit(report)
+
+
+def test_planted_thread_leak_caught(tmp_path):
+    # a started ksel- thread with NO close path at all — the structural
+    # leak class KSL021 exists for
+    src = """
+    import threading
+
+    def spawn(work):
+        t = threading.Thread(target=work, name="ksel-pipeline-extra")
+        t.start()
+        return None
+    """
+    report = _lint_source(tmp_path, src, name=f"{PKG}/streaming/mod.py")
+    assert _hits(report, "KSL021")
+
+
+# ---------------------------------------------------------------------------
+# runtime regressions for the first whole-repo run's fixed leak paths
+
+
+def _spill_dirs():
+    return set(
+        glob.glob(os.path.join(tempfile.gettempdir(), rp.SPILL_DIR_PREFIX + "*"))
+    )
+
+
+def test_runtime_sketch_abort_on_source_raise():
+    # sketch.py fix: a mid-stream source raise aborts the tee writer —
+    # no committed generation, no stranded gen dir (pre-fix, commit ran
+    # outside the try and an abort-path raise could strand records)
+    from mpi_k_selection_tpu.streaming import RadixSketch, SpillStore
+
+    before = _spill_dirs()
+
+    def chunks():
+        yield np.arange(64, dtype=np.int32)
+        raise RuntimeError("stream died mid-pass")
+
+    store = SpillStore()
+    try:
+        with pytest.raises(RuntimeError, match="stream died"):
+            RadixSketch(np.int32).update_stream(
+                chunks(), spill=store, pipeline_depth=0
+            )
+        assert store.generations == {}
+        assert not glob.glob(os.path.join(store.root, "gen-*"))
+    finally:
+        store.close()
+    assert _spill_dirs() == before
+
+
+def test_runtime_producer_releases_chunk_on_hard_tee_fault():
+    # pipeline.py fix: a hard pass-0 spill-tee fault raises on the
+    # PRODUCER thread between staging and the queue put — the handler
+    # now releases the chunk in hand (pre-fix: a leaked staged buffer)
+    from mpi_k_selection_tpu import faults
+    from mpi_k_selection_tpu.streaming import streaming_kselect
+    from mpi_k_selection_tpu.streaming.pipeline import live_staged_keys
+
+    before = _spill_dirs()
+    data = np.arange(512, dtype=np.int32)
+    chunks = [data[:256], data[256:]]
+    plan = faults.FaultPlan(
+        (faults.FaultSpec("spill.write", 0, "raise",
+                          attempts=tuple(range(99))),)
+    )
+    with faults.inject(faults.FaultInjector(plan)):
+        with pytest.raises(Exception):
+            streaming_kselect(
+                lambda: iter(chunks), 17, spill="force",
+                pipeline_depth=2, retry="off",
+            )
+    assert live_staged_keys() == 0
+    assert _spill_dirs() == before
+
+
+# ---------------------------------------------------------------------------
+# the whole-repo gate + the exported ownership graph
+
+
+def test_lifecycle_rules_clean_repo_wide():
+    report = run_analysis(
+        [REPO / PKG], root=REPO, contracts=False,
+        select=["KSL019", "KSL020", "KSL021"],
+    )
+    assert report.unsuppressed == [], [
+        f.render() for f in report.unsuppressed
+    ]
+
+
+def test_lifecycle_gate_whole_repo(tmp_path):
+    report = build_lifecycle_report([REPO / PKG], root=REPO)
+    art = json.dumps(report, indent=2, sort_keys=True)
+    (tmp_path / "kselect_lifecycle.json").write_text(art)
+    try:  # best-effort /tmp mirror (shared-host permission hazard)
+        pathlib.Path("/tmp/kselect_lifecycle.json").write_text(art)
+    except OSError:
+        pass
+    res = report["resources"]
+    # the graph is populated: every protocol family is visible
+    kinds = {a["kind"] for m in res.values() for a in m["acquires"]}
+    assert kinds >= {"staged", "spill", "thread"}
+    assert f"{PKG}/streaming/pipeline.py" in res
+    assert f"{PKG}/streaming/spill.py" in res
+    # releases and ownership-transfer edges are recorded, not just
+    # acquires (an all-acquire graph would mean the pass is blind to
+    # the package's actual release discipline)
+    assert any(m["releases"] for m in res.values())
+    assert any(m["escapes"] for m in res.values())
+    # paths are package-relative (cwd-independent joins)
+    assert all(p.startswith(PKG + "/") for p in res)
+    # every shipped `# ksel: owner[...]` annotation is LIVE (the
+    # staleness audit holds the tree at zero dead entries)
+    for mod, anns in report["annotations"].items():
+        for a in anns:
+            assert a["used"], (mod, a)
+    # the exported vocabulary IS the registry
+    assert report["prefixes"]["threads"] == list(rp.THREAD_PREFIXES)
+    assert report["prefixes"]["spill_dirs"] == rp.SPILL_DIR_PREFIX
+    assert report["owners"]["sites"] == dict(rp.OWNER_SITES)
+
+
+def test_lifecycle_report_cli_cwd_independent(tmp_path, monkeypatch):
+    out = tmp_path / "lc.json"
+    monkeypatch.chdir(tmp_path)
+    rc = lint_main(
+        [
+            str(REPO / PKG / "streaming" / "pipeline.py"),
+            "--no-contracts",
+            "--lifecycle-report", str(out),
+        ]
+    )
+    assert rc == 0
+    data = json.loads(out.read_text())
+    assert f"{PKG}/streaming/pipeline.py" in data["resources"]
+    assert data["owners"]["sites"] == dict(rp.OWNER_SITES)
+
+
+def test_leak_fixture_vocabulary_is_the_registry():
+    # satellite: ONE importable source of truth — the owning modules'
+    # public prefix constants ARE the registry objects the conftest
+    # fixtures and the static pass both read
+    from mpi_k_selection_tpu.monitor.monitor import MONITOR_THREAD_PREFIX
+    from mpi_k_selection_tpu.obs.flight import FLIGHT_FILE_PREFIX
+    from mpi_k_selection_tpu.serve.batcher import SERVE_THREAD_PREFIX
+    from mpi_k_selection_tpu.streaming.pipeline import THREAD_NAME_PREFIX
+    from mpi_k_selection_tpu.streaming.spill import SPILL_DIR_PREFIX
+
+    assert THREAD_NAME_PREFIX is rp.PIPELINE_THREAD_PREFIX
+    assert SERVE_THREAD_PREFIX is rp.SERVE_THREAD_PREFIX
+    assert MONITOR_THREAD_PREFIX is rp.MONITOR_THREAD_PREFIX
+    assert SPILL_DIR_PREFIX is rp.SPILL_DIR_PREFIX
+    assert FLIGHT_FILE_PREFIX is rp.FLIGHT_FILE_PREFIX
+    assert set(rp.THREAD_PREFIXES) == {
+        THREAD_NAME_PREFIX, SERVE_THREAD_PREFIX, MONITOR_THREAD_PREFIX
+    }
+    for prefix in rp.RESOURCE_PREFIXES:
+        assert prefix.startswith(rp.KSEL_PREFIX)
+    # the KSL021 supervisor vocabulary is non-empty and registry-owned
+    assert rp.THREAD_OWNER_ATTRS
+    assert rp.OWNER_SITES
+
+
+# ---------------------------------------------------------------------------
+# KSC104 — the host-transfer census
+
+
+def test_ksc104_registered():
+    from mpi_k_selection_tpu.analysis.jaxpr_checks import CONTRACT_CHECKS
+
+    assert "KSC104" in {c.id for c in CONTRACT_CHECKS}
+
+
+def test_ksc104_census_clean_over_all_surfaces():
+    from mpi_k_selection_tpu.analysis.jaxpr_checks import CONTRACT_CHECKS
+
+    check = next(c for c in CONTRACT_CHECKS if c.id == "KSC104")
+    findings = check.run()
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_ksc104_budget_table_is_exhaustive():
+    # every case-grid label has a declared budget and vice versa — the
+    # doc-drift posture applied to the transfer ledger
+    from mpi_k_selection_tpu.analysis.jaxpr_checks import (
+        _POP_MATERIALIZATION_BUDGET,
+        _census_cases,
+    )
+
+    labels = {label for _, label, _, _, _ in _census_cases()}
+    assert labels == set(_POP_MATERIALIZATION_BUDGET)
+
+
+def test_ksc104_detects_planted_crossing():
+    import jax
+
+    from mpi_k_selection_tpu.analysis.jaxpr_checks import (
+        _census_findings,
+        _spec,
+        _transfer_census,
+    )
+
+    def bad(x):
+        return jax.pure_callback(
+            lambda v: np.asarray(v).sum(),
+            jax.ShapeDtypeStruct((), x.dtype),
+            x,
+        )
+
+    assert _transfer_census(jax.make_jaxpr(bad)(_spec(8, "float32")))
+    case = [("pkg/mod.py", "planted[crossing]", bad, "float32", (8, 16))]
+    findings = _census_findings(case, {"planted[crossing]": 1})
+    assert findings and all(
+        "mid-pass host<->device crossing" in f.message for f in findings
+    )
+
+
+def test_ksc104_constant_placement_not_a_crossing():
+    # jnp.asarray of a closed-over numpy scalar inserts a literal
+    # device_put: constant placement, baked once per compile — NOT a
+    # mid-pass crossing (the sweep kernel's certificate-key idiom)
+    import jax
+    import jax.numpy as jnp
+
+    from mpi_k_selection_tpu.analysis.jaxpr_checks import (
+        _spec,
+        _transfer_census,
+    )
+
+    probe = np.asarray(5, np.uint32)
+
+    def f(x):
+        return x + jnp.asarray(probe)
+
+    assert _transfer_census(jax.make_jaxpr(f)(_spec(8, "uint32"))) == []
+
+
+def test_ksc104_budget_violations():
+    import jax.numpy as jnp
+
+    from mpi_k_selection_tpu.analysis.jaxpr_checks import _census_findings
+
+    def two_leaves(x):
+        return x, jnp.sum(x)
+
+    # over budget: an undeclared host-facing output
+    case = [("pkg/mod.py", "planted[wide]", two_leaves, "float32", (8, 16))]
+    findings = _census_findings(case, {"planted[wide]": 1})
+    assert findings and all(
+        "exceed the declared pop-time budget" in f.message for f in findings
+    )
+    # missing budget row: the surface must declare itself
+    findings = _census_findings(case, {})
+    assert len(findings) == 1
+    assert "no declared pop-time materialization budget" in findings[0].message
+    # stale budget row: a label no grid carries
+    findings = _census_findings([], {"planted[gone]": 1})
+    assert len(findings) == 1
+    assert "stale budget row" in findings[0].message
