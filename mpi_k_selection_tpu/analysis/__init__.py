@@ -37,10 +37,13 @@ from mpi_k_selection_tpu.analysis.core import (
 from mpi_k_selection_tpu.analysis import ast_rules as _ast_rules  # registers KSL rules
 from mpi_k_selection_tpu.analysis import concurrency as _concurrency  # KSL015-017
 from mpi_k_selection_tpu.analysis import lifecycle as _lifecycle  # KSL019-021
+from mpi_k_selection_tpu.analysis import placement as _placement  # KSL022-024
 from mpi_k_selection_tpu.analysis.concurrency import build_concurrency_report
 from mpi_k_selection_tpu.analysis.core import all_rules
 from mpi_k_selection_tpu.analysis.jaxpr_checks import CONTRACT_CHECKS
 from mpi_k_selection_tpu.analysis.lifecycle import build_lifecycle_report
+from mpi_k_selection_tpu.analysis.modcache import shared_modules
+from mpi_k_selection_tpu.analysis.placement import build_placement_report
 from mpi_k_selection_tpu.analysis.lockorder import LockOrderSanitizer
 from mpi_k_selection_tpu.analysis.reporters import render_json, render_text
 
@@ -56,6 +59,8 @@ __all__ = [
     "LockOrderSanitizer",
     "build_concurrency_report",
     "build_lifecycle_report",
+    "build_placement_report",
+    "shared_modules",
     "render_json",
     "render_text",
 ]

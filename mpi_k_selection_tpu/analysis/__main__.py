@@ -53,6 +53,23 @@ def build_parser() -> argparse.ArgumentParser:
         ),
     )
     p.add_argument(
+        "--placement", action="store_true",
+        help=(
+            "run only the device-placement pass (KSL022-024 dataflow "
+            "rules plus the KSC105 static<->runtime census contract "
+            "unless --no-contracts)"
+        ),
+    )
+    p.add_argument(
+        "--placement-report", default=None, metavar="PATH",
+        help=(
+            "also write the placement census (the abstract lattice, "
+            "per-module dispatch and crossing sites, the sanctioned-"
+            "transfer registry, and the `# ksel: placed-on[...]` "
+            "annotation ledger) as JSON to PATH"
+        ),
+    )
+    p.add_argument(
         "--verbose", action="store_true",
         help="show suppressed findings in text output too",
     )
@@ -79,6 +96,8 @@ def main(argv=None) -> int:
 
     select = args.select.split(",") if args.select else None
     ignore = args.ignore.split(",") if args.ignore else None
+    if args.placement:
+        select = (select or []) + ["KSL022", "KSL023", "KSL024", "KSC105"]
     try:
         report = run_analysis(
             args.paths,
@@ -111,6 +130,18 @@ def main(argv=None) -> int:
         with open(args.lifecycle_report, "w") as fh:
             json.dump(
                 build_lifecycle_report(args.paths, mods=report.modules),
+                fh, indent=2, sort_keys=True,
+            )
+    if args.placement_report:
+        import json
+
+        from mpi_k_selection_tpu.analysis.placement import (
+            build_placement_report,
+        )
+
+        with open(args.placement_report, "w") as fh:
+            json.dump(
+                build_placement_report(args.paths, mods=report.modules),
                 fh, indent=2, sort_keys=True,
             )
     if args.output:

@@ -496,26 +496,25 @@ class StreamingDevicePutWithoutDevice(Rule):
         "single-slot default path)."
     )
 
-    _PUT_NAMES = {"jax.device_put", "device_put"}
-    _TARGET_KWARGS = {"device", "sharding"}
+    # compatibility shim: the source model (what counts as an
+    # untargeted put) now lives with the placement dataflow pass —
+    # resource_protocols.TRANSFER_PUT_CALLS / PUT_TARGET_KWARGS — so one
+    # placement vocabulary exists, not two. This rule keeps only its id,
+    # fixtures and streaming/ scope.
 
     def check_module(self, mod: SourceModule):
         p = pathlib.Path(mod.path).resolve().as_posix()
         if "/streaming/" not in p or _is_test_file(mod):
             return
-        for node in ast.walk(mod.tree):
-            if (
-                isinstance(node, ast.Call)
-                and dotted_name(node.func) in self._PUT_NAMES
-                and len(node.args) < 2
-                and not any(kw.arg in self._TARGET_KWARGS for kw in node.keywords)
-            ):
-                yield node.lineno, (
-                    f"`{dotted_name(node.func)}` without an explicit device/"
-                    "sharding argument — staged buffers silently pile onto "
-                    "one chip; pass the round-robin slot (or an explicit "
-                    "None for the single-slot default path)"
-                )
+        from mpi_k_selection_tpu.analysis.placement import untargeted_puts
+
+        for line, name in untargeted_puts(mod):
+            yield line, (
+                f"`{name}` without an explicit device/"
+                "sharding argument — staged buffers silently pile onto "
+                "one chip; pass the round-robin slot (or an explicit "
+                "None for the single-slot default path)"
+            )
 
 
 # ---------------------------------------------------------------------------

@@ -232,14 +232,27 @@ def run_analysis(
     ignore=None,
     contracts: bool = True,
     root=None,
+    mods=None,
 ) -> Report:
     """Run every selected rule (and, with ``contracts=True``, every jaxpr
     contract check) over ``paths``. Returns a :class:`Report`; the gate
-    semantics are ``report.exit_code`` (1 iff any unsuppressed finding)."""
-    files = iter_python_files(paths)
+    semantics are ``report.exit_code`` (1 iff any unsuppressed finding).
+
+    ``mods`` is an optional pre-parsed :class:`SourceModule` list (the
+    analysis/modcache.py shared set): when given, ``paths`` is not
+    re-walked or re-parsed — the whole-repo gate tests hand the four
+    passes ONE parsed tree. KSL000 (syntax errors) can only arise from
+    the parse loop, so callers passing ``mods`` vouch the set parsed."""
     findings: list[Finding] = []
-    mods: list[SourceModule] = []
     checks_run: list[str] = []
+    if mods is not None:
+        mods = list(mods)
+        files = [m.path for m in mods]
+        return _run_rules(
+            mods, files, findings, checks_run, select, ignore, contracts
+        )
+    files = iter_python_files(paths)
+    mods = []
     for f in files:
         try:
             mods.append(load_module(f, root=root))
@@ -252,7 +265,10 @@ def run_analysis(
                 findings.append(
                     Finding("KSL000", str(f), e.lineno or 1, f"syntax error: {e.msg}")
                 )
+    return _run_rules(mods, files, findings, checks_run, select, ignore, contracts)
 
+
+def _run_rules(mods, files, findings, checks_run, select, ignore, contracts) -> Report:
     def emit(rule_id: str, mod: SourceModule, line: int, message: str):
         why = mod.suppression(rule_id, line)
         findings.append(

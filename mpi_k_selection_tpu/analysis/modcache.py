@@ -1,0 +1,77 @@
+"""Session-scoped shared parsed-module cache for the whole-repo gates.
+
+Four analysis passes now gate tier-1 over the whole repository — the
+AST rules (test_analysis.py), concurrency (test_concurrency.py),
+lifecycle (test_lifecycle.py) and placement (test_placement.py) — and
+before this module each gate re-walked and re-parsed every package file.
+``shared_modules`` parses once per session and hands every gate the SAME
+:class:`~mpi_k_selection_tpu.analysis.core.SourceModule` list, which
+also makes the per-module analyzer caches (concurrency's, lifecycle's,
+placement's — all keyed by ``id(mod)``) hit across gates instead of
+recomputing their dataflow per test file.
+
+The cache key is (resolved scan paths, root); the value is guarded by a
+per-file (path, mtime_ns, size) fingerprint, so an edited file
+invalidates the whole set — correctness first, the cache only
+accelerates the unchanged-tree case every test session actually is.
+
+``ANALYSIS_GATE_WALL_BUDGET_S`` is the declared wall ceiling for the
+four whole-repo scans COMBINED (contracts excluded — those trace jax
+programs and budget themselves); tests/test_placement.py asserts the
+budget holds, so a pass whose engine regresses to re-parsing (or whose
+dataflow goes quadratic) fails tier-1 with a number attached.
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+
+from mpi_k_selection_tpu.analysis.core import iter_python_files, load_module
+
+#: Declared combined wall ceiling (seconds) for the ast + concurrency +
+#: lifecycle + placement whole-repo scans sharing one parsed-module set.
+#: The four scans run in ~4-6 s on the CI container; 30 leaves honest
+#: headroom for slow shared runners without masking a quadratic engine.
+ANALYSIS_GATE_WALL_BUDGET_S = 30.0
+
+_CACHE: dict[tuple, tuple] = {}
+
+
+def _fingerprint(files) -> tuple:
+    out = []
+    for f in files:
+        try:
+            st = os.stat(f)
+        except OSError:  # racing delete: treat as changed
+            out.append((str(f), -1, -1))
+            continue
+        out.append((str(f), st.st_mtime_ns, st.st_size))
+    return tuple(out)
+
+
+def shared_modules(paths, *, root=None) -> list:
+    """The parsed :class:`SourceModule` list for ``paths`` — cached
+    across calls (and across the four gate test files) until any file's
+    (mtime, size) changes. An unparseable file RAISES here rather than
+    being silently dropped: a gate fed a shared set must never scan a
+    quietly-smaller tree than the uncached path would (KSL000's
+    scan-the-broken-file-anyway semantics stay with ``run_analysis``'s
+    own parse loop, which fixture tests exercise without the cache)."""
+    key = (
+        tuple(sorted(str(pathlib.Path(p).resolve()) for p in paths)),
+        str(pathlib.Path(root).resolve()) if root is not None else None,
+    )
+    files = iter_python_files(paths)
+    fp = _fingerprint(files)
+    hit = _CACHE.get(key)
+    if hit is not None and hit[0] == fp:
+        return hit[1]
+    mods = [load_module(f, root=root) for f in files]
+    _CACHE[key] = (fp, mods)
+    return mods
+
+
+def clear() -> None:
+    """Drop the cache (tests that synthesize trees under one path)."""
+    _CACHE.clear()

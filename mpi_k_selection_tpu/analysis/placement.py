@@ -1,0 +1,947 @@
+"""Device-placement dataflow pass (KSL022-KSL024) + the KSC105
+static<->runtime placement-census agreement contract.
+
+The CGM discipline this package ports (every processor touches exactly
+its own partition) appears here as three runtime conventions: staged
+chunk *j* commits to ``devices[j % p]``, a bucket's programs dispatch on
+the bucket's OWN device, and spill replay re-stages every record onto
+its recorded slot. Until this pass, all of that was enforced only at
+runtime (KSC104's host-transfer census, the recorded ``device_slot``
+streams) plus one shallow syntactic rule (KSL007). This module proves
+the discipline statically, the way lifecycle.py proves release-on-every-
+path: an abstract **placement lattice** per value,
+
+    ``unknown``      no information (bottom; joins absorb it)
+    ``none``         explicitly no placement (the uncommitted default)
+    ``host``         a host-side value (device_get / np.asarray result)
+    ``device(slot)`` committed to one slot expression
+    ``slots``        a resolved device tuple (resolve_stream_devices)
+    ``round-robin``  slots indexed by chunk position (``devs[j % p]``)
+    ``inherited``    a device chunk's own committed device
+    ``top``          conflicting placements met (the finding state)
+
+seeded at the known placement sources (``stage_keys``/
+``stage_device_keys``, ``jax.device_put(..., device=)``,
+``resolve_stream_devices``, ``.device`` reads), propagated through
+assignments, one interprocedural hop (module-local functions returning
+placed values — the same discipline lifecycle.py applies to
+acquire-returning functions) and container round-trips (a FIFO window /
+pipeline queue keeps the slot its pushed value carried). Loop bodies are
+walked twice so loop-carried slots converge.
+
+The rules:
+
+- **KSL022** — dispatch-device mismatch: one program dispatch consuming
+  buckets from two different slots, a conflicting (``top``) placement
+  reaching a dispatch, or a resolved device tuple dropped under a
+  condition that depends on the tuple itself (``devs if len(devs) > 1
+  else None`` — the silent single-device host-fallback bug class; gate
+  on the placement-independent knob instead). Also carries the
+  ``# ksel: placed-on[...]`` stale-annotation audit.
+- **KSL023** — unsanctioned transfer: a host<->device crossing call at
+  a module outside ``resource_protocols.SANCTIONED_TRANSFER_SITES`` —
+  the static, path-sensitive generalization of KSL007 (which delegates
+  its source model here and keeps only its streaming/ scope).
+- **KSL024** — placement nondeterminism: a device-target expression
+  data-dependent on a clock, thread identity, randomness or set/dict
+  iteration order. Device choice must be a pure function of chunk
+  index, an explicit knob or a recorded slot, or spill replay cannot
+  re-stage deterministically — this rule makes replay determinism a
+  proved property instead of a convention.
+
+Declared intent rides ``# ksel: placed-on[<slot-expr>] -- why`` on the
+site line: it overrides the pass's verdict there, is exported to the
+report ledger, and is itself audited — an annotation on a line carrying
+no dispatch, crossing or device-target expression is a finding (the
+owner[]/guarded-by[] staleness discipline applied to placement).
+
+**KSC105** closes the loop with the runtime: the static census must
+agree with KSC104's traced programs (a module whose programs KSC104
+proves crossing-free may not contain a static crossing site), and the
+recorded ``device_slot`` event streams on the devices {1, 2} x spill
+{off, force} grid must match the round-robin prediction, with spill
+replay landing every chunk back on its recorded slot bit-identically.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import pathlib
+import re
+
+from mpi_k_selection_tpu import resource_protocols as _rp
+from mpi_k_selection_tpu.analysis.ast_rules import (
+    _function_defs,
+    dotted_name,
+)
+from mpi_k_selection_tpu.analysis.concurrency import _in_package, _pkg_relpath
+from mpi_k_selection_tpu.analysis.core import Finding, Rule, SourceModule, register
+from mpi_k_selection_tpu.analysis.jaxpr_checks import contract
+
+_PKG = "mpi_k_selection_tpu"
+
+
+def _scoped_relpath(mod: SourceModule) -> str:
+    """``streaming/pipeline.py``-style path (the package segment
+    stripped) — the key form of ``SANCTIONED_TRANSFER_SITES`` and the
+    join key against KSC104's census module paths."""
+    rel = _pkg_relpath(mod)
+    return rel.split("/", 1)[1] if rel.startswith(_PKG + "/") else rel
+
+# ---------------------------------------------------------------------------
+# the lattice
+
+_PLACED_KINDS = frozenset({"device", "slots", "round-robin", "inherited"})
+_DROPPABLE = frozenset({"none", "host", "unknown"})
+
+
+@dataclasses.dataclass(frozen=True)
+class Placement:
+    """One lattice point. ``slot`` is the source text of the slot (or
+    tuple) expression; ``reason`` explains a ``top``."""
+
+    kind: str
+    slot: str = ""
+    reason: str = ""
+
+    def show(self) -> str:
+        return f"{self.kind}({self.slot})" if self.slot else self.kind
+
+
+UNKNOWN = Placement("unknown")
+NONE = Placement("none")
+HOST = Placement("host")
+INHERITED = Placement("inherited")
+
+
+def join(a: Placement, b: Placement) -> Placement:
+    """Lattice join. ``unknown`` is bottom and ``none`` (no explicit
+    placement) folds optimistically into a placed value — the
+    *conditional* drop of a placed value is judged separately with the
+    condition in hand (see ``_FunctionPlacement._merge_cond``), so the
+    plain join stays optimistic and the pass stays quiet on the
+    sanctioned depth-gated host paths."""
+    if a == b:
+        return a
+    for x, y in ((a, b), (b, a)):
+        if x.kind == "top":
+            return x
+        if x.kind == "unknown":
+            return y
+    for x, y in ((a, b), (b, a)):
+        if x.kind == "none":
+            return y
+    return Placement("top", reason=f"{a.show()} vs {b.show()}")
+
+
+# ---------------------------------------------------------------------------
+# `# ksel: placed-on[<slot-expr>] -- why` annotations
+
+_PLACED_RE = re.compile(
+    r"#\s*ksel:\s*placed-on\[(?P<slot>[^\]]+)\]"
+    r"(?:\s*--\s*(?P<why>.*\S))?"
+)
+
+
+def _names_in(node) -> set:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+# ---------------------------------------------------------------------------
+# the per-function engine
+
+
+class _FunctionPlacement:
+    """Abstract interpretation of one function body over the placement
+    lattice. ``record=False`` is the pass-1 walk that only computes the
+    function's return placement (the interprocedural seed); pass 2
+    re-runs with the module's placed-returning functions in ``extra``
+    and records sites + findings."""
+
+    def __init__(self, owner: "_ModulePlacement", fn, extra, record: bool):
+        self.o = owner
+        self.fn = fn
+        self.extra = extra
+        self.record = record
+        self.env: dict[str, Placement] = {}
+        self.defs: dict[str, ast.expr] = {}
+        self.return_placement = UNKNOWN
+
+    def run(self):
+        self._seq(self.fn.body)
+        return self
+
+    # -- statements ---------------------------------------------------------
+
+    def _seq(self, body):
+        for st in body:
+            self._stmt(st)
+
+    def _stmt(self, st):
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return  # analyzed as their own functions
+        if isinstance(st, ast.Assign):
+            v = self._eval(st.value)
+            for t in st.targets:
+                self._bind(t, v, st.value)
+        elif isinstance(st, ast.AnnAssign):
+            if st.value is not None:
+                self._bind(st.target, self._eval(st.value), st.value)
+        elif isinstance(st, ast.AugAssign):
+            self._eval(st.value)  # x += e never re-places x
+        elif isinstance(st, (ast.Return,)):
+            if st.value is not None:
+                self.return_placement = join(
+                    self.return_placement, self._eval(st.value)
+                )
+        elif isinstance(st, ast.Expr):
+            self._eval(st.value)
+        elif isinstance(st, ast.If):
+            self._eval(st.test)
+            before = dict(self.env)
+            self._seq(st.body)
+            after_body = self.env
+            self.env = dict(before)
+            self._seq(st.orelse)
+            after_else = self.env
+            merged = {}
+            for name in set(after_body) | set(after_else):
+                merged[name] = self._merge_cond(
+                    after_body.get(name, UNKNOWN),
+                    after_else.get(name, UNKNOWN),
+                    st.test,
+                )
+            self.env = merged
+        elif isinstance(st, (ast.For, ast.AsyncFor)):
+            self._eval(st.iter)
+            tv = UNKNOWN
+            if isinstance(st.iter, ast.Name):  # iterating a container
+                tv = self.env.get(st.iter.id + "@contents", UNKNOWN)
+            for _sweep in (0, 1):  # twice: loop-carried slots converge
+                self._bind(st.target, tv, None)
+                self._seq(st.body)
+            self._seq(st.orelse)
+        elif isinstance(st, ast.While):
+            self._eval(st.test)
+            for _sweep in (0, 1):
+                self._seq(st.body)
+            self._seq(st.orelse)
+        elif isinstance(st, (ast.With, ast.AsyncWith)):
+            for item in st.items:
+                v = self._eval(item.context_expr)
+                if item.optional_vars is not None:
+                    self._bind(item.optional_vars, v, item.context_expr)
+            self._seq(st.body)
+        elif isinstance(st, ast.Try):
+            self._seq(st.body)
+            for h in st.handlers:
+                if h.name:
+                    self.env[h.name] = UNKNOWN
+                self._seq(h.body)
+            self._seq(st.orelse)
+            self._seq(st.finalbody)
+        elif isinstance(st, (ast.Raise, ast.Assert, ast.Delete)):
+            for c in ast.iter_child_nodes(st):
+                if isinstance(c, ast.expr):
+                    self._eval(c)
+        # pass/break/continue/global/import: nothing placed moves
+
+    def _bind(self, target, placement: Placement, value_node):
+        if isinstance(target, ast.Name):
+            self.env[target.id] = placement
+            if value_node is not None:
+                self.defs[target.id] = value_node
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            elts = (
+                value_node.elts
+                if isinstance(value_node, (ast.Tuple, ast.List))
+                and len(value_node.elts) == len(target.elts)
+                else None
+            )
+            for i, t in enumerate(target.elts):
+                if elts is not None:
+                    self._bind(t, self._eval(elts[i]), elts[i])
+                else:
+                    self._bind(t, UNKNOWN, None)
+        elif isinstance(target, ast.Attribute):
+            d = dotted_name(target)
+            if d:
+                self.env[d] = placement
+        elif isinstance(target, ast.Starred):
+            self._bind(target.value, UNKNOWN, None)
+        # subscript targets don't re-place their base
+
+    # -- conditional drops ---------------------------------------------------
+
+    def _merge_cond(self, a: Placement, b: Placement, test) -> Placement:
+        """Join two branch placements under ``test``. Dropping a placed
+        value to None/host on one branch is sanctioned only when the
+        condition is placement-independent (a depth knob, the raw
+        ``devices`` argument) — a condition that depends on the resolved
+        placement itself (``len(devs) > 1``) is the single-device
+        silent-host-fallback bug class and joins to ``top``."""
+        placed, other = (a, b) if a.kind in _PLACED_KINDS else (b, a)
+        if (
+            placed.kind in _PLACED_KINDS
+            and other.kind in _DROPPABLE
+            and other.kind != "unknown"
+            and test is not None
+            and self._cond_depends_on_placed(test)
+        ):
+            return Placement(
+                "top",
+                slot=placed.slot,
+                reason=(
+                    f"resolved placement {placed.show()} is dropped under a "
+                    "condition that depends on the placement itself — gate "
+                    "the host path on a placement-independent knob "
+                    "(pipeline depth, the raw devices argument) instead"
+                ),
+            )
+        return join(a, b)
+
+    def _cond_depends_on_placed(self, test) -> bool:
+        for name in _names_in(test):
+            if self.env.get(name, UNKNOWN).kind in _PLACED_KINDS:
+                return True
+            rhs = self.defs.get(name)  # one hop: `multi = len(devs) > 1 ...`
+            if rhs is not None:
+                for m in _names_in(rhs):
+                    if self.env.get(m, UNKNOWN).kind in _PLACED_KINDS:
+                        return True
+        return False
+
+    # -- expressions ---------------------------------------------------------
+
+    def _txt(self, node) -> str:
+        seg = self.o.mod.segment(node)
+        if seg:
+            return " ".join(seg.split())
+        try:
+            return ast.unparse(node)
+        except Exception:  # pragma: no cover - unparse is total on exprs
+            return "<expr>"
+
+    def _eval(self, node) -> Placement:
+        if node is None:
+            return UNKNOWN
+        if isinstance(node, ast.Constant):
+            return NONE if node.value is None else UNKNOWN
+        if isinstance(node, ast.Name):
+            return self.env.get(node.id, UNKNOWN)
+        if isinstance(node, ast.Attribute):
+            self._eval(node.value)
+            d = dotted_name(node)
+            if d and d in self.env:
+                return self.env[d]
+            if node.attr == "device":  # StagedKeys.device / array.device
+                return Placement("device", slot=self._txt(node))
+            return UNKNOWN
+        if isinstance(node, ast.Subscript):
+            base = self._eval(node.value)
+            self._eval(node.slice)
+            if base.kind == "slots":
+                self._check_nondet(node.slice, "slot index")
+                idx = self._txt(node.slice)
+                kind = "round-robin" if "%" in idx else "device"
+                return Placement(kind, slot=self._txt(node))
+            return UNKNOWN
+        if isinstance(node, ast.IfExp):
+            self._eval(node.test)
+            return self._merge_cond(
+                self._eval(node.body), self._eval(node.orelse), node.test
+            )
+        if isinstance(node, (ast.Tuple, ast.List)):
+            placed = [
+                p for p in (self._eval(e) for e in node.elts)
+                if p.kind in _PLACED_KINDS
+            ]
+            # a tuple carries its single placed element's slot through a
+            # container round-trip; a mixed tuple (the devs tuple itself)
+            # is not a placement conflict
+            if placed and all(p == placed[0] for p in placed):
+                return placed[0]
+            return UNKNOWN
+        if isinstance(node, ast.Call):
+            return self._call(node)
+        if isinstance(node, ast.BoolOp):
+            for v in node.values:
+                self._eval(v)
+            return UNKNOWN
+        for c in ast.iter_child_nodes(node):
+            if isinstance(c, ast.expr):
+                self._eval(c)
+        return UNKNOWN
+
+    def _kwnode(self, node, *names):
+        for kw in node.keywords:
+            if kw.arg in names:
+                return kw.value
+        return None
+
+    def _call(self, node: ast.Call) -> Placement:
+        name = dotted_name(node.func) or ""
+        last = name.rsplit(".", 1)[-1]
+        argp = [self._eval(a) for a in node.args]
+        kwp = {}
+        for kw in node.keywords:
+            v = self._eval(kw.value)
+            if kw.arg:
+                kwp[kw.arg] = v
+
+        if last in _rp.SLOT_RESOLVER_CALLS:
+            return Placement("slots", slot=self._txt(node))
+        if last in _rp.INHERIT_STAGE_CALLS:
+            return INHERITED
+        if last in _rp.STAGE_CALLS:
+            tgt = (
+                node.args[1]
+                if len(node.args) > 1
+                else self._kwnode(node, "device")
+            )
+            return self._target(tgt)
+        if name in _rp.TRANSFER_PUT_CALLS:
+            self._site_crossing(node, name)
+            tgt = (
+                node.args[1]
+                if len(node.args) > 1
+                else self._kwnode(node, *sorted(_rp.PUT_TARGET_KWARGS))
+            )
+            if tgt is None:
+                return NONE  # uncommitted put — KSL007's subject
+            return self._target(tgt)
+        if name in _rp.CROSSING_CALLS or last in ("device_get", "copy_to_host_async"):
+            self._site_crossing(node, name or last)
+            return HOST
+        if last in _rp.DISPATCH_CALLS:
+            self._site_dispatch(node, last, argp, kwp, mismatch=True)
+            return UNKNOWN
+        if last in _rp.DEVICE_THREADING_CALLS:
+            self._site_dispatch(node, last, argp, kwp, mismatch=False)
+            return UNKNOWN
+        if isinstance(node.func, ast.Attribute):
+            recv = dotted_name(node.func.value)
+            if recv:  # container round-trips keep the pushed slot
+                if last in ("push", "put", "_put", "append", "appendleft", "add"):
+                    if argp:
+                        key = recv + "@contents"
+                        self.env[key] = join(self.env.get(key, UNKNOWN), argp[0])
+                    return UNKNOWN
+                if last in ("pop", "popleft", "get", "drain", "peek"):
+                    return self.env.get(recv + "@contents", UNKNOWN)
+        if last in self.extra:  # the one interprocedural hop
+            return self.extra[last]
+        return UNKNOWN
+
+    def _target(self, tgt_node) -> Placement:
+        """Placement of a device-target expression (stage_keys' device
+        argument, a device_put target, a threading call's devices=)."""
+        if tgt_node is None:
+            return NONE
+        self._check_nondet(tgt_node, "device target")
+        p = self._eval(tgt_node)
+        if p.kind in _PLACED_KINDS or p.kind == "top":
+            return p
+        if isinstance(tgt_node, ast.Constant) and tgt_node.value is None:
+            return NONE
+        return Placement("device", slot=self._txt(tgt_node))
+
+    # -- site checks ---------------------------------------------------------
+
+    def _site_crossing(self, node, name):
+        if not self.record:
+            return
+        self.o.note_site(node.lineno)
+        rel = _scoped_relpath(self.o.mod)
+        sanctioned = rel in _rp.SANCTIONED_TRANSFER_SITES
+        self.o.crossing_sites.append(
+            {"line": node.lineno, "call": name, "sanctioned": sanctioned}
+        )
+        if not sanctioned:
+            self.o.emit(
+                node.lineno,
+                "KSL023",
+                f"`{name}` host<->device crossing at {rel}, which is not a "
+                "sanctioned transfer site — route the transfer through the "
+                "staging boundary (streaming/pipeline.py) or register the "
+                "module in resource_protocols.SANCTIONED_TRANSFER_SITES "
+                "with a written reason",
+            )
+
+    def _site_dispatch(self, node, name, argp, kwp, *, mismatch: bool):
+        if not self.record:
+            return
+        self.o.note_site(node.lineno)
+        operands = list(argp)
+        for k in ("device", "devices"):
+            if k in kwp:
+                operands.append(kwp[k])
+        slots = sorted(
+            {p.slot for p in operands if p.kind in ("device", "round-robin")}
+        )
+        self.o.dispatch_sites.append(
+            {
+                "line": node.lineno,
+                "call": name,
+                "kind": "dispatch" if mismatch else "threading",
+                "slots": slots,
+            }
+        )
+        if mismatch and len(slots) > 1:
+            self.o.emit(
+                node.lineno,
+                "KSL022",
+                f"`{name}` dispatch consumes operands placed on different "
+                f"slots ({', '.join(slots)}) — one program dispatch, one "
+                "device; thread the bucket's own slot",
+            )
+        for p in operands:
+            if p.kind == "top":
+                self.o.emit(
+                    node.lineno,
+                    "KSL022",
+                    f"`{name}` consumes a conflicting placement: {p.reason}",
+                )
+
+    def _check_nondet(self, expr, context: str):
+        if not self.record:
+            return
+        self.o.note_site(getattr(expr, "lineno", self.fn.lineno))
+
+        def scan(e, hop_left):
+            for sub in ast.walk(e):
+                if isinstance(sub, ast.Call):
+                    dn = dotted_name(sub.func) or ""
+                    ln = dn.rsplit(".", 1)[-1]
+                    if dn in _rp.NONDET_PLACEMENT_CALLS or (
+                        ln in ("get_ident", "current_thread", "urandom",
+                               "uuid1", "uuid4")
+                    ):
+                        self.o.emit(
+                            getattr(sub, "lineno", expr.lineno),
+                            "KSL024",
+                            f"{context} depends on `{dn or ln}` — device "
+                            "choice must be a pure function of chunk index, "
+                            "an explicit knob or a recorded slot, or spill "
+                            "replay cannot re-stage deterministically",
+                        )
+                    elif dn in _rp.UNORDERED_CONSTRUCTORS:
+                        self.o.emit(
+                            getattr(sub, "lineno", expr.lineno),
+                            "KSL024",
+                            f"{context} drawn from a `{dn}` — set/dict "
+                            "iteration order is no contract; a device index "
+                            "must come from an ordered, recorded source",
+                        )
+                elif isinstance(sub, ast.Name) and hop_left:
+                    rhs = self.defs.get(sub.id)
+                    if rhs is not None and rhs is not e:
+                        scan(rhs, hop_left - 1)
+
+        scan(expr, 1)
+
+
+# ---------------------------------------------------------------------------
+# the per-module analyzer
+
+
+class _ModulePlacement:
+    def __init__(self, mod: SourceModule):
+        self.mod = mod
+        self.findings: set[tuple[int, str, str]] = set()
+        self.dispatch_sites: list[dict] = []
+        self.crossing_sites: list[dict] = []
+        self.annotations: dict[int, tuple[str, str]] = {}
+        self.site_lines: set[int] = set()
+        in_string = mod.string_literal_lines()
+        for i, text in enumerate(mod.lines, start=1):
+            if i in in_string:
+                continue
+            m = _PLACED_RE.search(text)
+            if m:
+                self.annotations[i] = (
+                    m.group("slot").strip(),
+                    (m.group("why") or "").strip(),
+                )
+
+    def note_site(self, line: int):
+        self.site_lines.add(line)
+
+    def emit(self, line: int, rule: str, message: str):
+        if line in self.annotations:
+            return  # declared placement overrides; audited below
+        self.findings.add((line, rule, message))
+
+    def run(self) -> "_ModulePlacement":
+        fns = [
+            fn for defs in _function_defs(self.mod.tree).values() for fn in defs
+        ]
+        returns: dict[str, Placement] = {}
+        for fn in fns:  # pass 1: placed-returning functions
+            eng = _FunctionPlacement(self, fn, {}, record=False).run()
+            if eng.return_placement.kind in _PLACED_KINDS:
+                returns[fn.name] = eng.return_placement
+        for fn in fns:  # pass 2: sites + findings, with the hop seeded
+            _FunctionPlacement(self, fn, returns, record=True).run()
+        self._audit_annotations()
+        return self
+
+    def _audit_annotations(self):
+        for line, (slot, _why) in sorted(self.annotations.items()):
+            if line not in self.site_lines:
+                self.findings.add(
+                    (
+                        line,
+                        "KSL022",
+                        f"stale `# ksel: placed-on[{slot}]`: no dispatch, "
+                        "crossing or device-target expression on this line "
+                        "— placement annotations must sit on the site they "
+                        "sanction",
+                    )
+                )
+
+
+_CACHE: dict[int, _ModulePlacement] = {}
+
+
+def analyze_placement(mod: SourceModule) -> _ModulePlacement:
+    key = id(mod)
+    hit = _CACHE.get(key)
+    if hit is None:
+        if len(_CACHE) > 4096:  # pragma: no cover - bound, not a policy
+            _CACHE.clear()
+        hit = _CACHE[key] = _ModulePlacement(mod).run()
+    return hit
+
+
+# ---------------------------------------------------------------------------
+# scope + the KSL007 source model
+
+_SCOPED_PACKAGES = ("streaming", "serve", "monitor", "ops", "parallel")
+
+
+def _in_scope(mod: SourceModule) -> bool:
+    if not _in_package(mod):
+        return False
+    return _scoped_relpath(mod).split("/", 1)[0] in _SCOPED_PACKAGES
+
+
+def untargeted_puts(mod: SourceModule):
+    """``(line, call_name)`` for every ``jax.device_put`` lacking an
+    explicit device/sharding target — THE placement-source model KSL007
+    gates on (defined here so one placement vocabulary exists, not two:
+    the same ``resource_protocols`` names seed the dataflow pass)."""
+    for node in ast.walk(mod.tree):
+        if (
+            isinstance(node, ast.Call)
+            and dotted_name(node.func) in _rp.TRANSFER_PUT_CALLS
+            and len(node.args) < 2
+            and not any(
+                kw.arg in _rp.PUT_TARGET_KWARGS for kw in node.keywords
+            )
+        ):
+            yield node.lineno, dotted_name(node.func)
+
+
+# ---------------------------------------------------------------------------
+# the rules
+
+
+class _PlacementRule(Rule):
+    def check_module(self, mod: SourceModule):
+        if not _in_scope(mod):
+            return
+        result = analyze_placement(mod)
+        for line, rule, message in sorted(result.findings):
+            if rule == self.id:
+                yield line, message
+
+
+@register
+class DispatchDeviceMismatch(_PlacementRule):
+    id = "KSL022"
+    title = "program dispatch with mismatched or dropped device placement"
+    rationale = (
+        "The streaming discipline is one bucket, one slot, one program: "
+        "staged chunk j commits to devices[j % p] and every program the "
+        "bucket feeds dispatches on that slot. A dispatch consuming "
+        "operands from two slots forces XLA to insert a silent cross-"
+        "device copy mid-pass (the exact transfer KSC104's census "
+        "forbids); a resolved device tuple dropped under a condition "
+        "that depends on the tuple itself (`devs if len(devs) > 1 else "
+        "None`) silently host-folds an explicitly requested single "
+        "device — the caller asked for a placement and got the default. "
+        "Declared intent rides `# ksel: placed-on[<slot>]` and is "
+        "audited for staleness like owner[]/guarded-by[]."
+    )
+
+
+@register
+class UnsanctionedTransfer(_PlacementRule):
+    id = "KSL023"
+    title = "host<->device crossing outside the sanctioned transfer registry"
+    rationale = (
+        "Every legitimate host<->device crossing in the streaming/serve/"
+        "monitor/ops/parallel vertical lives at a named site: the "
+        "staging boundary (streaming/pipeline.py), the mesh-sharding "
+        "registrations (parallel/), the DCN device_get (multihost). A "
+        "crossing anywhere else is how mid-pass transfers sneak in — "
+        "the static, path-sensitive generalization of KSL007, keyed on "
+        "resource_protocols.SANCTIONED_TRANSFER_SITES so the registry "
+        "is one importable table, not a rule's private list."
+    )
+
+
+@register
+class PlacementNondeterminism(_PlacementRule):
+    id = "KSL024"
+    title = "device choice data-dependent on a nondeterministic source"
+    rationale = (
+        "Spill replay re-stages every record onto its recorded slot, and "
+        "recovery is bit-identical only because device choice is a pure "
+        "function of chunk index, explicit knobs and recorded slots. A "
+        "device target derived from a clock, thread identity, randomness "
+        "or set/dict iteration order makes placement unreproducible: the "
+        "replay lands on different chips than the pass it replays, "
+        "recompiles every bucket program, and the flight recorder's "
+        "device_slot stream stops describing reality."
+    )
+
+
+# ---------------------------------------------------------------------------
+# the exported placement graph
+
+
+def build_placement_report(paths, root=None, mods=None) -> dict:
+    """The placement graph the ``--placement-report`` flag exports:
+    per-module dispatch/threading/crossing sites, the annotation ledger
+    (with justifications), the sanctioned-transfer registry and the
+    lattice vocabulary — package-relative, cwd-independent."""
+    from mpi_k_selection_tpu.analysis.core import iter_python_files, load_module
+
+    if mods is None:
+        mods = []
+        for f in iter_python_files(paths):
+            try:
+                mods.append(load_module(f, root=root))
+            except SyntaxError:
+                continue
+    placements: dict[str, dict] = {}
+    annotations: list[dict] = []
+    for mod in mods:
+        if not _in_scope(mod):
+            continue
+        result = analyze_placement(mod)
+        rel = _scoped_relpath(mod)
+        if result.dispatch_sites or result.crossing_sites:
+            placements[rel] = {
+                "dispatch_sites": sorted(
+                    result.dispatch_sites, key=lambda s: s["line"]
+                ),
+                "crossing_sites": sorted(
+                    result.crossing_sites, key=lambda s: s["line"]
+                ),
+            }
+        for line, (slot, why) in sorted(result.annotations.items()):
+            annotations.append(
+                {
+                    "path": rel,
+                    "line": line,
+                    "slot": slot,
+                    "justification": why,
+                    "used": line in result.site_lines,
+                }
+            )
+    return {
+        "lattice": [
+            "unknown", "none", "host", "device(slot)", "slots",
+            "round-robin", "inherited", "top",
+        ],
+        "placements": placements,
+        "annotations": annotations,
+        "sanctioned_transfers": dict(_rp.SANCTIONED_TRANSFER_SITES),
+        "rules": ["KSL022", "KSL023", "KSL024"],
+    }
+
+
+# ---------------------------------------------------------------------------
+# KSC105 — static<->runtime placement-census agreement
+
+
+def _static_census():
+    """(crossing sites by package-relative module, dispatch-call names
+    the pass saw) over the installed package — the static half of
+    KSC105."""
+    pkg_root = pathlib.Path(__file__).resolve().parent.parent
+    from mpi_k_selection_tpu.analysis.core import iter_python_files, load_module
+
+    crossings: dict[str, list[dict]] = {}
+    dispatch_names: set[str] = set()
+    for f in iter_python_files([pkg_root]):
+        try:
+            mod = load_module(f, root=pkg_root.parent)
+        except SyntaxError:
+            continue
+        if not _in_scope(mod):
+            continue
+        result = analyze_placement(mod)
+        rel = _scoped_relpath(mod)
+        if result.crossing_sites:
+            crossings[rel] = list(result.crossing_sites)
+        dispatch_names.update(
+            s["call"] for s in result.dispatch_sites if s["kind"] == "dispatch"
+        )
+        # a dispatch core passed BY REFERENCE (into jax.jit / a dispatch
+        # wrapper) is a live vocabulary use too — operand agreement only
+        # applies at direct calls, but the name has not drifted
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.Name, ast.Attribute)):
+                name = (dotted_name(node) or "").rsplit(".", 1)[-1]
+                if name in _rp.DISPATCH_CALLS:
+                    dispatch_names.add(name)
+    return crossings, dispatch_names
+
+
+def _slot_stream_findings(devices: int, force_spill: bool) -> list:
+    """Run one small staged sketch pass and check the recorded
+    ``device_slot`` stream against the round-robin prediction; with
+    ``force_spill`` also replay the spill generation and check the
+    replay re-stages every chunk onto its recorded slot with a
+    bit-identical fold."""
+    import numpy as np
+
+    from mpi_k_selection_tpu.obs import Observability
+    from mpi_k_selection_tpu.streaming.sketch import RadixSketch
+    from mpi_k_selection_tpu.streaming.spill import SpillStore
+
+    here = "mpi_k_selection_tpu/analysis/placement.py"
+    findings: list[Finding] = []
+    rng = np.random.default_rng(7)
+    chunks = [
+        rng.integers(0, 1 << 31, 1024, dtype=np.int64).astype(np.int32)
+        for _ in range(4)
+    ]
+
+    def run(source, store=None):
+        obs = Observability.collecting()
+        sk = RadixSketch(np.dtype(np.int32))
+        sk.update_stream(
+            source, pipeline_depth=2, devices=devices, spill=store, obs=obs
+        )
+        evs = obs.events.of_kind("stream.chunk")
+        return sk, evs
+
+    store = SpillStore() if force_spill else None
+    try:
+        sk, evs = run(chunks, store=store)
+        if len(evs) != len(chunks):
+            findings.append(
+                Finding(
+                    "KSC105", here, 0,
+                    f"devices={devices} spill={force_spill}: expected "
+                    f"{len(chunks)} stream.chunk events, saw {len(evs)}",
+                )
+            )
+        for ev in evs:
+            want = ev.chunk_index % devices
+            if ev.device_slot != want:
+                findings.append(
+                    Finding(
+                        "KSC105", here, 0,
+                        f"devices={devices} spill={force_spill}: chunk "
+                        f"{ev.chunk_index} recorded device_slot="
+                        f"{ev.device_slot}, round-robin predicts {want} — "
+                        "the runtime slot stream disagrees with the static "
+                        "placement model",
+                    )
+                )
+        if force_spill:
+            sk2, evs2 = run(store)
+            if [e.device_slot for e in evs2] != [e.device_slot for e in evs]:
+                findings.append(
+                    Finding(
+                        "KSC105", here, 0,
+                        f"devices={devices}: spill replay re-dealt the slots "
+                        f"({[e.device_slot for e in evs2]} vs recorded "
+                        f"{[e.device_slot for e in evs]}) — replay must "
+                        "re-stage every record onto its recorded slot",
+                    )
+                )
+            if sk2 != sk:
+                findings.append(
+                    Finding(
+                        "KSC105", here, 0,
+                        f"devices={devices}: spill replay's sketch fold is "
+                        "not bit-identical to the teeing pass",
+                    )
+                )
+    finally:
+        if store is not None:
+            store.close()
+    return findings
+
+
+@contract(
+    "KSC105",
+    "static placement census agrees with traced programs and recorded slots",
+    "The placement pass predicts WHERE crossings and dispatches happen; "
+    "KSC104 proves the streaming programs carry no mid-pass crossing and "
+    "the runtime records each staged chunk's device_slot. The three views "
+    "must agree: a module whose programs KSC104 traces as crossing-free "
+    "may not contain a static crossing site, every static crossing must "
+    "be sanctioned, and the recorded slot streams on the devices {1,2} x "
+    "spill {off,force} grid must match the round-robin prediction with "
+    "replay landing on recorded slots (the KSL016/lockorder discipline "
+    "applied to placement).",
+)
+def _check_placement_agreement() -> list:
+    findings: list[Finding] = []
+    crossings, dispatch_names = _static_census()
+    for rel, sites in sorted(crossings.items()):
+        for site in sites:
+            if not site["sanctioned"]:
+                findings.append(
+                    Finding(
+                        "KSC105", rel, site["line"],
+                        f"static census: `{site['call']}` crossing at an "
+                        "unsanctioned site survives to the contract layer",
+                    )
+                )
+    # KSC104 agreement: its traced program modules must be statically
+    # crossing-free (their zero-mid-pass-crossing claim is a runtime
+    # census; this is its static twin over the same modules), and every
+    # dispatch-family name must be SEEN by the pass somewhere — a
+    # registry name no call site uses means the vocabulary drifted
+    from mpi_k_selection_tpu.analysis.jaxpr_checks import _census_cases
+
+    census_rels = set()
+    for case in _census_cases():
+        census_rels.add(case[0].split("mpi_k_selection_tpu/", 1)[-1])
+    for rel in sorted(census_rels):
+        if rel in crossings:
+            findings.append(
+                Finding(
+                    "KSC105", rel, crossings[rel][0]["line"],
+                    "KSC104 traces this module's programs as crossing-free, "
+                    "but the static placement census finds a host<->device "
+                    "crossing site in it — the two censuses disagree",
+                )
+            )
+    for name in sorted(_rp.DISPATCH_CALLS - dispatch_names):
+        findings.append(
+            Finding(
+                "KSC105", "mpi_k_selection_tpu/resource_protocols.py", 0,
+                f"DISPATCH_CALLS registers `{name}` but the placement pass "
+                "sees no call site for it — the dispatch vocabulary has "
+                "drifted from the code (remove the name or fix the scan)",
+            )
+        )
+    # runtime agreement on the devices {1,2} x spill {off,force} grid
+    import jax
+
+    grid = [1] + ([2] if len(jax.devices()) >= 2 else [])
+    for devices in grid:
+        for force_spill in (False, True):
+            findings.extend(_slot_stream_findings(devices, force_spill))
+    return findings

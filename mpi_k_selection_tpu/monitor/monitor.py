@@ -275,7 +275,9 @@ class Monitor:
         kdt = np.dtype(_dt.key_dtype(dtype))
         depth = _pl.validate_pipeline_depth(self.pipeline_depth)
         devs = _pl.resolve_stream_devices(self.devices)
-        multi = len(devs) > 1 and depth > 0
+        # gate staging on the raw knobs, not the resolved tuple (KSL022):
+        # an explicit single device must stage committed, not host-fold
+        staged = depth > 0 and self.devices is not None
         self.ws = self._make_window(dtype)
         src = as_chunk_source(source, one_shot_ok=True)
         timer, _restore = _wr.attach_timer(self.obs, timer)
@@ -289,8 +291,8 @@ class Monitor:
         try:
             with _pl._phase(timer, "monitor.pass"), _key_chunk_stream(
                 src, dtype, pipeline_depth=depth, timer=timer,
-                hist_method="scatter" if multi else None,
-                devices=devs if multi else None,
+                hist_method="scatter" if staged else None,
+                devices=devs if staged else None,
             ) as kc:
                 for keys, _ in kc:
                     if self.obs is not None:

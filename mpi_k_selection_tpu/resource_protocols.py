@@ -132,3 +132,128 @@ OWNER_SITES = {
     "supervisor": "a conftest-recognized thread supervisor joins it",
     "caller": "ownership returns to the caller (documented contract)",
 }
+
+# ---------------------------------------------------------------------------
+# device-placement vocabulary (analysis/placement.py, KSL022-KSL024 + KSC105)
+#
+# The placement pass models, per value, WHERE it computes: `host`,
+# `device(slot)`, `slots` (a resolved device tuple), `round-robin`
+# (slots indexed by chunk position), `inherited` (a device-resident
+# chunk's own committed device) or `top` (conflicting placements met).
+# The names below are the ONE vocabulary shared by the static pass, the
+# KSL007 compatibility shim and the KSC105 static<->runtime contract.
+
+# -- placement sources ------------------------------------------------------
+
+#: ``jax.device_put`` spellings (the raw transfer primitive — KSL007's
+#: historical subject and a KSL023 crossing).
+TRANSFER_PUT_CALLS = frozenset({"jax.device_put", "device_put"})
+#: Keyword arguments that commit a ``device_put`` to a target.
+PUT_TARGET_KWARGS = frozenset({"device", "sharding"})
+#: Staging constructors whose result carries its device argument's slot
+#: (``stage_device_keys`` inherits the chunk's own committed device).
+STAGE_CALLS = frozenset({"stage_keys"})
+INHERIT_STAGE_CALLS = frozenset({"stage_device_keys"})
+#: The slot-tuple resolver: its result is the abstract ``slots`` value —
+#: round-robin staging indexes it by chunk position.
+SLOT_RESOLVER_CALLS = frozenset({"resolve_stream_devices"})
+
+# -- dispatch / threading sites ---------------------------------------------
+
+#: Per-bucket device-program dispatches (the KSL014 family): every
+#: operand of one dispatch must agree on ONE slot (KSL022).
+DISPATCH_CALLS = frozenset(
+    {
+        "dispatch_chunk_histograms",
+        "dispatch_compaction",
+        "dispatch_fused_ingest",
+        "dispatch_sweep_ingest",
+        "fused_ingest_core",
+        "sweep_ingest_core",
+        "masked_radix_histogram",
+        "multi_masked_radix_histogram",
+    }
+)
+#: Calls that accept the resolved device tuple via ``devices=`` and
+#: thread it into round-robin staging — the KSL022 drop-site family: a
+#: conditional that withholds a resolved tuple from one of these may
+#: depend only on placement-independent knobs (pipeline depth, the raw
+#: ``devices`` argument), never on the resolved tuple itself.
+DEVICE_THREADING_CALLS = frozenset(
+    {
+        "_key_chunk_stream",
+        "ChunkPipeline",
+        "streaming_kselect",
+        "streaming_kselect_many",
+        "update_stream",
+    }
+)
+
+# -- sanctioned host<->device crossings (KSL023) ----------------------------
+
+#: Host<->device crossing calls the placement pass censuses statically
+#: (the AST twin of KSC104's ``_CROSSING_PRIMITIVES``).
+CROSSING_CALLS = frozenset(
+    {
+        "jax.device_put",
+        "device_put",
+        "jax.device_get",
+        "device_get",
+        "copy_to_host_async",
+    }
+)
+#: The sanctioned transfer sites: package-relative module path -> why
+#: that module may host crossings. A crossing call in `streaming/`,
+#: `serve/`, `monitor/`, `ops/` or `parallel/` OUTSIDE this registry is
+#: a KSL022-class placement hole (KSL023). Keep reasons current: the
+#: placement report exports this table verbatim.
+SANCTIONED_TRANSFER_SITES = {
+    "streaming/pipeline.py": (
+        "THE staging boundary: stage_keys/stage_device_keys commit "
+        "buckets to their round-robin slot (KSC104 proves no other "
+        "crossing rides a streaming program)"
+    ),
+    "parallel/mesh.py": (
+        "shard_for_mesh — the one sanctioned mesh-sharding helper "
+        "(device_put with a NamedSharding)"
+    ),
+    "parallel/topk.py": "mesh-sharded input registration (NamedSharding put)",
+    "parallel/radix.py": "mesh-sharded input registration (NamedSharding put)",
+    "parallel/cgm.py": "mesh-sharded input registration (NamedSharding put)",
+    "parallel/multihost.py": (
+        "the DCN boundary: device_get of cross-process reductions"
+    ),
+}
+
+# -- placement-nondeterminism sources (KSL024) ------------------------------
+
+#: Calls whose result may never feed a device-target expression: device
+#: choice must be a pure function of chunk index, an explicit knob or a
+#: recorded slot, or spill replay cannot re-stage deterministically.
+NONDET_PLACEMENT_CALLS = frozenset(
+    {
+        "time.time",
+        "time.time_ns",
+        "time.monotonic",
+        "time.monotonic_ns",
+        "time.perf_counter",
+        "time.perf_counter_ns",
+        "threading.get_ident",
+        "threading.current_thread",
+        "get_ident",
+        "current_thread",
+        "random.random",
+        "random.randint",
+        "random.randrange",
+        "random.choice",
+        "random.getrandbits",
+        "os.urandom",
+        "uuid.uuid1",
+        "uuid.uuid4",
+        "hash",
+        "id",
+    }
+)
+#: Constructors whose iteration order is no contract: a device index
+#: drawn from one is nondeterministic placement even without a clock.
+UNORDERED_CONSTRUCTORS = frozenset({"set", "frozenset"})

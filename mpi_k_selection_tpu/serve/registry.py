@@ -315,20 +315,30 @@ class DatasetRegistry:
         self._check_new_id(dataset_id)
         src = as_chunk_source(source)  # rejects one-shot sources loudly
         dtype = None
-        sk = None
-        n = 0
-        for chunk in src():
+        for chunk in src():  # dtype probe only; the fold is one stream pass
+            cdt = getattr(chunk, "orig_dtype", None)  # spill records
+            if cdt is not None:
+                dtype = np.dtype(cdt)
+                break
             c = np.ravel(np.asarray(chunk))
-            if c.size == 0:
-                continue
-            if dtype is None:
+            if c.size:
                 dtype = np.dtype(c.dtype)
-                sk = RadixSketch(
-                    dtype, radix_bits=sketch_bits, levels=sketch_levels
-                )
-            sk.update(c)
-            n += int(c.size)
-        if dtype is None or n == 0:
+                break
+        if dtype is None:
+            raise QueryError("cannot register an empty dataset")
+        sk = RadixSketch(dtype, radix_bits=sketch_bits, levels=sketch_levels)
+        # the accumulation pass rides the streaming layer so the
+        # dataset's held staging knobs govern the sketch build too — the
+        # registry must not host-fold a stream its caller staged on
+        # devices (the KSL022 placement hole this loop used to be)
+        sk.update_stream(
+            src,
+            pipeline_depth=stream_kwargs.get("pipeline_depth", 0),
+            devices=stream_kwargs.get("devices"),
+            fused=stream_kwargs.get("fused"),
+        )
+        n = int(sk.n)
+        if n == 0:
             raise QueryError("cannot register an empty dataset")
         return self._register(
             ResidentDataset(

@@ -479,7 +479,12 @@ def _collect_survivors(
     kdt = np.dtype(_dt.key_dtype(dtype))
     total_bits = _dt.key_bits(dtype)
     devs = _pl.resolve_stream_devices(devices)
-    multi = len(devs) > 1 and _pl.validate_pipeline_depth(pipeline_depth) > 0
+    depth = _pl.validate_pipeline_depth(pipeline_depth)
+    multi = len(devs) > 1 and depth > 0
+    # staging is gated on the RAW knobs (depth, the devices argument) —
+    # never on the resolved tuple, so an explicitly requested single
+    # device stages committed instead of silently host-folding (KSL022)
+    staged = depth > 0 and devices is not None
     sorted_specs = sorted(specs)
     collector = _ex.CollectConsumer(
         sorted_specs, kdt, total_bits, deferred=deferred, obs=obs
@@ -501,8 +506,8 @@ def _collect_survivors(
     try:
         with _pl._phase(timer, "descent.collect"), _key_chunk_stream(
             src, dtype, pipeline_depth=pipeline_depth, timer=timer,
-            hist_method=hist_method if multi else None,
-            devices=devs if multi else None, retry=retry, obs=obs,
+            hist_method=hist_method if staged else None,
+            devices=devs if staged else None, retry=retry, obs=obs,
         ) as kc:
             for keys, _ in kc:
                 if obs is not None:
@@ -1379,7 +1384,10 @@ def streaming_rank_certificate(
         src = _fp.resilient_source(src, policy, obs=obs)
     devs = _pl.resolve_stream_devices(devices)
     timer, _restore_recorder = _wr.attach_timer(obs, timer)
-    multi = len(devs) > 1 and _pl.validate_pipeline_depth(pipeline_depth) > 0
+    depth = _pl.validate_pipeline_depth(pipeline_depth)
+    # gate staging on the raw knobs, not the resolved tuple (KSL022): an
+    # explicit single device must stage committed, not host-fold
+    staged = depth > 0 and devices is not None
     vkey = None
     kdt = None
     counter = ex = keys = None
@@ -1387,8 +1395,8 @@ def streaming_rank_certificate(
     try:
         with _pl._phase(timer, "certificate.pass"), _key_chunk_stream(
             src, pipeline_depth=pipeline_depth, timer=timer,
-            hist_method="auto" if multi else None,
-            devices=devs if multi else None, retry=policy, obs=obs,
+            hist_method="auto" if staged else None,
+            devices=devs if staged else None, retry=policy, obs=obs,
         ) as kc:
             for keys, chunk in kc:
                 if vkey is None:

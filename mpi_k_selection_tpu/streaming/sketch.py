@@ -265,7 +265,10 @@ class RadixSketch:
             _exec.DEFAULT_FUSED if fused is None else fused
         )
         timer, _restore_recorder = _wr.attach_timer(obs, timer)
-        multi = len(devs) > 1 and pipeline_depth > 0
+        # staging is gated on the RAW knobs (depth, the devices argument)
+        # — never on the resolved tuple, so an explicitly requested
+        # single device stages committed instead of host-folding (KSL022)
+        staged = pipeline_depth > 0 and devices is not None
         if spill is not None and not isinstance(spill, _sp.SpillStore):
             raise TypeError(
                 "update_stream's spill must be a SpillStore (the caller "
@@ -290,8 +293,8 @@ class RadixSketch:
                 # buckets (the same method distributed_sketch defaults to);
                 # resolve_stream_hist downgrades it to host counting exactly
                 # where the device would not be bit-exact
-                hist_method="scatter" if multi else None,
-                devices=devs if multi else None,
+                hist_method="scatter" if staged else None,
+                devices=devs if staged else None,
                 spill=writer,
             ) as kc:
                 for keys, _ in kc:

@@ -16,7 +16,7 @@ import textwrap
 
 import pytest
 
-from mpi_k_selection_tpu.analysis import run_analysis
+from mpi_k_selection_tpu.analysis import run_analysis, shared_modules
 from mpi_k_selection_tpu.analysis.core import load_module
 from mpi_k_selection_tpu.analysis.__main__ import main as lint_main
 
@@ -1555,7 +1555,10 @@ def test_analyzer_gate_whole_repo():
     with a written justification (# ksel: noqa[...] -- why)."""
     from mpi_k_selection_tpu.analysis import render_json
 
-    report = run_analysis([REPO], root=REPO, contracts=True)
+    report = run_analysis(
+        [REPO], root=REPO, contracts=True,
+        mods=shared_modules([REPO], root=REPO),
+    )
     pathlib.Path("/tmp/kselect_lint.json").write_text(render_json(report))
     assert report.unsuppressed == [], (
         "unsuppressed kselect-lint findings (full report: "

@@ -29,7 +29,7 @@ import warnings
 import numpy as np
 import pytest
 
-from mpi_k_selection_tpu.analysis import run_analysis
+from mpi_k_selection_tpu.analysis import run_analysis, shared_modules
 from mpi_k_selection_tpu.analysis.__main__ import main as lint_main
 from mpi_k_selection_tpu.analysis.concurrency import (
     analyze_module,
@@ -462,7 +462,10 @@ def test_ksl016_noqa(tmp_path):
 def test_repo_static_lock_graph_acyclic():
     """The shipped package's own static lock-order graph has no cycle
     (the KSL016 gate property, asserted directly on the graph)."""
-    report = build_concurrency_report([REPO / PKG], root=REPO)
+    report = build_concurrency_report(
+        [REPO / PKG], root=REPO,
+        mods=shared_modules([REPO / PKG], root=REPO),
+    )
     assert report["lock_graph"]["cycles"] == []
     assert len(report["lock_graph"]["nodes"]) >= 10
 
@@ -591,7 +594,10 @@ def test_ksl017_scope_and_noqa(tmp_path):
 
 
 def test_thread_graph_finds_package_roots():
-    report = build_concurrency_report([REPO / PKG], root=REPO)
+    report = build_concurrency_report(
+        [REPO / PKG], root=REPO,
+        mods=shared_modules([REPO / PKG], root=REPO),
+    )
     threads = report["threads"]
     assert "QueryBatcher._run" in threads[f"{PKG}/serve/batcher.py"]["roots"]
     assert (
@@ -715,7 +721,10 @@ def test_dead_suppressions_in_json_report(tmp_path, capsys):
 def test_repo_has_no_dead_suppressions():
     """The shipped ledger carries no stale entries (the audit retired
     the redundant compat.py / spill.py noqas when it landed)."""
-    report = run_analysis([REPO], root=REPO, contracts=False)
+    report = run_analysis(
+        [REPO], root=REPO, contracts=False,
+        mods=shared_modules([REPO], root=REPO),
+    )
     assert report.dead_suppressions == [], report.dead_suppressions
 
 
@@ -991,7 +1000,10 @@ def test_lockorder_sanitizer_gate(tmp_path):
         _monitor_run(san)
     assert san.threads_seen, "no lock activity recorded at all?"
     san.assert_acyclic()
-    static = build_concurrency_report([REPO / PKG], root=REPO)
+    static = build_concurrency_report(
+        [REPO / PKG], root=REPO,
+        mods=shared_modules([REPO / PKG], root=REPO),
+    )
     conflicts = san.check_consistency(static["lock_graph"])
     assert conflicts == [], conflicts
     artifact = san.to_dict()

@@ -35,7 +35,7 @@ import numpy as np
 import pytest
 
 from mpi_k_selection_tpu import resource_protocols as rp
-from mpi_k_selection_tpu.analysis import run_analysis
+from mpi_k_selection_tpu.analysis import run_analysis, shared_modules
 from mpi_k_selection_tpu.analysis.__main__ import main as lint_main
 from mpi_k_selection_tpu.analysis.lifecycle import build_lifecycle_report
 
@@ -738,6 +738,7 @@ def test_lifecycle_rules_clean_repo_wide():
     report = run_analysis(
         [REPO / PKG], root=REPO, contracts=False,
         select=["KSL019", "KSL020", "KSL021"],
+        mods=shared_modules([REPO / PKG], root=REPO),
     )
     assert report.unsuppressed == [], [
         f.render() for f in report.unsuppressed
@@ -745,7 +746,10 @@ def test_lifecycle_rules_clean_repo_wide():
 
 
 def test_lifecycle_gate_whole_repo(tmp_path):
-    report = build_lifecycle_report([REPO / PKG], root=REPO)
+    report = build_lifecycle_report(
+        [REPO / PKG], root=REPO,
+        mods=shared_modules([REPO / PKG], root=REPO),
+    )
     art = json.dumps(report, indent=2, sort_keys=True)
     (tmp_path / "kselect_lifecycle.json").write_text(art)
     try:  # best-effort /tmp mirror (shared-host permission hazard)
