@@ -1065,20 +1065,27 @@ def bench_ingest_fusion(on_tpu: bool):
 
 def bench_serve(on_tpu: bool):
     """Resident-dataset query server (serve/): queries/sec and p50/p99
-    request latency per tier at client concurrency {1, 8, 64}, plus the
-    batch-width histogram snapshot. ``exact_match`` REQUIRES bit-equality
-    between the server's batched/coalesced answers (exact and auto tiers,
-    every concurrency level) and one-at-a-time ``api.kselect`` over the
-    same resident bits; sketch-tier answers must bracket the true value
-    with their exact bounds. Latency here includes the coalescing window
-    (2 ms) — that is the serving trade the batcher makes: a bounded
-    latency add buys one shared-pass walk per concurrent burst."""
+    request latency per tier at client concurrency {1, 8, 64}, the
+    batch-width histogram snapshot, plus the ISSUE 18 hot-path records:
+    the cold-vs-warm first-query latency split (``warmup`` on/off, the
+    compile wall attributed via the ledger's ``serve.programs`` site
+    book) and the sketch-tier fast-path on/off qps comparison.
+    ``exact_match`` REQUIRES bit-equality between the server's answers
+    (exact and auto tiers, every concurrency level, both first-query
+    legs) and one-at-a-time ``api.kselect`` over the same resident bits;
+    sketch-tier answers must bracket the true value with their exact
+    bounds. Latency here includes the coalescing window (2 ms) — that is
+    the serving trade the batcher makes: a bounded latency add buys one
+    shared-pass walk per concurrent burst. Acceptance gates: fast-path
+    sketch qps >= 2x the queued path at concurrency 64, and the warmed
+    dataset's first exact query runs with ZERO on-path compiles."""
     import threading
 
     import numpy as np
 
     from mpi_k_selection_tpu import api
     from mpi_k_selection_tpu.obs import MetricsRegistry, Observability
+    from mpi_k_selection_tpu.obs.ledger import LEDGER, snapshot_delta
     from mpi_k_selection_tpu.serve import KSelectServer
     from mpi_k_selection_tpu.utils import datagen
 
@@ -1088,60 +1095,121 @@ def bench_serve(on_tpu: bool):
     ks_pool = [1 + (i * 104729) % n for i in range(queries_per_cell)]
     ref = {k: np.asarray(api.kselect(x, k)).item() for k in sorted(set(ks_pool))}
     s_host = np.sort(x, kind="stable")
+    exact = True
+
+    def storm(srv, dataset, tier, conc, pool):
+        """One concurrency cell: ``conc`` client threads splitting
+        ``pool``, per-query wall latencies + bit/bounds checks."""
+        nonlocal exact
+        lat: list[float] = []
+        mismatches = []
+        lock = threading.Lock()
+        shards = [pool[i::conc] for i in range(conc)]
+
+        def worker(shard):
+            mine, bad = [], 0
+            for k in shard:
+                t0 = time.perf_counter()
+                a = srv.kselect(dataset, k, tier=tier)
+                mine.append(time.perf_counter() - t0)
+                if a.tier == "sketch":
+                    v_lo, v_hi = a.value_bounds
+                    if not v_lo <= s_host[k - 1] <= v_hi:
+                        bad += 1
+                elif int(a.value) != ref[k]:
+                    bad += 1
+            with lock:
+                lat.extend(mine)
+                if bad:
+                    mismatches.append(bad)
+
+        threads = [
+            threading.Thread(target=worker, args=(sh,)) for sh in shards if sh
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        if mismatches:
+            exact = False
+        lat.sort()
+        return {
+            "qps": round(len(lat) / max(wall, 1e-9), 1),
+            "p50_ms": round(lat[len(lat) // 2] * 1e3, 3),
+            "p99_ms": round(
+                lat[min(len(lat) - 1, (99 * len(lat)) // 100)] * 1e3, 3
+            ),
+        }
+
+    # -- cold vs warm first-query (ISSUE 18): distinct n per leg so the
+    # process-wide jit cache cannot lend either leg the other's compile
+    first_query = {}
+    compile_books = {}
+    for leg, warm, extra in (("cold", False, 4099), ("warm", True, 8209)):
+        n_leg = n + extra
+        x_leg = datagen.generate(n_leg, pattern="uniform", seed=13, dtype=np.int32)
+        k_probe = 1 + (n_leg // 3)
+        v_ref = np.asarray(api.kselect(x_leg, k_probe)).item()
+        with KSelectServer() as srv:
+            reg0 = LEDGER.snapshot()
+            srv.add_dataset("fq", x_leg, warmup=warm)
+            snap0 = LEDGER.snapshot()
+            t0 = time.perf_counter()
+            a = srv.kselect("fq", k_probe, tier="exact")
+            first_query[leg] = round(time.perf_counter() - t0, 6)
+            if int(a.value) != v_ref:
+                exact = False
+            on_path = snapshot_delta(snap0, LEDGER.snapshot())["sites"].get(
+                "serve.programs", {}
+            )
+            reg_book = snapshot_delta(reg0, snap0)["sites"].get(
+                "serve.programs", {}
+            )
+            compile_books[leg] = {
+                "registration_compiles": reg_book.get("compiles", 0),
+                "registration_compile_seconds": round(
+                    reg_book.get("compile_seconds", 0.0), 6
+                ),
+                "on_path_compiles": on_path.get("compiles", 0),
+                "on_path_compile_seconds": round(
+                    on_path.get("compile_seconds", 0.0), 6
+                ),
+            }
+    warm_excludes_compile_wall = compile_books["warm"]["on_path_compiles"] == 0
+
+    # -- sketch-tier fast path on/off (ISSUE 18): the same query storm
+    # against the same bits, answered inline vs through the lane
+    fast_pool = [1 + (i * 104729) % n for i in range(4 * queries_per_cell)]
+    fastpath_out = {}
+    for label, enabled in (("on", True), ("off", False)):
+        with KSelectServer(window=0.002, fast_path=enabled) as srv:
+            srv.add_dataset("bench", x)
+            srv.kselect("bench", 1, tier="sketch")  # open the path once
+            fastpath_out[label] = {
+                str(conc): storm(srv, "bench", "sketch", conc, fast_pool)
+                for conc in (1, 8, 64)
+            }
+    fastpath_speedup_64 = round(
+        fastpath_out["on"]["64"]["qps"]
+        / max(fastpath_out["off"]["64"]["qps"], 1e-9),
+        2,
+    )
 
     obs = Observability(metrics=MetricsRegistry())
-    exact = True
     tiers_out = {}
     with KSelectServer(window=0.002, obs=obs) as srv:
         srv.add_dataset("bench", x)
         srv.kselect("bench", 1, tier="exact")  # warm compile + cache
         for tier in ("sketch", "exact", "auto"):
-            per_conc = {}
-            for conc in (1, 8, 64):
-                lat: list[float] = []
-                mismatches = []
-                lock = threading.Lock()
-                shards = [ks_pool[i::conc] for i in range(conc)]
-
-                def worker(shard):
-                    mine, bad = [], 0
-                    for k in shard:
-                        t0 = time.perf_counter()
-                        a = srv.kselect("bench", k, tier=tier)
-                        mine.append(time.perf_counter() - t0)
-                        if a.tier == "sketch":
-                            v_lo, v_hi = a.value_bounds
-                            if not v_lo <= s_host[k - 1] <= v_hi:
-                                bad += 1
-                        elif int(a.value) != ref[k]:
-                            bad += 1
-                    with lock:
-                        lat.extend(mine)
-                        if bad:
-                            mismatches.append(bad)
-
-                threads = [
-                    threading.Thread(target=worker, args=(sh,))
-                    for sh in shards
-                    if sh
-                ]
-                t0 = time.perf_counter()
-                for t in threads:
-                    t.start()
-                for t in threads:
-                    t.join()
-                wall = time.perf_counter() - t0
-                if mismatches:
-                    exact = False
-                lat.sort()
-                per_conc[str(conc)] = {
-                    "qps": round(len(lat) / max(wall, 1e-9), 1),
-                    "p50_ms": round(lat[len(lat) // 2] * 1e3, 3),
-                    "p99_ms": round(lat[min(len(lat) - 1, (99 * len(lat)) // 100)] * 1e3, 3),
-                }
-            tiers_out[tier] = per_conc
+            tiers_out[tier] = {
+                str(conc): storm(srv, "bench", tier, conc, ks_pool)
+                for conc in (1, 8, 64)
+            }
         width = obs.metrics.histogram("serve.batch_width").as_dict()
         cache = srv.collect_metrics().as_dict()
+        lanes = srv.batcher.lane_summary()
     _emit(
         {
             "metric": "serve_kselect_qps",
@@ -1152,6 +1220,11 @@ def bench_serve(on_tpu: bool):
             "window_s": 0.002,
             "queries_per_cell": queries_per_cell,
             "tiers": tiers_out,
+            "first_query_seconds": first_query,
+            "first_query_compile_books": compile_books,
+            "fastpath_qps": fastpath_out,
+            "fastpath_speedup_64": fastpath_speedup_64,
+            "lanes": lanes,
             "batch_width": {
                 key: width.get(key) for key in ("count", "mean", "max")
             },
@@ -1162,7 +1235,9 @@ def bench_serve(on_tpu: bool):
             "exact_match": bool(exact),
         }
     )
-    return exact
+    return bool(
+        exact and warm_excludes_compile_wall and fastpath_speedup_64 >= 2.0
+    )
 
 
 def bench_chaos(on_tpu: bool):

@@ -707,8 +707,11 @@ class ServeHandlerCompile(Rule):
         "issue one query. All compile-bearing callables under serve/ are "
         "built ONCE in serve/registry.py and reused through its keyed "
         "ProgramCache (hit/miss counters exported as "
-        "`serve.program_cache.*`); handler code (server, batcher, tiers, "
-        "http) dispatches through cached programs only."
+        "`serve.program_cache.*`); handler code (server, batcher, lanes, "
+        "tiers, http) dispatches through cached programs only — the "
+        "per-device dispatch lanes (serve/lanes.py) route work, they "
+        "never compile it, and registration-time warmup pre-builds "
+        "through the same cache."
     )
 
     _SANCTIONED = ("serve/registry.py",)

@@ -686,6 +686,26 @@ def build_serve_parser() -> argparse.ArgumentParser:
         help="coalesced-request ceiling per dispatch",
     )
     p.add_argument(
+        "--warmup", action="store_true",
+        help="pre-build the dataset's selection programs (cached sort, "
+        "walk closure + its width-1 compile, sketch pin path) at "
+        "registration, so the first client query excludes the compile "
+        "wall (the ledger's serve.programs book proves it)",
+    )
+    p.add_argument(
+        "--lanes", default="auto", metavar="N|auto",
+        help="dispatch lanes: 'auto' (default) opens one supervised "
+        "dispatch thread per distinct execution device; an integer "
+        "folds devices onto N lanes (1 = the single-thread PR 7 "
+        "batcher; answers bit-identical at every setting)",
+    )
+    p.add_argument(
+        "--no-fast-path", action="store_true",
+        help="route sketch-tier (and auto-pinned) answers through the "
+        "dispatch lane instead of answering inline on the request "
+        "thread — the bit-for-bit oracle for the default fast path",
+    )
+    p.add_argument(
         "--quit-after", type=int, default=None, metavar="N",
         help="serve N HTTP requests, then exit cleanly (smoke/testing; "
         "default: serve until interrupted)",
@@ -735,10 +755,17 @@ def serve_main(argv=None) -> int:
         if args.latency_windows
         else None
     )
+    try:
+        lanes = args.lanes if args.lanes == "auto" else int(args.lanes)
+    except ValueError:
+        raise SystemExit(
+            f"error: --lanes must be 'auto' or an integer, got {args.lanes!r}"
+        ) from None
     with maybe_x64(x64_needed):
         server = KSelectServer(
             window=args.batch_window, max_batch=args.max_batch, obs=obs,
             latency_windows=latency_windows,
+            fast_path=not args.no_fast_path, lanes=lanes,
             flight=True if args.debug_bundle else None,
         )
         try:
@@ -748,6 +775,7 @@ def serve_main(argv=None) -> int:
                 server.add_dataset(
                     args.dataset_id,
                     source=_chunk_source(args),
+                    warmup=args.warmup,
                     sketch=not args.no_sketch,
                     sketch_bits=args.sketch_bits,
                     sketch_levels=args.sketch_levels,
@@ -759,6 +787,7 @@ def serve_main(argv=None) -> int:
                 server.add_dataset(
                     args.dataset_id,
                     x,
+                    warmup=args.warmup,
                     sketch=not args.no_sketch,
                     sketch_bits=args.sketch_bits,
                     sketch_levels=args.sketch_levels,

@@ -54,8 +54,11 @@ HELP_TEXTS = {
     "phase.calls": "Calls per PhaseTimer phase",
     "serve.queries": "Requests answered, by answering tier and op",
     "serve.latency_seconds": "Per-request wall latency by answering tier",
-    "serve.queue_depth": "Dispatch-queue depth sampled at every submit",
+    "serve.queue_depth": "Per-lane dispatch-queue depth at every submit",
     "serve.batch_width": "Total rank width of each coalesced dispatch",
+    "serve.fastpath": "Sketch-tier answers served on the request thread",
+    "serve.warmup_compiles": "Programs pre-built by add_dataset warmup",
+    "serve.lanes": "Dispatch lanes currently open (one per device)",
     "monitor.quantile": "Continuous windowed quantile stream (monitor/)",
     "monitor.window_n": "Merged live-window count of the monitor",
     "monitor.epoch": "Window advances completed by the monitor",

@@ -32,8 +32,11 @@ KSEL_PREFIX = "ksel-"
 
 #: streaming/pipeline.py ChunkPipeline producer threads.
 PIPELINE_THREAD_PREFIX = "ksel-pipeline"
-#: serve/ threads: the batcher's supervised dispatch thread, the HTTP
-#: accept loop and per-request handlers.
+#: serve/ threads: the per-device dispatch-lane threads (serve/lanes.py
+#: names each lane's supervised QueryBatcher thread
+#: ``ksel-serve-lane-<key>-dispatch-*``; a standalone batcher keeps
+#: ``ksel-serve-dispatch-*``), the HTTP accept loop and per-request
+#: handlers.
 SERVE_THREAD_PREFIX = "ksel-serve"
 #: monitor/ metrics-server threads (accept loop + per-request handlers).
 MONITOR_THREAD_PREFIX = "ksel-monitor"
@@ -111,8 +114,12 @@ THREAD_OWNER_CALLS = frozenset()
 #: The conftest-recognized supervisor slots: attributes whose owners
 #: join their threads on every close path (ChunkPipeline._thread,
 #: QueryBatcher._thread, the HTTP servers' _serve_thread and tracked
-#: _req_threads list in serve/http.py and monitor/monitor.py).
-THREAD_OWNER_ATTRS = frozenset({"_thread", "_serve_thread", "_req_threads"})
+#: _req_threads list in serve/http.py and monitor/monitor.py, and the
+#: LaneDispatcher's _lanes map in serve/lanes.py — each lane is a whole
+#: QueryBatcher whose close() joins its own _thread).
+THREAD_OWNER_ATTRS = frozenset(
+    {"_thread", "_serve_thread", "_req_threads", "_lanes"}
+)
 THREAD_TYPES = frozenset({"Thread"})
 
 # ---------------------------------------------------------------------------

@@ -9,12 +9,15 @@ top-k / rank-certificate queries from many concurrent clients:
 - **registry** (serve/registry.py) — immutable resident shards keyed by
   dataset id + the ``StagingPool``-style keyed program cache (compiled
   walk closures, cached sorts) so repeat query shapes never recompile;
-- **batcher** (serve/batcher.py) — one dispatch thread with a bounded
-  coalescing window turns concurrent rank queries into one shared-pass
-  ``kselect_many`` walk, bit-identical to serial execution;
-- **tiers** (serve/tiers.py) — ``sketch`` (instant, exact error bounds
-  attached), ``exact`` (the real descent), ``auto`` (sketch when it
-  already pins the answer, escalate otherwise);
+- **batcher + lanes** (serve/batcher.py, serve/lanes.py) — one
+  supervised dispatch lane per execution device; each lane's bounded
+  coalescing window turns concurrent rank queries against its datasets
+  into one shared-pass ``kselect_many`` walk, bit-identical to serial
+  execution (``lanes=1`` is the single-thread degenerate case);
+- **tiers** (serve/tiers.py) — ``sketch`` (instant — answered on the
+  request thread with the default ``fast_path=True`` — with exact error
+  bounds attached), ``exact`` (the real descent), ``auto`` (sketch when
+  it already pins the answer, escalate otherwise);
 - **http** (serve/http.py) — stdlib JSON-over-HTTP front +
   ``/metrics`` Prometheus exposition; CLI: ``python -m
   mpi_k_selection_tpu serve ...``.
@@ -43,6 +46,7 @@ from mpi_k_selection_tpu.serve.http import (
     KSelectHTTPServer,
     start_http_server,
 )
+from mpi_k_selection_tpu.serve.lanes import LaneDispatcher, lane_key_for
 from mpi_k_selection_tpu.serve.registry import (
     DatasetRegistry,
     ProgramCache,
@@ -59,6 +63,7 @@ __all__ = [
     "DispatchCrashedError",
     "KSelectHTTPServer",
     "KSelectServer",
+    "LaneDispatcher",
     "PendingQuery",
     "ProgramCache",
     "QueryBatcher",
@@ -70,5 +75,6 @@ __all__ = [
     "ServerClosedError",
     "ServerOverloadedError",
     "TIERS",
+    "lane_key_for",
     "start_http_server",
 ]

@@ -8,11 +8,17 @@ every histogram pass across all ranks, and
 should flip to one sort). This module turns concurrent arrivals into
 that shape:
 
-- **One dispatch thread** (``ksel-serve-dispatch-*``) owns ALL device
-  work. Requests enqueue and block on a per-request event; the thread
-  drains the queue, coalesces, executes, and wakes them. Serializing
-  device work on one thread is what makes concurrent answers
+- **One dispatch thread per batcher** (``ksel-serve-dispatch-*``, or a
+  lane name when serve/lanes.py owns it) owns all device work routed to
+  it. Requests enqueue and block on a per-request event; the thread
+  drains the queue, coalesces, executes, and wakes them. Serializing a
+  dataset's device work on one thread is what makes concurrent answers
   bit-identical to serial execution: there is no interleaving to vary.
+  The server composes one batcher per execution device
+  (:class:`~mpi_k_selection_tpu.serve.lanes.LaneDispatcher`) — each
+  dataset always lands in the same lane, so per-dataset serialization
+  (the determinism requirement) is preserved while datasets resident on
+  different chips answer concurrently.
 - **Bounded coalescing window**: when the first request of a batch
   arrives the thread waits at most ``window`` seconds (a plain
   ``Event.wait`` — KSL004: no raw clock reads here) for more to arrive,
@@ -176,6 +182,7 @@ class QueryBatcher:
         observe_shed=None,
         observe_expired=None,
         observe_restart=None,
+        name: str | None = None,
     ):
         self._execute_ranks = execute_ranks
         self.window = validate_window(window)
@@ -189,6 +196,8 @@ class QueryBatcher:
         self._observe_restart = observe_restart
         #: dispatch-loop supervisor restarts (serve.dispatch_restarts)
         self.restarts = 0
+        #: queries admitted by submit() (per-lane occupancy figure)
+        self.submitted = 0  # ksel: guarded-by[_submit_lock]
         self._inflight: list = []  # the batch being dispatched right now
         self._q: queue.Queue = queue.Queue()
         # serializes submit's check+put against close's final drain, so a
@@ -196,9 +205,18 @@ class QueryBatcher:
         # drain — a queued request can never be left waiting forever
         self._submit_lock = threading.Lock()
         self._stop = threading.Event()
+        # a lane owner (serve/lanes.py) passes its lane name; the prefix
+        # contract (conftest leak fixture + KSL021) holds either way
+        if name is None:
+            name = f"{SERVE_THREAD_PREFIX}-dispatch-{next(self._ids)}"
+        elif not name.startswith(SERVE_THREAD_PREFIX):
+            raise ValueError(
+                f"dispatch thread name {name!r} must carry the "
+                f"{SERVE_THREAD_PREFIX!r} prefix (conftest leak contract)"
+            )
         self._thread = threading.Thread(
             target=self._run,
-            name=f"{SERVE_THREAD_PREFIX}-dispatch-{next(self._ids)}",
+            name=name,
             daemon=True,
         )
         self._thread.start()
@@ -223,6 +241,7 @@ class QueryBatcher:
                 )
             if self._observe_depth is not None:
                 self._observe_depth(depth)
+            self.submitted += 1
             self._q.put(item)
         return item
 
@@ -369,3 +388,8 @@ class QueryBatcher:
     @property
     def closed(self) -> bool:
         return self._stop.is_set()
+
+    @property
+    def depth(self) -> int:
+        """Current dispatch-queue depth (approximate — the queue moves)."""
+        return self._q.qsize()

@@ -24,7 +24,8 @@ Endpoints:
   sliding-window quantile gauges with exact bounds
   (``ksel_serve_latency_seconds_windowed{tier=,quantile=}`` — see
   obs/windows.py and docs/OBSERVABILITY.md "Continuous monitoring").
-- ``GET /healthz`` — liveness + dataset count.
+- ``GET /healthz`` — liveness + dataset count + hot-path shape (the
+  ``fast_path`` setting and the live dispatch-lane count).
 
 Threading: ``ThreadingHTTPServer`` with NAMED request threads
 (``ksel-serve-req-*``) tracked and joined on ``server_close()`` — the
@@ -180,7 +181,12 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/healthz":
             self._send(
                 200,
-                {"status": "ok", "datasets": len(self.kserver.registry)},
+                {
+                    "status": "ok",
+                    "datasets": len(self.kserver.registry),
+                    "fast_path": self.kserver.fast_path,
+                    "lanes": self.kserver.batcher.lane_count,
+                },
             )
         elif self.path == "/v1/datasets":
             self._send(200, {"datasets": self.kserver.list_datasets()})

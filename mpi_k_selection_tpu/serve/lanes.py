@@ -1,0 +1,221 @@
+"""Per-device dispatch lanes — one supervised batcher per execution
+device.
+
+PR 7's batcher serialized ALL device work on one dispatch thread. That
+is stronger than determinism needs: answers must be bit-identical to
+serial execution PER DATASET (one dataset's coalesced walks must not
+interleave), but two datasets resident on DIFFERENT devices share no
+state at all — serializing them through one thread only makes one
+chip's slow walk block another chip's fast one. This module keeps the
+per-dataset guarantee and drops the accidental global one:
+
+- **Lane key**: every resolved dataset maps to a deterministic lane key
+  (:func:`lane_key_for`) — the sorted committed device set for device
+  residency, ``"host"`` for the host-exact route, ``"stream"`` for
+  out-of-core datasets (streamed descents manage their own staging
+  devices; serializing them against each other preserves PR 7's
+  behavior for the shared staging pool). A dataset's key never changes
+  (resident shards are immutable), so all of its queries always land in
+  the same lane and coalesce exactly as before.
+- **Lanes are whole batchers**: each lane is a full
+  :class:`~mpi_k_selection_tpu.serve.batcher.QueryBatcher` — coalescing
+  window, deadline drops, admission control (``max_depth`` bounds EACH
+  lane's queue), and supervised restarts all keep their PR 7 semantics
+  inside the lane. A crash in one lane's loop restarts only that lane;
+  the others never notice (tests/test_serve_lanes.py).
+- **Lane count**: ``lanes="auto"`` (default) opens one lane per
+  distinct key, lazily at first query. An integer ``lanes=N`` folds
+  keys onto N lanes by CRC32 (a stable hash — ``hash()`` is
+  process-seeded and KSL024 bars nondeterministic placement);
+  ``lanes=1`` degenerates to exactly today's single batcher,
+  bit-for-bit.
+
+Threads are named ``ksel-serve-lane-<key>-dispatch-*`` — the
+``ksel-serve`` family (resource_protocols.py), so the conftest
+leaked-thread fixture and the KSL021 lifecycle pass cover lane threads
+with no new vocabulary. ``close()`` closes every lane (joins every
+dispatch thread) on all exit paths.
+"""
+
+from __future__ import annotations
+
+import threading
+import zlib
+
+from mpi_k_selection_tpu.resource_protocols import SERVE_THREAD_PREFIX
+from mpi_k_selection_tpu.serve.batcher import DEFAULT_MAX_BATCH, QueryBatcher
+from mpi_k_selection_tpu.serve.errors import ServerClosedError
+
+
+def lane_key_for(ds) -> str:
+    """The dispatch-lane key of one resolved dataset: a pure function
+    of the dataset's (immutable) residency, so every query against it
+    lands in the same lane forever. Device residency keys by the sorted
+    committed device set (a sharded array spanning devices gets one
+    combined lane — its walk already fans across those chips)."""
+    residency = getattr(ds, "residency", None)
+    if residency == "device":
+        try:
+            devices = ds.data.devices()
+        except AttributeError:
+            return "device"
+        return "+".join(sorted(str(d) for d in devices))
+    if residency in ("host", "stream"):
+        return residency
+    return "default"
+
+
+def validate_lanes(lanes):
+    """``"auto"`` or an int >= 1."""
+    if lanes == "auto":
+        return lanes
+    n = int(lanes)
+    if n < 1:
+        raise ValueError(f"lanes={lanes!r} must be 'auto' or an int >= 1")
+    return n
+
+
+class LaneDispatcher:
+    """The server's dispatch surface: routes each
+    :class:`~mpi_k_selection_tpu.serve.batcher.PendingQuery` to its
+    dataset's lane, creating lanes lazily. Presents the same submit/
+    restarts/closed/close surface as one ``QueryBatcher`` (the PR 7
+    server's tests drive it unchanged); ``observe_depth`` and
+    ``observe_restart`` gain a trailing ``lane`` name argument so the
+    metrics can carry the per-lane label."""
+
+    def __init__(
+        self,
+        execute_ranks,
+        *,
+        lanes="auto",
+        window: float = 0.0,
+        max_batch: int = DEFAULT_MAX_BATCH,
+        max_depth: int | None = None,
+        retry_after: float = 1.0,
+        observe_depth=None,
+        observe_width=None,
+        observe_shed=None,
+        observe_expired=None,
+        observe_restart=None,
+    ):
+        self.lanes = validate_lanes(lanes)
+        self._execute_ranks = execute_ranks
+        self._window = window
+        self._max_batch = max_batch
+        self._max_depth = max_depth
+        self._retry_after = retry_after
+        self._observe_depth = observe_depth
+        self._observe_width = observe_width
+        self._observe_shed = observe_shed
+        self._observe_expired = observe_expired
+        self._observe_restart = observe_restart
+        self._lock = threading.Lock()
+        self._lanes: dict[str, QueryBatcher] = {}  # ksel: guarded-by[_lock]
+        self._stop = False  # ksel: guarded-by[_lock]
+
+    # -- routing -----------------------------------------------------------
+
+    def _lane_name(self, ds) -> str:
+        key = lane_key_for(ds)
+        if self.lanes == "auto":
+            return key
+        if self.lanes == 1:
+            # the single-lane degenerate case IS today's batcher: one
+            # thread, one queue, every dataset serialized through it
+            return "lane0"
+        return f"lane{zlib.crc32(key.encode()) % self.lanes}"
+
+    def _lane_for(self, ds) -> QueryBatcher:
+        name = self._lane_name(ds)
+        with self._lock:
+            if self._stop:
+                raise ServerClosedError("server is closed; query rejected")
+            lane = self._lanes.get(name)
+            if lane is None:
+                lane = QueryBatcher(
+                    self._execute_ranks,
+                    window=self._window,
+                    max_batch=self._max_batch,
+                    max_depth=self._max_depth,
+                    retry_after=self._retry_after,
+                    observe_depth=self._wrap_depth(name),
+                    observe_width=self._observe_width,
+                    observe_shed=self._observe_shed,
+                    observe_expired=self._observe_expired,
+                    observe_restart=self._wrap_restart(name),
+                    name=f"{SERVE_THREAD_PREFIX}-lane-{name}-dispatch",
+                )
+                self._lanes[name] = lane
+        return lane
+
+    def _wrap_depth(self, name: str):
+        if self._observe_depth is None:
+            return None
+        return lambda depth: self._observe_depth(depth, name)
+
+    def _wrap_restart(self, name: str):
+        if self._observe_restart is None:
+            return None
+        return lambda exc: self._observe_restart(exc, name)
+
+    # -- the QueryBatcher surface ------------------------------------------
+
+    def submit(self, item):
+        """Route to the item's dataset lane (created on first use) and
+        enqueue — admission control and closed checks are the lane's."""
+        return self._lane_for(item.ds).submit(item)
+
+    @property
+    def restarts(self) -> int:
+        """Supervisor restarts summed over every lane (the
+        ``serve.dispatch_restarts`` figure)."""
+        with self._lock:
+            lanes = list(self._lanes.values())
+        return sum(lane.restarts for lane in lanes)
+
+    @property
+    def closed(self) -> bool:
+        with self._lock:
+            return self._stop
+
+    @property
+    def depth(self) -> int:
+        """Queued queries summed over every lane (approximate)."""
+        with self._lock:
+            lanes = list(self._lanes.values())
+        return sum(lane.depth for lane in lanes)
+
+    @property
+    def lane_count(self) -> int:
+        with self._lock:
+            return len(self._lanes)
+
+    def lane_summary(self) -> dict:
+        """Per-lane occupancy snapshot: ``{lane: {submitted,
+        queue_depth, restarts}}`` — the /debug/bundle "lanes" section
+        and the tpu_smoke occupancy print."""
+        with self._lock:
+            lanes = dict(self._lanes)
+        return {
+            name: {
+                "submitted": int(lane.submitted),
+                "queue_depth": int(lane.depth),
+                "restarts": int(lane.restarts),
+            }
+            for name, lane in sorted(lanes.items())
+        }
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self) -> None:
+        """Stop admitting (new lanes AND new submits), then drain and
+        join every lane's dispatch thread. Idempotent; a submit racing
+        close either fails here-or-there with
+        :class:`~mpi_k_selection_tpu.serve.errors.ServerClosedError` or
+        is drained by its lane's own close."""
+        with self._lock:
+            self._stop = True
+            lanes = list(self._lanes.values())
+        for lane in lanes:
+            lane.close()

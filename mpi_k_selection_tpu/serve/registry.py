@@ -29,9 +29,12 @@ many queries" is exactly the state this module owns:
 
 Concurrency discipline: datasets are immutable once registered (host
 arrays are defensively copied and marked read-only; device arrays are
-immutable by construction), the registry dict is guarded by one lock, and
-all device work runs on the server's single dispatch thread
-(serve/batcher.py) — the registry itself never starts a thread.
+immutable by construction), the registry dict is guarded by one lock,
+and each dataset's device work runs on exactly one dispatch-lane thread
+(serve/batcher.py routed by serve/lanes.py) — the registry itself never
+starts a thread. :class:`ProgramCache` is safe for concurrent lanes:
+builds run behind a per-key latch, so two lanes racing a first query
+never compile the same program twice.
 """
 
 from __future__ import annotations
@@ -70,6 +73,9 @@ class ProgramCache:
     def __init__(self, *, max_entries: int = 64):
         self._lock = threading.Lock()
         self._entries: collections.OrderedDict = collections.OrderedDict()  # ksel: guarded-by[_lock]
+        #: per-key build latches: key -> Event set when that build ends
+        #: (success OR failure) — the thundering-herd gate
+        self._building: dict = {}  # ksel: guarded-by[_lock]
         self.max_entries = int(max_entries)
         self.hits = 0
         self.misses = 0
@@ -80,29 +86,51 @@ class ProgramCache:
 
     def get_or_build(self, key, builder):
         """The cached value for ``key``, building (and caching) it on the
-        first request. The build runs OUTSIDE the lock — it may compile;
-        the server's single dispatch thread means no duplicate-build race
-        in practice, and a concurrent duplicate would only waste work,
-        never corrupt (last write wins on an identical value)."""
-        with self._lock:
-            hit = key in self._entries
-            if hit:
-                self.hits += 1
-                self._entries.move_to_end(key)
-                value = self._entries[key]
-            else:
-                self.misses += 1
-        # ledger reporting OUTSIDE the cache lock (the ledger locks itself)
-        if hit:
-            _ldg.LEDGER.note_hit(self.LEDGER_SITE, key)
-            return value
-        with _ldg.LEDGER.compile_span(self.LEDGER_SITE, key, obs=self.obs):
-            value = builder()
+        first request. The build runs OUTSIDE the lock — it may compile
+        for seconds — behind a per-key latch: the first caller installs
+        the latch and builds; concurrent callers for the SAME key wait
+        on the latch and take the finished value as a HIT (one compile,
+        one ledger entry — the thundering-herd fix; two racing first
+        queries used to compile the same program twice). If the build
+        RAISES, waiters retry the build themselves rather than caching
+        the failure."""
+        while True:
+            with self._lock:
+                if key in self._entries:
+                    self.hits += 1
+                    self._entries.move_to_end(key)
+                    value = self._entries[key]
+                    latch = None
+                else:
+                    latch = self._building.get(key)
+                    if latch is None:
+                        # we are the builder for this key
+                        self.misses += 1
+                        self._building[key] = threading.Event()
+                        break
+            # ledger reporting OUTSIDE the cache lock (it locks itself)
+            if latch is None:
+                _ldg.LEDGER.note_hit(self.LEDGER_SITE, key)
+                return value
+            # another thread is building this key: wait for its latch,
+            # then re-enter — the entry is there (our hit), or the build
+            # failed / the entry was LRU-evicted meanwhile (we rebuild)
+            latch.wait()
+        try:
+            with _ldg.LEDGER.compile_span(self.LEDGER_SITE, key, obs=self.obs):
+                value = builder()
+        except BaseException:
+            with self._lock:
+                self._building.pop(key).set()
+            raise
         with self._lock:
             self._entries[key] = value
             self._entries.move_to_end(key)
             while len(self._entries) > self.max_entries:
                 self._entries.popitem(last=False)
+            # release waiters only AFTER the entry is visible, so every
+            # waiter's re-entry counts a clean hit
+            self._building.pop(key).set()
         return value
 
     def drop_dataset(self, dataset_id: str) -> None:
@@ -440,6 +468,75 @@ class DatasetRegistry:
             lambda: self._build_walk(ds),
         )
         return np.asarray(fn(ks))
+
+    # -- registration-time warmup ------------------------------------------
+
+    def warmup(self, ds: ResidentDataset) -> int:
+        """Pre-build every program :meth:`select_many` can reach for
+        this dataset — through :class:`ProgramCache`, so the compile
+        wall is clocked under the ledger's ``compile_span`` at
+        registration time instead of landing on the first client.
+        Returns the number of programs built (the cache misses this
+        call caused; 0 when everything was already resident).
+
+        The warm builders go one step further than the lazy ones: they
+        FORCE the first execution (``block_until_ready`` on the cached
+        sort, one width-1 walk call) so jax's trace+compile happens
+        inside the span too — after this, a warmed dataset's
+        steady-state query mix records ZERO on-path compiles at the
+        ``serve.programs`` site (the tier-1 gate in
+        tests/test_serve_lanes.py). Walk widths other than 1 still
+        jit-specialize on first use — that cost is per width, inside
+        jax, and invisible to the program cache by design (the closure
+        is keyed per dataset, not per width)."""
+        miss0 = self.programs.misses
+        if ds.residency == "stream":
+            # the streamed descent's closure is host logic; its device
+            # programs belong to the streaming layer's own caches.
+            # Building the closure here still takes the first query's
+            # cache miss off the request path
+            self.programs.get_or_build(
+                ("stream_select", ds.dataset_id),
+                lambda: self._build_stream_select(ds),
+            )
+        else:
+            self.programs.get_or_build(
+                ("sorted", ds.dataset_id),
+                lambda: self._build_sorted_warm(ds),
+            )
+            if ds.n > 1 << 14:
+                # large datasets dispatch narrow batches to the walk
+                self.programs.get_or_build(
+                    ("walk", ds.dataset_id),
+                    lambda: self._build_walk_warm(ds),
+                )
+        built = self.programs.misses - miss0
+        if ds.sketch is not None:
+            # the sketch fast path is pure numpy, but its first pin/
+            # bounds touch materializes the pyramid's cumulative views —
+            # warm those reads too so the first sketch answer is steady
+            ds.sketch.pin(1)
+            ds.sketch.rank_bounds(1)
+            ds.sketch.value_bounds(1)
+        return built
+
+    @staticmethod
+    def _build_sorted_warm(ds: ResidentDataset):
+        """:meth:`_build_sorted` plus a device sync, so the sort's
+        compile+execute wall lands inside the warmup compile span."""
+        s = DatasetRegistry._build_sorted(ds)
+        if not isinstance(s, np.ndarray):
+            s.block_until_ready()
+        return s
+
+    @staticmethod
+    def _build_walk_warm(ds: ResidentDataset):
+        """:meth:`_build_walk` plus one width-1 execution: the walk's
+        jit trace+compile for the single-rank shape happens here, inside
+        the warmup compile span, not on the first client's query."""
+        fn = DatasetRegistry._build_walk(ds)
+        np.asarray(fn([1]))
+        return fn
 
     @staticmethod
     def _build_sorted(ds: ResidentDataset):

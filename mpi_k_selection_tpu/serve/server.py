@@ -2,26 +2,38 @@
 
 Composes the subsystem: a :class:`~mpi_k_selection_tpu.serve.registry.
 DatasetRegistry` (resident shards + keyed program cache), a
-:class:`~mpi_k_selection_tpu.serve.batcher.QueryBatcher` (one dispatch
-thread, bounded coalescing window), and the latency tiers
-(serve/tiers.py). The HTTP front (serve/http.py) and the CLI ``serve``
-mode are thin shells over this class; embedding callers use it directly::
+:class:`~mpi_k_selection_tpu.serve.lanes.LaneDispatcher` (one supervised
+dispatch lane per execution device, each a bounded-coalescing-window
+:class:`~mpi_k_selection_tpu.serve.batcher.QueryBatcher`), and the
+latency tiers (serve/tiers.py). The HTTP front (serve/http.py) and the
+CLI ``serve`` mode are thin shells over this class; embedding callers
+use it directly::
 
     from mpi_k_selection_tpu.serve import KSelectServer
 
     with KSelectServer(window=0.002) as srv:
-        srv.add_dataset("logits", x)             # shard/convert ONCE
+        srv.add_dataset("logits", x, warmup=True)  # shard+compile ONCE
         a = srv.kselect("logits", k, tier="auto")
         qs = srv.quantiles("logits", [0.5, 0.99], tier="sketch")
         qs[0].rank_error_bound                   # bounds always attached
 
-Guarantees (tested in tests/test_serve.py):
+Guarantees (tested in tests/test_serve.py, tests/test_serve_lanes.py):
 
 - **Determinism**: answers are bit-identical to serial one-at-a-time
   ``api.kselect``/``api.quantiles`` calls, for every tier, dataset
-  residency, coalescing window, and client concurrency — all device
-  work runs on the single dispatch thread, resident shards are
+  residency, coalescing window, client concurrency, lane layout,
+  ``fast_path`` setting and ``warmup`` setting — each dataset's device
+  work runs on exactly one dispatch-lane thread, resident shards are
   immutable, and exact order statistics are batch-invariant.
+- **Hot-path shape** (docs/API.md "Serving"): sketch-tier answers (and
+  auto-tier answers the sketch pins) are pure numpy reads over an
+  immutable resident pyramid, so with ``fast_path=True`` (default)
+  they are answered directly ON THE REQUEST THREAD — no enqueue, no
+  dispatch wake, counted in ``serve.fastpath{tier=}``.
+  ``fast_path=False`` routes them through the dispatch lane — the
+  bit-for-bit oracle for the fast path (and the qps baseline
+  ``bench_serve`` compares against). Exact-tier work always dispatches
+  through the dataset's lane.
 - **No recompiles on repeat shapes**: compiled walk closures and the
   sort path's descent state live in the registry's keyed program cache
   (``serve.program_cache.{hits,misses}`` mirror its counters exactly).
@@ -46,8 +58,8 @@ from mpi_k_selection_tpu.serve import tiers as _tiers
 from mpi_k_selection_tpu.serve.batcher import (
     DEFAULT_MAX_BATCH,
     PendingQuery,
-    QueryBatcher,
 )
+from mpi_k_selection_tpu.serve.lanes import LaneDispatcher
 from mpi_k_selection_tpu.serve.errors import (
     DeadlineExceededError,
     QueryError,
@@ -100,8 +112,15 @@ class KSelectServer:
     concurrent clients. ``window`` is the batcher's coalescing window in
     seconds (0 = dispatch every request alone).
 
+    Hot-path knobs: ``fast_path`` (default True) answers sketch-tier
+    (and auto-pinned) queries inline on the request thread —
+    ``fast_path=False`` is the queued bit-for-bit oracle; ``lanes``
+    (``"auto"`` = one dispatch lane per distinct execution device, or
+    an explicit int — ``1`` degenerates to the single PR 7 batcher)
+    routes each dataset's exact-tier work to its device's lane.
+
     Resilience knobs (docs/ROBUSTNESS.md): ``max_queue_depth`` bounds
-    the dispatch queue — arrivals past it are shed with
+    each lane's dispatch queue — arrivals past it are shed with
     :class:`~mpi_k_selection_tpu.serve.errors.ServerOverloadedError`
     (HTTP 503 + ``Retry-After``, ``retry_after`` seconds, counted in
     ``serve.load_shed``) instead of queueing unboundedly;
@@ -120,6 +139,8 @@ class KSelectServer:
         max_queue_depth: int | None = None,
         retry_after: float = 1.0,
         default_deadline: float | None = None,
+        fast_path: bool = True,
+        lanes="auto",
         latency_windows=None,
         flight=None,
         obs=None,
@@ -195,8 +216,10 @@ class KSelectServer:
                 self.flight,
             )
         )
-        self.batcher = QueryBatcher(
+        self.fast_path = bool(fast_path)
+        self.batcher = LaneDispatcher(
             self._execute_ranks,
+            lanes=lanes,
             window=window,
             max_batch=max_batch,
             max_depth=max_queue_depth,
@@ -220,12 +243,20 @@ class KSelectServer:
         return self.registry.get(dataset_id)
 
     def add_dataset(
-        self, dataset_id: str, data=None, *, source=None, **kwargs
+        self, dataset_id: str, data=None, *, source=None,
+        warmup: bool = False, **kwargs
     ):
         """Register a dataset: ``data`` (an array — converted/sharded
         once) or ``source`` (a replayable chunk source — sketched once,
-        exact queries re-stream). Keyword options per
-        :meth:`DatasetRegistry.add_array` / :meth:`add_stream`."""
+        exact queries re-stream). ``warmup=True`` additionally
+        pre-builds the dataset's selection programs (cached sort, walk
+        closure with its width-1 compile forced, stream-select closure,
+        sketch pin path) through the program cache at registration time
+        — the compile wall lands here, clocked under the ledger's
+        ``serve.programs`` compile book, instead of on the first client
+        (``serve.warmup_compiles`` counts the programs built). Other
+        keyword options per :meth:`DatasetRegistry.add_array` /
+        :meth:`add_stream`."""
         if self._closed:
             # a post-close registration would re-enter the ledger's
             # resident byte book with nothing left to release it
@@ -236,6 +267,10 @@ class KSelectServer:
             ds = self.registry.add_array(dataset_id, data, **kwargs)
         else:
             ds = self.registry.add_stream(dataset_id, source, **kwargs)
+        if warmup:
+            built = self.registry.warmup(ds)
+            if self.metrics is not None:
+                self.metrics.counter("serve.warmup_compiles").inc(built)
         if self.metrics is not None:
             self.metrics.gauge("serve.datasets").set(len(self.registry))
         return ds
@@ -383,7 +418,25 @@ class KSelectServer:
             with self.timer.phase(
                 "serve.request.sketch", args={"trace_id": tid}
             ):
-                answers = _tiers.sketch_answers(ds, ks)
+                if self.fast_path:
+                    # the sketch is immutable and its reads are pure
+                    # numpy: answer on the request thread — no enqueue,
+                    # no dispatch wake, no lane serialization needed
+                    answers = _tiers.sketch_answers(ds, ks)
+                    if self.metrics is not None:
+                        self.metrics.counter(
+                            "serve.fastpath", labels={"tier": tier}
+                        ).inc()
+                else:
+                    # the queued oracle: same answers, through the lane
+                    pending = self.batcher.submit(
+                        PendingQuery(
+                            ds.dataset_id, "sketch", ds=ds, deadline=dl,
+                            trace_id=tid,
+                            run=lambda: _tiers.sketch_answers(ds, ks),
+                        )
+                    )
+                    answers = self._wait(pending)
             self._account(ds, op, tier, "sketch", len(ks), False, tid)
             return answers
         escalated = tier == "auto"
@@ -453,9 +506,11 @@ class KSelectServer:
                 )
             )
 
-    def _observe_depth(self, depth: int) -> None:
+    def _observe_depth(self, depth: int, lane: str) -> None:
         if self.metrics is not None:
-            self.metrics.histogram("serve.queue_depth").observe(depth)
+            self.metrics.histogram(
+                "serve.queue_depth", labels={"lane": lane}
+            ).observe(depth)
 
     def _observe_width(self, width: int) -> None:
         if self.metrics is not None:
@@ -479,12 +534,12 @@ class KSelectServer:
         if self.metrics is not None:
             self.metrics.counter("serve.deadline_exceeded").inc()
 
-    def _observe_restart(self, exc) -> None:
+    def _observe_restart(self, exc, lane: str) -> None:
         self._fault_obs("serve.dispatch", "restart", exc)
         if self.metrics is not None:
-            # mirror of the batcher's own counter (set, not inc: the
-            # batcher increments BEFORE this hook runs, and collect_
-            # metrics re-mirrors it idempotently)
+            # mirror of the lanes' own counters (set, not inc: the lane
+            # increments BEFORE this hook runs, and collect_metrics
+            # re-mirrors the sum idempotently)
             self.metrics.counter("serve.dispatch_restarts").set(
                 int(self.batcher.restarts)
             )
@@ -546,6 +601,7 @@ class KSelectServer:
         self.metrics.counter("serve.dispatch_restarts").set(
             int(self.batcher.restarts)
         )
+        self.metrics.gauge("serve.lanes").set(self.batcher.lane_count)
         collect_runtime(self.metrics, timer=self.timer)
         # the process ProgramLedger's compile/byte book rides /metrics
         # too (ledger.compiles{site=}, ledger.device_bytes{pool=,device=})
@@ -561,6 +617,8 @@ class KSelectServer:
                 "entries": len(self.registry.programs),
             },
             "dispatch_restarts": int(self.batcher.restarts),
+            "fast_path": self.fast_path,
+            "lanes": self.batcher.lane_summary(),
             "closed": self.batcher.closed,
         }
 
@@ -602,7 +660,8 @@ class KSelectServer:
     # -- lifecycle ---------------------------------------------------------
 
     def close(self) -> None:
-        """Join the dispatch thread; fail queued stragglers. A registry
+        """Join every dispatch-lane thread; fail queued stragglers. A
+        registry
         this server created is closed too (its datasets leave the ledger
         resident byte book); a caller-provided one stays the caller's.
         Idempotent."""

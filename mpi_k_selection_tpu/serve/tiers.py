@@ -88,13 +88,13 @@ def sketch_answers(ds, ks) -> list[RankAnswer]:
     out = []
     for k in ks:
         k = int(k)
-        lo, hi = sk.rank_bounds(k)
-        v_lo, v_hi = sk.value_bounds(k)
-        pinned = sk.pin(k)
+        # one bucket resolution per rank (RadixSketch.describe) — the
+        # separate rank_bounds/value_bounds/pin/query calls each redo it
+        lo, hi, v_lo, v_hi, pinned = sk.describe(k)
         out.append(
             RankAnswer(
                 k=k,
-                value=pinned if pinned is not None else sk.query(k),
+                value=pinned if pinned is not None else v_lo,
                 tier="sketch",
                 exact=pinned is not None,
                 rank_bounds=(lo, hi),

@@ -36,10 +36,12 @@ def _no_leaked_pipeline_threads():
     """Every package-owned thread must be joined by the time its owner
     returns/closes — normally AND on every raise/injected-fault path.
     All such threads carry the ``ksel-`` name prefix (``ksel-pipeline-*``
-    producers, ``ksel-serve-*``: the batcher's SUPERVISED dispatch
-    thread — restarts reuse the same thread, so its name survives a
-    crash-recover cycle — the HTTP serve loop, per-request handlers,
-    ``ksel-monitor-*`` exporters, and any future faults/-layer worker),
+    producers, ``ksel-serve-*``: the per-device dispatch-lane threads
+    (``ksel-serve-lane-<key>-dispatch-*``, serve/lanes.py) and the
+    standalone batcher's SUPERVISED dispatch thread — restarts reuse the
+    same thread, so its name survives a crash-recover cycle — the HTTP
+    serve loop, per-request handlers, ``ksel-monitor-*`` exporters, and
+    any future faults/-layer worker),
     so the fixture matches the prefix family rather than an allowlist a
     new subsystem could silently fall out of. A thread surviving a test
     is a shutdown bug in streaming/pipeline.py, serve/, monitor/ or

@@ -1,0 +1,518 @@
+"""Serve hot-path overhaul (ISSUE 18): sketch fast path, per-device
+dispatch lanes, registration-time warmup, program-cache build latch.
+
+The load-bearing contracts:
+
+- **Determinism grid** — answers are bit-identical to serial
+  ``api.kselect`` across fast_path {on, off} × warmup {on, off} ×
+  tiers × residency (device/host/stream) × concurrency; sketch answers
+  (bounds included) are identical between the fast path and the queued
+  oracle.
+- **Lanes** — datasets on distinct devices get distinct supervised
+  dispatch lanes that answer concurrently; one lane's dispatch crash
+  restarts only that lane; ``lanes=1`` degenerates to the single PR 7
+  batcher. Lane threads carry the ``ksel-serve`` prefix, so the
+  conftest leaked-thread fixture covers them with no new vocabulary.
+- **Warmup** — ``add_dataset(..., warmup=True)`` pre-builds the
+  selection programs through the ProgramCache; the ledger's
+  ``serve.programs`` book then records ZERO on-path compiles for the
+  steady-state query mix (the tier-1 gate of the ISSUE 18 acceptance).
+- **Build latch** — two racing first queries for the same program key
+  compile it ONCE; the second caller waits and counts as a hit (cache
+  counters and the ledger book agree).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from mpi_k_selection_tpu import api
+from mpi_k_selection_tpu import obs as obs_lib
+from mpi_k_selection_tpu.obs import ledger as ldg
+from mpi_k_selection_tpu.serve import (
+    DispatchCrashedError,
+    KSelectServer,
+    LaneDispatcher,
+    PendingQuery,
+    ProgramCache,
+    lane_key_for,
+)
+from mpi_k_selection_tpu.serve import tiers as tiers_mod
+from mpi_k_selection_tpu.serve.registry import ResidentDataset, _build_sketch
+
+# > 2^14 so single exact rank queries take the shared radix walk (the
+# same dispatch api.kselect resolves to at this n)
+N_BIG = 40_000
+
+
+@pytest.fixture
+def x_int32(rng):
+    return rng.integers(-(2**31), 2**31 - 1, size=N_BIG, dtype=np.int32)
+
+
+def _bits(values, dtype):
+    return np.asarray(values, dtype=dtype).tobytes()
+
+
+def _serial_reference(x, ks):
+    return [np.asarray(api.kselect(x, int(k))).item() for k in ks]
+
+
+def _add_host_dataset(srv, dataset_id, x):
+    """Register a HOST-resident dataset directly (the f64-on-TPU route's
+    residency — unreachable through add_dataset on CPU CI, where every
+    array converts to a device array)."""
+    arr = np.ascontiguousarray(x).copy()
+    arr.flags.writeable = False
+    ds = ResidentDataset(
+        dataset_id=dataset_id,
+        residency="host",
+        dtype=np.dtype(arr.dtype),
+        n=int(arr.size),
+        data=arr,
+        sketch=_build_sketch([arr], np.dtype(arr.dtype), 4, 4),
+    )
+    return srv.registry._register(ds)
+
+
+def _answer_bits(answers, dtype):
+    return _bits([a.value for a in answers], dtype)
+
+
+# ---------------------------------------------------------------------------
+# the determinism grid: fast_path x warmup x residency x tier x concurrency
+
+
+@pytest.mark.parametrize("fast_path", [True, False])
+@pytest.mark.parametrize("warmup", [True, False])
+def test_determinism_grid(x_int32, fast_path, warmup):
+    ks = [1, 17, N_BIG // 2, N_BIG]
+    ref = _serial_reference(x_int32, ks)
+    sketch_oracle = None
+    with KSelectServer(window=0.002, fast_path=fast_path) as srv:
+        srv.add_dataset("dev", x_int32, warmup=warmup)
+        host_ds = _add_host_dataset(srv, "host", x_int32)
+        chunks = [c.copy() for c in np.array_split(x_int32, 5)]
+        srv.add_dataset("stream", source=chunks, warmup=warmup)
+        if warmup:
+            srv.registry.warmup(host_ds)
+        for dataset in ("dev", "host", "stream"):
+            for tier in ("exact", "auto"):
+                answers = srv.kselect_many(dataset, ks, tier=tier)
+                assert _answer_bits(answers, np.int32) == _bits(
+                    ref, np.int32
+                ), (dataset, tier)
+                assert all(a.exact for a in answers)
+            # sketch answers: bounds contract + identical to the pure
+            # tiers oracle (the fast path and the queued path must
+            # return THE SAME answers, fields and all)
+            ds = srv.registry.get(dataset)
+            oracle = tiers_mod.sketch_answers(ds, ks)
+            got = srv.kselect_many(dataset, ks, tier="sketch")
+            for a, o in zip(got, oracle):
+                assert (a.value, a.rank_bounds, a.value_bounds) == (
+                    o.value, o.rank_bounds, o.value_bounds,
+                ), dataset
+                assert a.rank_error_bound == o.rank_error_bound
+            if dataset == "dev":
+                sketch_oracle = [(a.value, a.rank_bounds) for a in got]
+        # concurrency: 4 threads per dataset, every answer bit-checked
+        errors = []
+
+        def worker(dataset, my_ks):
+            try:
+                answers = srv.kselect_many(dataset, my_ks, tier="exact")
+                assert _answer_bits(answers, np.int32) == _bits(
+                    _serial_reference(x_int32, my_ks), np.int32
+                )
+            except BaseException as e:  # noqa: BLE001 — surfaced below
+                errors.append((dataset, e))
+
+        shards = [ks, list(reversed(ks)), [7, 9999], [N_BIG - 1]]
+        threads = [
+            threading.Thread(target=worker, args=(dataset, shard))
+            for dataset in ("dev", "host", "stream")
+            for shard in shards
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, errors
+    assert sketch_oracle is not None
+
+
+def test_fast_path_on_off_sketch_bits_identical(x_int32):
+    """The queued oracle (fast_path=False) and the fast path answer the
+    SAME bits, bounds included, rank by rank."""
+    ks = [1, 100, N_BIG // 3, N_BIG]
+    with KSelectServer(fast_path=True) as fast:
+        fast.add_dataset("a", x_int32)
+        a_fast = fast.kselect_many("a", ks, tier="sketch")
+    with KSelectServer(fast_path=False) as queued:
+        queued.add_dataset("a", x_int32)
+        a_queued = queued.kselect_many("a", ks, tier="sketch")
+    for f, q in zip(a_fast, a_queued):
+        assert (f.k, f.value, f.tier, f.exact) == (q.k, q.value, q.tier, q.exact)
+        assert f.rank_bounds == q.rank_bounds
+        assert f.value_bounds == q.value_bounds
+        assert f.rank_error_bound == q.rank_error_bound
+
+
+def test_fastpath_counter_and_routing(rng):
+    """fast_path=True answers sketch/auto-pinned on the request thread
+    (counted in serve.fastpath{tier=}, nothing enqueued); fast_path=False
+    routes the same queries through the dataset's lane."""
+    # int16 keys: the default 4x4 sketch resolves the FULL key width, so
+    # it pins every rank and tier=auto stays on the sketch (the
+    # auto_pins fast-path branch)
+    x = rng.integers(-(2**15), 2**15 - 1, size=N_BIG).astype(np.int16)
+    obs = obs_lib.Observability(metrics=obs_lib.MetricsRegistry())
+    with KSelectServer(obs=obs, fast_path=True) as srv:
+        srv.add_dataset("a", x)
+        srv.kselect("a", 5, tier="sketch")
+        srv.kselect("a", 5, tier="auto")
+        assert obs.metrics.counter(
+            "serve.fastpath", labels={"tier": "sketch"}
+        ).value == 1
+        assert obs.metrics.counter(
+            "serve.fastpath", labels={"tier": "auto"}
+        ).value == 1
+        # nothing was enqueued: the lane map is still empty
+        assert srv.batcher.lane_summary() == {}
+    obs2 = obs_lib.Observability(metrics=obs_lib.MetricsRegistry())
+    with KSelectServer(obs=obs2, fast_path=False) as srv:
+        srv.add_dataset("a", x)
+        a = srv.kselect("a", 5, tier="sketch")
+        assert a.tier == "sketch" and a.rank_bounds is not None
+        assert obs2.metrics.counter(
+            "serve.fastpath", labels={"tier": "sketch"}
+        ).value == 0
+        summary = srv.batcher.lane_summary()
+        assert sum(s["submitted"] for s in summary.values()) == 1
+
+
+# ---------------------------------------------------------------------------
+# per-device dispatch lanes
+
+
+def _two_device_arrays(x):
+    devs = jax.devices()
+    if len(devs) < 2:
+        pytest.skip("needs >= 2 devices (xla_force_host_platform_device_count)")
+    return (
+        jax.device_put(x, devs[0]),
+        jax.device_put(np.roll(x, 7), devs[1]),
+        devs,
+    )
+
+
+def test_lane_per_device_and_keys(x_int32):
+    xa, xb, devs = _two_device_arrays(x_int32)
+    with KSelectServer() as srv:
+        srv.add_dataset("a", xa)
+        srv.add_dataset("b", xb)
+        assert lane_key_for(srv.registry.get("a")) != lane_key_for(
+            srv.registry.get("b")
+        )
+        va = srv.kselect("a", 1234, tier="exact").value
+        vb = srv.kselect("b", 1234, tier="exact").value
+        assert va == api.kselect(x_int32, 1234)
+        assert vb == api.kselect(np.roll(x_int32, 7), 1234)
+        assert va == vb  # same multiset, different devices
+        summary = srv.batcher.lane_summary()
+        assert len(summary) == 2
+        assert all(s["submitted"] == 1 for s in summary.values())
+        # lane threads are live, ksel-serve named, and die with close()
+        lane_threads = [
+            t.name
+            for t in threading.enumerate()
+            if t.name.startswith("ksel-serve-lane-")
+        ]
+        assert len(lane_threads) == 2
+    assert not [
+        t
+        for t in threading.enumerate()
+        if t.name.startswith("ksel-serve-lane-") and t.is_alive()
+    ]
+
+
+def test_lanes_answer_concurrently(x_int32):
+    """A blocked lane must not stall another device's lane — the whole
+    point of per-device dispatch (a single global dispatch thread would
+    deadline this test)."""
+    xa, xb, devs = _two_device_arrays(x_int32)
+    with KSelectServer() as srv:
+        dsa = srv.add_dataset("a", xa)
+        srv.add_dataset("b", xb)
+        release = threading.Event()
+        entered = threading.Event()
+
+        def block():
+            entered.set()
+            release.wait(30)
+            return "blocked-op"
+
+        blocker = srv.batcher.submit(
+            PendingQuery("a", "op", ds=dsa, run=block)
+        )
+        assert entered.wait(10)
+        try:
+            # lane "a" is busy inside block(); lane "b" still answers —
+            # with one global dispatch thread this query would sit
+            # behind block() past its deadline and raise
+            vb = srv.kselect("b", 99, tier="exact", deadline=20.0).value
+            assert vb == api.kselect(np.roll(x_int32, 7), 99)
+        finally:
+            release.set()
+        assert blocker.wait() == "blocked-op"
+
+
+def test_lane_failure_isolation(x_int32):
+    """One lane's dispatch-loop crash restarts ONLY that lane: the
+    other lane never notices, and the crashed lane keeps serving after
+    its supervisor restart."""
+
+    class _PoisonDeadline:
+        def remaining(self):
+            return 30.0
+
+        @property
+        def expired(self):
+            raise RuntimeError("poisoned deadline (lane-crash probe)")
+
+    xa, xb, devs = _two_device_arrays(x_int32)
+    obs = obs_lib.Observability(metrics=obs_lib.MetricsRegistry())
+    with KSelectServer(obs=obs) as srv:
+        dsa = srv.add_dataset("a", xa)
+        srv.add_dataset("b", xb)
+        # open both lanes first so the summary names are stable
+        srv.kselect("a", 1, tier="exact")
+        srv.kselect("b", 1, tier="exact")
+        poisoned = srv.batcher.submit(
+            PendingQuery("a", "rank", ks=(1,), ds=dsa,
+                         deadline=_PoisonDeadline())
+        )
+        with pytest.raises(DispatchCrashedError):
+            poisoned.wait()
+        summary = srv.batcher.lane_summary()
+        crashed = lane_key_for(dsa) if srv.batcher.lanes == "auto" else None
+        assert crashed in summary
+        assert summary[crashed]["restarts"] == 1
+        others = {k: v for k, v in summary.items() if k != crashed}
+        assert all(v["restarts"] == 0 for v in others.values())
+        assert srv.batcher.restarts == 1
+        # both lanes still serve, bit-identically
+        assert srv.kselect("a", 77, tier="exact").value == api.kselect(
+            x_int32, 77
+        )
+        assert srv.kselect("b", 77, tier="exact").value == api.kselect(
+            np.roll(x_int32, 7), 77
+        )
+        assert obs.metrics.counter("serve.dispatch_restarts").value == 1
+
+
+def test_lanes_one_degenerates_to_single_batcher(x_int32):
+    """lanes=1 is today's batcher: every dataset serializes through ONE
+    dispatch thread, answers unchanged."""
+    xa, xb, devs = _two_device_arrays(x_int32)
+    with KSelectServer(lanes=1) as srv:
+        srv.add_dataset("a", xa)
+        srv.add_dataset("b", xb)
+        assert srv.kselect("a", 50, tier="exact").value == api.kselect(
+            x_int32, 50
+        )
+        assert srv.kselect("b", 50, tier="exact").value == api.kselect(
+            np.roll(x_int32, 7), 50
+        )
+        summary = srv.batcher.lane_summary()
+        assert set(summary) == {"lane0"}
+        assert summary["lane0"]["submitted"] == 2
+
+
+def test_lanes_validation_and_modular_fold(x_int32):
+    with pytest.raises(ValueError):
+        KSelectServer(lanes=0)
+    with pytest.raises(ValueError):
+        LaneDispatcher(lambda items: None, lanes="three")
+    xa, xb, devs = _two_device_arrays(x_int32)
+    with KSelectServer(lanes=2) as srv:
+        srv.add_dataset("a", xa)
+        srv.add_dataset("b", xb)
+        for k in (3, 1000):
+            assert srv.kselect("a", k, tier="exact").value == api.kselect(
+                x_int32, k
+            )
+        summary = srv.batcher.lane_summary()
+        assert set(summary) <= {"lane0", "lane1"}
+
+
+def test_per_lane_queue_depth_metric(x_int32):
+    obs = obs_lib.Observability(metrics=obs_lib.MetricsRegistry())
+    with KSelectServer(obs=obs) as srv:
+        srv.add_dataset("a", x_int32)
+        srv.kselect("a", 12, tier="exact")
+        text = srv.render_prometheus()
+    assert "ksel_serve_queue_depth" in text
+    assert 'lane="' in text
+    assert "ksel_serve_lanes" in text
+
+
+# ---------------------------------------------------------------------------
+# ProgramCache build latch (the thundering-herd fix)
+
+
+def test_program_cache_build_latch_single_compile():
+    pc = ProgramCache()
+    before = ldg.LEDGER.snapshot()
+    started = threading.Event()
+    release = threading.Event()
+    builds = []
+
+    def builder():
+        builds.append(threading.current_thread().name)
+        started.set()
+        assert release.wait(10)
+        return "program"
+
+    results = []
+
+    def call():
+        results.append(pc.get_or_build(("walk", "latch-herd-ds"), builder))
+
+    t1 = threading.Thread(target=call)
+    t1.start()
+    assert started.wait(10)  # t1 is inside builder, latch installed
+    t2 = threading.Thread(target=call)
+    t2.start()
+    # t2 must wait on the latch, not run a second build
+    t2.join(timeout=0.2)
+    assert t2.is_alive() and len(builds) == 1
+    release.set()
+    t1.join(timeout=10)
+    t2.join(timeout=10)
+    assert not t1.is_alive() and not t2.is_alive()
+    assert results == ["program", "program"]
+    assert len(builds) == 1  # ONE compile for two racing first callers
+    assert (pc.misses, pc.hits) == (1, 1)  # the waiter counts as a hit
+    delta = ldg.snapshot_delta(before, ldg.LEDGER.snapshot())
+    book = delta["sites"]["serve.programs"]
+    assert book["compiles"] == 1
+    assert book["hits"] == 1
+
+
+def test_program_cache_build_latch_failure_releases_waiters():
+    """A failing build must not cache the failure NOR strand waiters:
+    the first caller raises, the waiter retries the build itself."""
+    pc = ProgramCache()
+    waiter_queued = threading.Event()
+    calls = []
+
+    def builder():
+        calls.append(1)
+        if len(calls) == 1:
+            assert waiter_queued.wait(10)
+            raise RuntimeError("first build fails")
+        return 42
+
+    outcomes = []
+
+    def call(tag):
+        try:
+            outcomes.append(
+                (tag, pc.get_or_build(("sorted", "latch-fail-ds"), builder))
+            )
+        except RuntimeError as e:
+            outcomes.append((tag, e))
+
+    t1 = threading.Thread(target=call, args=("first",))
+    t1.start()
+    while not calls:  # t1 inside the (gated) failing build
+        time.sleep(0.005)
+    t2 = threading.Thread(target=call, args=("second",))
+    t2.start()
+    t2.join(timeout=0.2)
+    assert t2.is_alive()  # parked on the latch
+    waiter_queued.set()
+    t1.join(timeout=10)
+    t2.join(timeout=10)
+    got = dict(outcomes)
+    assert isinstance(got["first"], RuntimeError)
+    assert got["second"] == 42
+    assert len(calls) == 2
+    assert (pc.misses, pc.hits) == (2, 0)
+
+
+# ---------------------------------------------------------------------------
+# registration-time warmup: the zero-on-path-compiles tier-1 gate
+
+
+def test_warmup_zero_on_path_compiles_gate(x_int32):
+    """ISSUE 18 acceptance: a warmed dataset's steady-state query mix
+    records ZERO compiles at the serve.programs ledger site — the
+    compile wall was paid at registration, under the warmup span."""
+    obs = obs_lib.Observability(metrics=obs_lib.MetricsRegistry())
+    with KSelectServer(obs=obs) as srv:
+        srv.add_dataset("a", x_int32, warmup=True)
+        assert obs.metrics.counter("serve.warmup_compiles").value == 2
+        assert srv.registry.programs.misses == 2  # sorted + walk
+        before = ldg.LEDGER.snapshot()
+        # the steady-state mix: narrow exacts (walk), a wide quantile
+        # batch (sort path), sketch reads, an auto escalation
+        for k in (5, 17, 31_337):
+            srv.kselect("a", k, tier="exact")
+        srv.quantiles("a", [i / 256 for i in range(1, 256)], tier="exact")
+        srv.kselect("a", 9, tier="sketch")
+        srv.kselect("a", 9, tier="auto")
+        delta = ldg.snapshot_delta(before, ldg.LEDGER.snapshot())
+        book = delta["sites"]["serve.programs"]
+        assert book["compiles"] == 0, book
+        assert book["hits"] >= 4
+        # and the answers still match the serial oracle bit for bit
+        assert srv.kselect("a", 17, tier="exact").value == api.kselect(
+            x_int32, 17
+        )
+
+
+def test_cold_dataset_compiles_on_path(x_int32):
+    """The control for the gate above: WITHOUT warmup the first exact
+    query carries the build (the PR 7 behavior the warmup knob removes)."""
+    with KSelectServer() as srv:
+        srv.add_dataset("a", x_int32)
+        before = ldg.LEDGER.snapshot()
+        srv.kselect("a", 17, tier="exact")
+        delta = ldg.snapshot_delta(before, ldg.LEDGER.snapshot())
+        assert delta["sites"]["serve.programs"]["compiles"] == 1
+
+
+def test_warmup_stream_and_small_datasets(rng):
+    """Stream datasets warm their select closure; small (<= 2^14)
+    resident datasets warm only the cached sort (no walk program)."""
+    x_small = rng.integers(0, 1 << 20, size=4096).astype(np.int32)
+    chunks = [c.copy() for c in np.array_split(x_small, 4)]
+    obs = obs_lib.Observability(metrics=obs_lib.MetricsRegistry())
+    with KSelectServer(obs=obs) as srv:
+        srv.add_dataset("small", x_small, warmup=True)
+        assert ("sorted", "small") in srv.registry.programs._entries
+        assert ("walk", "small") not in srv.registry.programs._entries
+        srv.add_dataset("st", source=chunks, warmup=True)
+        assert ("stream_select", "st") in srv.registry.programs._entries
+        before = ldg.LEDGER.snapshot()
+        for dataset in ("small", "st"):
+            a = srv.kselect(dataset, 1000, tier="exact")
+            assert a.value == api.kselect(x_small, 1000)
+        delta = ldg.snapshot_delta(before, ldg.LEDGER.snapshot())
+        assert delta["sites"]["serve.programs"]["compiles"] == 0
+
+
+def test_warmup_idempotent_and_counter(x_int32):
+    obs = obs_lib.Observability(metrics=obs_lib.MetricsRegistry())
+    with KSelectServer(obs=obs) as srv:
+        srv.add_dataset("a", x_int32, warmup=True)
+        built_again = srv.registry.warmup(srv.registry.get("a"))
+        assert built_again == 0  # everything already resident
+        assert obs.metrics.counter("serve.warmup_compiles").value == 2

@@ -633,6 +633,41 @@ def main() -> int:
             f"misses: {sv_cache['serve.program_cache.misses']['value']}}}, "
             f"escalations={esc}"
         )
+        # per-device dispatch-lane occupancy (ISSUE 18): each resident
+        # dataset routes to the lane of its execution device, so on a
+        # multi-chip host this prints one row per device that saw work
+        print(f"  serve lanes: {sv_srv.batcher.lane_summary()}")
+
+    # --- registration-time warmup (ISSUE 18): the cold-vs-warm
+    # first-query split on real silicon — a warmed dataset's first exact
+    # query must run with ZERO on-path compiles (the compile wall moved
+    # into add_dataset), while the cold control pays it on the request ---
+    sv_fq_timer = _PhaseTimer()
+    sv_fq_books = {}
+    for sv_leg, sv_warm, sv_extra in (("cold", False, 4099), ("warm", True, 8209)):
+        sv_x = rng.integers(
+            -(2**31), 2**31 - 1, size=(1 << 17) + sv_extra, dtype=np.int32
+        )
+        sv_k = 1 + sv_x.size // 3
+        sv_v_ref = int(np.asarray(_sv_api.kselect(sv_x, sv_k)))
+        with _KSelectServer() as sv_fq_srv:
+            sv_fq_srv.add_dataset("fq", sv_x, warmup=sv_warm)
+            sv_led0 = _obs_lib.LEDGER.snapshot()
+            with sv_fq_timer.phase(sv_leg):
+                sv_a = int(sv_fq_srv.kselect("fq", sv_k, tier="exact").value)
+            sv_fq_books[sv_leg] = _obs_lib.snapshot_delta(
+                sv_led0, _obs_lib.LEDGER.snapshot()
+            )["sites"].get("serve.programs", {}).get("compiles", 0)
+        check(f"serve {sv_leg} first query bit-equality", sv_a, sv_v_ref)
+    check("serve warmed first query on-path compiles", sv_fq_books["warm"], 0)
+    sv_fq = sv_fq_timer.as_dict()
+    print(
+        "  serve first-query split: "
+        f"cold={sv_fq['cold']['seconds']:.3f}s "
+        f"({sv_fq_books['cold']} on-path compiles), "
+        f"warm={sv_fq['warm']['seconds']:.3f}s "
+        f"({sv_fq_books['warm']} on-path compiles)"
+    )
 
     if failures:
         print(f"tpu_smoke: {len(failures)} FAILURES")
