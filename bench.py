@@ -842,6 +842,84 @@ def bench_streaming_oc(on_tpu: bool):
     )
     ok = ok and exact_sp and (0.0 < shrink <= 1.0 / (1 << (sp_rb - 1)))
 
+    # --- width-schedule + packed-spill config (ISSUE 19): the SAME spill
+    # stream with width_schedule="auto" (one wide pass-0 digit) and
+    # pack_spill="auto" (digit-segmented gen-0 tee + prefix-packed
+    # survivor generations). The acceptance gates: total LOGICAL bytes
+    # streamed <= 1.2 * n * key_bytes (the legacy spill path pays ~2x —
+    # pass 0 reads the source, pass 1 re-reads ALL of gen 0; the
+    # segment-pruned replay deletes that second full-n read), packed
+    # PHYSICAL writes strictly below the unpacked run's at every
+    # generation past gen 0, and `exact_match` REQUIRES bit-equality
+    # against BOTH oracles (spill="off" and the unpacked spill run).
+    from mpi_k_selection_tpu.streaming.chunked import resolve_width_schedule
+
+    wp_sched = resolve_width_schedule("auto", 32, sp_rb)
+    with SpillStore() as wp_off_store:
+        ans_wp_off = streaming_kselect(
+            sp_source, sp_k, radix_bits=sp_rb, collect_budget=sp_budget,
+            spill=wp_off_store, devices=sp_devices,
+            width_schedule="auto", pack_spill="off",
+        )
+        wp_off_passes = list(wp_off_store.pass_log)
+    obs_wp = Observability(metrics=MetricsRegistry())
+    with SpillStore() as wp_store:
+        t0 = time.perf_counter()
+        ans_wp = streaming_kselect(
+            sp_source, sp_k, radix_bits=sp_rb, collect_budget=sp_budget,
+            spill=wp_store, devices=sp_devices,
+            width_schedule="auto", pack_spill="auto", obs=obs_wp,
+        )
+        wp_s = time.perf_counter() - t0
+        wp_passes = list(wp_store.pass_log)
+    key_bytes = 4  # int32 stream
+    wp_streamed = sum(p["bytes_read"] for p in wp_passes)
+    wp_disk_w = sum(p.get("disk_bytes_written") or 0 for p in wp_passes)
+    wp_logical_w = sum(p.get("bytes_written") or 0 for p in wp_passes)
+    # per-generation packed-vs-unpacked physical writes: the two runs
+    # share the schedule, so pass labels line up; every survivor
+    # generation past gen 0 must be STRICTLY smaller packed
+    unpacked_w = {
+        p["pass"]: p.get("disk_bytes_written") or 0 for p in wp_off_passes
+    }
+    packed_under = all(
+        (p.get("disk_bytes_written") or 0) < unpacked_w[p["pass"]]
+        for p in wp_passes
+        if isinstance(p["pass"], int) and p["pass"] >= 1
+        and unpacked_w.get(p["pass"], 0) > 0
+    )
+    exact_wp = int(ans_wp) == int(ans_off) == int(ans_wp_off)
+    wp_ratio = wp_streamed / (sp_n * key_bytes)
+    _emit(
+        {
+            "metric": "kselect_streaming_oc_width_pack",
+            "value": round(sp_n / wp_s, 1) if exact_wp else 0.0,
+            "unit": "elems/sec/chip",
+            "n": sp_n,
+            "k": sp_k,
+            "radix_bits": sp_rb,
+            "collect_budget": sp_budget,
+            "devices": sp_ndev,
+            "seconds": round(wp_s, 6),
+            "width_schedule": "auto",
+            "pack_spill": "auto",
+            "pass_schedule": list(wp_sched),
+            "bytes_streamed_total": wp_streamed,
+            "bytes_streamed_over_n_key_bytes": round(wp_ratio, 4),
+            "bytes_streamed_bound": 1.2,
+            "unpacked_bytes_streamed_total": sum(
+                p["bytes_read"] for p in sp_passes
+            ),
+            "disk_bytes_ratio": (
+                round(wp_disk_w / wp_logical_w, 4) if wp_logical_w else None
+            ),
+            "packed_below_unpacked_past_gen0": bool(packed_under),
+            "passes": wp_passes,
+            "exact_match": bool(exact_wp),
+        }
+    )
+    ok = ok and exact_wp and wp_ratio <= 1.2 and packed_under
+
     # --- multi-device config: the same stream, staged round-robin across
     # every local device (devices=p, ISSUE 4) vs the devices=1 run above.
     # `device_scaling` is pipelined-devices=1 wall / multi-device wall;
@@ -1051,6 +1129,30 @@ def bench_ingest_fusion(on_tpu: bool):
         "bucket_reads_by_phase_unfused": reads["off"]["by_phase"],
         "exact_match": bool(exact),
     }
+    # the width-schedule + packed-spill knobs on the kernel tier (ISSUE
+    # 19): wide passes route per-bucket counting to the scatter path (the
+    # rb <= 8 kernel support rule), so this leg proves the schedule
+    # composes with the fused dispatch — and records the byte columns
+    from mpi_k_selection_tpu.streaming.chunked import resolve_width_schedule
+
+    with SpillStore() as wp_store:
+        ans_wp = streaming_kselect_many(
+            source, ks, radix_bits=rb, collect_budget=budget,
+            spill=wp_store, devices=devices, fused="kernel",
+            width_schedule="auto", pack_spill="auto",
+        )
+        wp_log = list(wp_store.pass_log)
+    wp_streamed = sum(p["bytes_read"] for p in wp_log)
+    wp_disk_w = sum(p.get("disk_bytes_written") or 0 for p in wp_log)
+    wp_logical_w = sum(p.get("bytes_written") or 0 for p in wp_log)
+    exact_wp = [int(a) for a in ans_wp] == [int(w) for w in want]
+    rec["pass_schedule"] = list(resolve_width_schedule("auto", 32, rb))
+    rec["bytes_streamed_total"] = wp_streamed
+    rec["bytes_streamed_over_n_key_bytes"] = round(wp_streamed / (n * 4), 4)
+    rec["disk_bytes_ratio"] = (
+        round(wp_disk_w / wp_logical_w, 4) if wp_logical_w else None
+    )
+    rec["width_pack_exact_match"] = bool(exact_wp)
     _emit(rec)
     return (
         bool(exact)
@@ -1060,6 +1162,8 @@ def bench_ingest_fusion(on_tpu: bool):
         and amp["xla"] <= 1.1
         and amp["off"] is not None
         and amp["off"] > amp["kernel"]
+        and bool(exact_wp)
+        and wp_streamed <= 1.2 * n * 4
     )
 
 

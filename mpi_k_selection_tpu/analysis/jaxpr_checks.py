@@ -181,6 +181,52 @@ def _streaming_ingest_cases():
     ]
 
 
+def _streaming_wide_ingest_cases():
+    """The WIDE-digit descent programs ``width_schedule`` adds
+    (streaming/chunked.py:resolve_width_schedule): pass 0 histograms a
+    16-bit digit (2^16 int32 bins — inside the MAX_PASS_BITS device
+    budget; wide passes always route ``method="scatter"``, the PR 13
+    rb <= 8 kernel rule via ``_pass_method``), and a later schedule step
+    runs the same wide program over PACKED-REPLAY survivors re-staged
+    from a pruned spill generation (the replay reconstructs full-width
+    keys on host, so the device program is identical — the multi-prefix
+    sweep with live filter specs). Both carry the int32-partial counter
+    discipline and must trace one trail across staging buckets."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mpi_k_selection_tpu.ops.histogram import (
+        masked_radix_histogram,
+        multi_masked_radix_histogram,
+    )
+
+    path = "mpi_k_selection_tpu/streaming/chunked.py"
+    return [
+        (
+            path,
+            "streaming wide ingest[uint32, pass-0 w=16]",
+            lambda u: masked_radix_histogram(
+                u, shift=16, radix_bits=16, prefix=None, method="scatter",
+                count_dtype=jnp.int32,
+            ),
+            "uint32",
+            _STREAMING_INGEST_SIZES,
+        ),
+        (
+            path,
+            "streaming wide ingest[uint32, packed-replay step w=16 "
+            "multi-prefix]",
+            lambda u: multi_masked_radix_histogram(
+                u, shift=8, radix_bits=16,
+                prefixes=np.asarray([0, 3, 129], np.uint32),
+                method="scatter", count_dtype=jnp.int32,
+            ),
+            "uint32",
+            _STREAMING_INGEST_SIZES,
+        ),
+    ]
+
+
 def _streaming_collect_mask_cases():
     """The survivor-collect filter PREDICATE the eager (``deferred=off``)
     collect/tee paths run on each staged chunk's own device
@@ -500,7 +546,40 @@ def check_counter_width() -> list[Finding]:
     from mpi_k_selection_tpu.streaming.chunked import _chunk_histograms
 
     spath = "mpi_k_selection_tpu/streaming/chunked.py"
-    for case_path, label, fn, dt, sizes in _streaming_ingest_cases():
+    # width_schedule's refusal surface: a digit wider than MAX_PASS_BITS
+    # must be refused LOUDLY at validation time — 2**width int32 device
+    # partials per in-flight (prefix, chunk) dispatch is the budget this
+    # check's int32 cases below are sized for — and "auto" must never
+    # resolve a width past the 16-bit wide-pass cap on its own
+    from mpi_k_selection_tpu.streaming.chunked import (
+        MAX_PASS_BITS,
+        resolve_width_schedule,
+        validate_width_schedule,
+    )
+
+    try:
+        validate_width_schedule((MAX_PASS_BITS + 1,))
+        findings.append(
+            Finding("KSC102", spath, 0,
+                    f"validate_width_schedule accepted a {MAX_PASS_BITS + 1}"
+                    "-bit digit — 2**width int32 device partials would blow "
+                    "the device histogram budget; the refusal must fire "
+                    "before any stream is touched")
+        )
+    except ValueError:
+        pass
+    for total, rb in ((64, 8), (32, 4), (16, 8)):
+        for w in resolve_width_schedule("auto", total, rb):
+            if w > 16:
+                findings.append(
+                    Finding("KSC102", spath, 0,
+                            f"resolve_width_schedule('auto', {total}, {rb}) "
+                            f"emitted a {w}-bit digit past the 16-bit "
+                            "wide-pass cap")
+                )
+    for case_path, label, fn, dt, sizes in (
+        _streaming_ingest_cases() + _streaming_wide_ingest_cases()
+    ):
         for n in sizes:
             out = jax.eval_shape(fn, _spec(n, dt))
             cdt = np.dtype(jnp.result_type(out)) if not hasattr(out, "dtype") else np.dtype(out.dtype)
@@ -699,6 +778,10 @@ def check_jaxpr_stability() -> list[Finding]:
     # programs per bucket, so a divergence multiplies by p; the collect
     # filter predicate is on the grid for the same reason
     cases += _streaming_ingest_cases()
+    # the wide-digit schedule programs at both staging buckets — the wide
+    # pass-0 histogram and the packed-replay schedule step must compile
+    # once per (bucket, dtype) exactly like the narrow digits they replace
+    cases += _streaming_wide_ingest_cases()
     cases += _streaming_collect_mask_cases()
     cases += _streaming_compaction_cases()
     # the fused single-read program at both staging buckets: a trail
@@ -733,6 +816,34 @@ def check_jaxpr_stability() -> list[Finding]:
                     "structure recompiles per batch size",
                 )
             )
+    # schedule-STEP stability for the width_schedule descent: the same
+    # wide digit at two different resolved depths (pass-0 vs a later
+    # step's shift) must be ONE trail — the shift is a baked Python
+    # constant, so a divergence means the program structure keys on the
+    # step index and every schedule step compiles a fresh histogram
+    n_step = _STREAMING_INGEST_SIZES[0]
+    step_trails = [
+        _primitive_trail(
+            jax.make_jaxpr(
+                lambda u, s=shift: masked_radix_histogram(
+                    u, shift=s, radix_bits=16, prefix=None,
+                    method="scatter", count_dtype=jnp.int32,
+                )
+            )(_spec(n_step, "uint32"))
+        )
+        for shift in (16, 8, 0)
+    ]
+    if any(t != step_trails[0] for t in step_trails[1:]):
+        findings.append(
+            Finding(
+                "KSC103",
+                "mpi_k_selection_tpu/streaming/chunked.py",
+                0,
+                "wide-digit histogram trail diverges across schedule "
+                "steps (shift constants) — step-dependent program "
+                "structure recompiles per descent pass",
+            )
+        )
     return findings
 
 
@@ -798,6 +909,11 @@ _POP_MATERIALIZATION_BUDGET = {
     "streaming chunked ingest[uint32, multi-prefix shared sweep]": 1,
     # one deepest-level partial per bucket (host fold at pop)
     "streaming sketch deep fold[uint32, rb=16]": 1,
+    # width_schedule's wide digits: one int32 partial per bucket, same
+    # as the narrow ingest they replace (pass 0 and packed-replay step)
+    "streaming wide ingest[uint32, pass-0 w=16]": 1,
+    "streaming wide ingest[uint32, packed-replay step w=16 "
+    "multi-prefix]": 1,
     # the eager filter predicate: one bool mask (the deferred="off"
     # oracle's single device product per bucket)
     "streaming collect filter[uint32, mask]": 1,
@@ -817,6 +933,7 @@ def _census_cases():
     census the moment it lands on the width/stability grids."""
     return (
         _streaming_ingest_cases()
+        + _streaming_wide_ingest_cases()
         + _streaming_collect_mask_cases()
         + _streaming_compaction_cases()
         + _streaming_fused_ingest_cases()

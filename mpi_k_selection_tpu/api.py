@@ -343,7 +343,20 @@ def kselect_streaming(source, k, **kwargs):
     default on TPU), ``"xla"`` the one-XLA-program fusion
     (ops/pallas/fused_ingest.py; the auto default elsewhere), and
     ``"off"`` keeps the unfused bundle as the bit-for-bit oracle.
-    ``retry`` arms the resilience policies (docs/ROBUSTNESS.md; default
+    ``width_schedule`` (default ``"off"``) picks how many key bits each
+    descent pass resolves: ``"auto"`` spends a WIDE first digit (up to 16
+    bits, int32-partial-safe) so the first spill generation shrinks to
+    ~n/2^16 survivors and later passes fall back to ``radix_bits``-wide
+    digits; an explicit tuple of per-pass widths (summing to the key
+    width) pins the schedule. ``pack_spill`` (default ``"off"``) makes
+    the spill store's records prefix-packed: each generation stores only
+    the still-unresolved low bits per survivor (bit-packed,
+    per-segment CRC'd, format-versioned) and replays reconstruct keys
+    exactly — generation-0 tees are digit-segmented so later passes read
+    ONLY the surviving segments instead of the whole teed stream.
+    Both knobs are bit-identical to their ``"off"`` oracles on every
+    source/dtype, and ``"off"``/``"off"`` is byte-for-byte the legacy
+    path. ``retry`` arms the resilience policies (docs/ROBUSTNESS.md; default
     on): transient source errors re-pull mid-pass, staging transfers
     retry in place, failed passes re-run from the previous spill
     generation, corrupt spill records re-read then rebuild, and ENOSPC
@@ -360,7 +373,8 @@ def kselect_streaming(source, k, **kwargs):
     streaming/chunked.py:streaming_kselect for the full option set
     (``radix_bits``, ``hist_method``, ``collect_budget``, ``sketch``,
     ``pipeline_depth``, ``timer``, ``devices``, ``spill``, ``spill_dir``,
-    ``deferred``, ``fused``, ``retry``, ``obs``)."""
+    ``deferred``, ``fused``, ``width_schedule``, ``pack_spill``,
+    ``retry``, ``obs``)."""
     from mpi_k_selection_tpu.streaming.chunked import streaming_kselect
 
     return streaming_kselect(source, k, **kwargs)
@@ -403,14 +417,22 @@ class StreamingQuantiles:
         devices=None,
         deferred=None,
         fused=None,
+        width_schedule=None,
+        pack_spill=None,
         obs=None,
     ):
+        from mpi_k_selection_tpu.streaming.chunked import (
+            DEFAULT_PACK_SPILL,
+            DEFAULT_WIDTH_SCHEDULE,
+            validate_width_schedule,
+        )
         from mpi_k_selection_tpu.streaming.executor import (
             DEFAULT_DEFERRED,
             DEFAULT_FUSED,
             resolve_deferred,
             validate_fused,
         )
+        from mpi_k_selection_tpu.streaming.spill import validate_pack_spill
         from mpi_k_selection_tpu.streaming.pipeline import (
             resolve_stream_devices,
             validate_pipeline_depth,
@@ -431,6 +453,18 @@ class StreamingQuantiles:
         # tier: resolve_fused probes jax.default_backend(), a full
         # platform init this sketch-only constructor must not trigger
         validate_fused(self.fused)
+        #: per-pass digit-width schedule for the exact refinement passes
+        #: ("off" = radix_bits every pass, "auto" = wide first digit, or
+        #: an explicit per-pass tuple — streaming/chunked.py)
+        self.width_schedule = (
+            DEFAULT_WIDTH_SCHEDULE if width_schedule is None else width_schedule
+        )
+        validate_width_schedule(self.width_schedule)  # eagerly, like depth
+        #: prefix-packed spill records for update_stream tees and the
+        #: refinement passes ("off" = unpacked v1 oracle — spill.py)
+        self.pack_spill = validate_pack_spill(
+            DEFAULT_PACK_SPILL if pack_spill is None else pack_spill
+        )
         #: optional Observability bundle threaded through update_stream
         #: and refine_quantiles (off = None, the default)
         self.obs = obs
@@ -457,10 +491,14 @@ class StreamingQuantiles:
         entirely from the spilled generation. The tracker's ``fused``
         tier rides along: at ``"kernel"`` each supported staged bucket's
         deep fold + extremes run as ONE single-sweep program
-        (ops/pallas/sweep_ingest.py) instead of the 2-program pair."""
+        (ops/pallas/sweep_ingest.py) instead of the 2-program pair. The
+        tracker's ``pack_spill`` mode governs the tee: ``"auto"`` writes
+        digit-segmented packed records so the later refinement descent
+        reads ONLY the segments its sketch-seeded first pass keeps."""
         self.sketch.update_stream(
             source, pipeline_depth=self.pipeline_depth, devices=self.devices,
-            spill=spill, fused=self.fused, obs=self.obs,
+            spill=spill, fused=self.fused, pack_spill=self.pack_spill,
+            obs=self.obs,
         )
         return self
 
@@ -473,6 +511,8 @@ class StreamingQuantiles:
             devices=self.devices,
             deferred=self.deferred,
             fused=self.fused,
+            width_schedule=self.width_schedule,
+            pack_spill=self.pack_spill,
             obs=self.obs,
         )
         out.sketch = self.sketch.merge(
@@ -505,6 +545,8 @@ class StreamingQuantiles:
             devices=self.devices,
             deferred=self.deferred,
             fused=self.fused,
+            width_schedule=self.width_schedule,
+            pack_spill=self.pack_spill,
             obs=self.obs,
         )
 

@@ -171,6 +171,24 @@ def build_parser() -> argparse.ArgumentParser:
         "Answers are bit-identical at every tier",
     )
     p.add_argument(
+        "--width-schedule", default="off", metavar="auto|off|W0,W1,...",
+        help="--streaming per-pass digit widths: off (default) = "
+        "radix_bits every pass (the bit-for-bit oracle), auto = one WIDE "
+        "first digit (up to 16 bits, int32-partial-safe) so the first "
+        "spill generation shrinks to ~n/2^16 survivors, or an explicit "
+        "comma-separated width list summing to the key width. Answers "
+        "are bit-identical for every schedule",
+    )
+    p.add_argument(
+        "--pack-spill", choices=("auto", "off"), default="off",
+        help="--streaming prefix-packed spill records: auto stores only "
+        "each survivor's still-unresolved low bits (bit-packed, "
+        "per-segment CRC'd, format-versioned) and digit-segments the "
+        "pass-0 tee so later passes read ONLY surviving segments; off "
+        "(default) = the unpacked v1 records. Answers and replayed keys "
+        "are bit-identical either way",
+    )
+    p.add_argument(
         "--retry", choices=("default", "off"), default="default",
         help="--streaming resilience policies (faults/, docs/ROBUSTNESS.md): "
         "default = bounded retry (3 attempts, exponential backoff) for "
@@ -403,6 +421,26 @@ def _run_streaming(args, obs=None):
     )
 
     depth = validate_pipeline_depth(args.pipeline_depth)
+    # --width-schedule accepts the mode strings or a comma-separated
+    # per-pass width list; validate eagerly so a typo is a clean
+    # SystemExit instead of a mid-descent ValueError
+    from mpi_k_selection_tpu.streaming.chunked import validate_width_schedule
+
+    width_schedule = args.width_schedule
+    if width_schedule not in ("auto", "off"):
+        try:
+            width_schedule = tuple(
+                int(w) for w in width_schedule.split(",") if w.strip()
+            )
+        except ValueError:
+            raise SystemExit(
+                f"error: --width-schedule must be auto, off, or "
+                f"comma-separated ints, got {args.width_schedule!r}"
+            )
+    try:
+        validate_width_schedule(width_schedule)
+    except ValueError as e:
+        raise SystemExit(f"error: {e}")
     # --devices caps the round-robin ingest set (seq backend = host
     # histograms, no devices to spread over)
     devices = args.devices if args.backend != "seq" else None
@@ -473,6 +511,8 @@ def _run_streaming(args, obs=None):
             spill_dir=args.spill_dir,
             deferred=args.deferred,
             fused=args.fused,
+            width_schedule=width_schedule,
+            pack_spill=args.pack_spill,
             retry=args.retry,
             obs=obs,
         )
@@ -497,6 +537,12 @@ def _run_streaming(args, obs=None):
         record.extra["spill"] = args.spill
         record.extra["deferred"] = args.deferred
         record.extra["fused"] = args.fused
+        record.extra["width_schedule"] = (
+            list(width_schedule)
+            if isinstance(width_schedule, tuple)
+            else width_schedule
+        )
+        record.extra["pack_spill"] = args.pack_spill
         record.extra["retry"] = args.retry
         if injector is not None:
             record.extra["chaos"] = {
@@ -571,8 +617,9 @@ def _run_streaming(args, obs=None):
             less, leq = streaming_rank_certificate(
                 cert_src,
                 answer, pipeline_depth=depth, devices=devices,
-                deferred=args.deferred, fused=args.fused, retry=args.retry,
-                obs=cert_obs,
+                deferred=args.deferred, fused=args.fused,
+                width_schedule=width_schedule, pack_spill=args.pack_spill,
+                retry=args.retry, obs=cert_obs,
             )
             cert_ok = less < k <= leq
             record.extra["rank_certificate"] = [less, leq]

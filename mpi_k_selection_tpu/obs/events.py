@@ -78,6 +78,19 @@ class StreamPassEvent(ObsEvent):
     survivors: tuple  # per-rank populations after the walk
     keys_written: int | None = None  # spill survivors written (None = no tee)
     bytes_written: int | None = None
+    #: PHYSICAL bytes moved (spill.py's on-disk record payloads, packed
+    #: when ``pack_spill`` engaged) vs the LOGICAL ``bytes_read`` /
+    #: ``bytes_written`` above (keys x itemsize, the descent-algebra
+    #: unit). Written physical <= written logical always — the packer
+    #: falls back to the unpacked v1 format per record rather than ever
+    #: inflating. Read physical prices what a (possibly PRUNED) replay
+    #: actually touches: matching segments plus each record's directory,
+    #: so it can exceed the logical column on small heavily-pruned reads
+    #: while collapsing far below it on the big early ones. ``None`` on
+    #: old event streams only; source-read passes report physical ==
+    #: logical (the source hands keys at full width).
+    disk_bytes_read: int | None = None
+    disk_bytes_written: int | None = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -100,7 +113,12 @@ class ChunkEvent(ObsEvent):
 @dataclasses.dataclass(frozen=True)
 class SpillGenerationEvent(ObsEvent):
     """One committed spill generation (pass-0 tee or a filtered survivor
-    write): its record count, key count and payload bytes."""
+    write): its record count, key count and payload bytes. ``nbytes`` is
+    the PHYSICAL on-disk payload total; ``logical_nbytes`` (keys x
+    itemsize) is what those keys cost unpacked, so ``nbytes /
+    logical_nbytes`` is the generation's disk compression ratio when
+    ``packed`` (any record in the v2 prefix-packed format) is True —
+    and the two are equal when it is False."""
 
     kind: ClassVar[str] = "spill.generation"
 
@@ -108,6 +126,8 @@ class SpillGenerationEvent(ObsEvent):
     records: int
     keys: int
     nbytes: int
+    logical_nbytes: int | None = None
+    packed: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -317,8 +337,16 @@ def check_stream_invariants(events, spill_pass_log=None) -> None:
       all bounded by that pass's ``keys_read``;
     - chunk events: per-pass chunk indices 0..chunks-1 in order, sizes
       summing to ``keys_read``, staged slots well-formed;
+    - physical vs logical byte accounting on the WRITE side:
+      ``disk_bytes_written <= bytes_written`` on every pass that reports
+      them — the prefix packer never inflates a record (it falls back to
+      the unpacked v1 format per record). The read side carries no such
+      bound: a PRUNED replay reads each record's segment directory, bytes
+      the logical column (keys streamed x itemsize) does not see, so
+      small heavily-pruned reads can price more disk than logical bytes;
     - with ``spill_pass_log`` (a ``SpillStore.pass_log``): the events'
-      bytes_read/bytes_written match the store's log entry for entry.
+      bytes_read/bytes_written AND disk_bytes_read/disk_bytes_written
+      match the store's log entry for entry.
     """
     passes = [e for e in events if isinstance(e, StreamPassEvent)]
     assert passes, "no StreamPassEvent emitted"
@@ -409,6 +437,16 @@ def check_stream_invariants(events, spill_pass_log=None) -> None:
         )
         for c in chunks:
             assert c.device_slot is None or c.device_slot >= 0
+    for e in passes:
+        if e.disk_bytes_written is not None:
+            assert e.bytes_written is not None, (
+                f"pass {e.pass_index}: disk_bytes_written without a tee"
+            )
+            assert e.disk_bytes_written <= e.bytes_written, (
+                f"pass {e.pass_index}: disk_bytes_written "
+                f"{e.disk_bytes_written} exceeds logical bytes_written "
+                f"{e.bytes_written} — the packer must never inflate a record"
+            )
     if spill_pass_log is not None:
         logged = {entry["pass"]: entry for entry in spill_pass_log}
         for e in passes:
@@ -424,4 +462,18 @@ def check_stream_invariants(events, spill_pass_log=None) -> None:
                     f"pass {e.pass_index}: event bytes_written "
                     f"{e.bytes_written} != pass_log "
                     f"{entry.get('bytes_written')}"
+                )
+            if e.disk_bytes_read is not None and "disk_bytes_read" in entry:
+                assert e.disk_bytes_read == entry["disk_bytes_read"], (
+                    f"pass {e.pass_index}: event disk_bytes_read "
+                    f"{e.disk_bytes_read} != pass_log "
+                    f"{entry['disk_bytes_read']}"
+                )
+            if e.disk_bytes_written is not None:
+                assert e.disk_bytes_written == entry.get(
+                    "disk_bytes_written"
+                ), (
+                    f"pass {e.pass_index}: event disk_bytes_written "
+                    f"{e.disk_bytes_written} != pass_log "
+                    f"{entry.get('disk_bytes_written')}"
                 )

@@ -50,6 +50,11 @@ HELP_TEXTS = {
     "staging_pool.misses": "StagingPool buffer allocations",
     "staging_pool.resident_bytes": "Free-list bytes currently pooled",
     "spill.passes": "Spill store pass_log entries",
+    "spill.disk_bytes_read": "Physical spill bytes read (packed/pruned)",
+    "spill.disk_bytes_written": "Physical spill bytes written (packed)",
+    "spill.packed_bytes": "Physical bytes resident in live generations",
+    "spill.logical_bytes": "Logical key bytes resident in live generations",
+    "ingest.resolved_bits": "Resolved key bits after each descent pass",
     "phase.seconds": "Wall seconds per PhaseTimer phase",
     "phase.calls": "Calls per PhaseTimer phase",
     "serve.queries": "Requests answered, by answering tier and op",
@@ -440,7 +445,12 @@ def collect_runtime(
     - ``spill.passes`` / ``spill.bytes_read`` / ``spill.bytes_written`` /
       ``spill.keys_read`` / ``spill.keys_written`` (Counter) summed over a
       :class:`~mpi_k_selection_tpu.streaming.spill.SpillStore`'s
-      ``pass_log``, plus ``spill.generations_live`` (Gauge);
+      ``pass_log``, their PHYSICAL twins ``spill.disk_bytes_read`` /
+      ``spill.disk_bytes_written`` (what the packed/pruned records
+      actually moved on disk vs the logical keys-x-itemsize columns),
+      plus ``spill.generations_live`` and the resident-footprint pair
+      ``spill.packed_bytes`` / ``spill.logical_bytes`` (Gauge — equal
+      unless ``pack_spill`` shrank the on-disk records);
     - every :class:`~mpi_k_selection_tpu.utils.profiling.PhaseTimer`
       phase as ``phase.seconds{phase=...}`` / ``phase.calls{phase=...}``
       (the ``pipeline.stall`` seconds the ROADMAP items need ride here).
@@ -469,8 +479,20 @@ def collect_runtime(
         registry.counter("spill.keys_written").set(
             sum(int(p.get("keys_written", 0)) for p in log)
         )
-        registry.gauge("spill.generations_live").set(
-            len(getattr(spill_store, "generations", ()))
+        registry.counter("spill.disk_bytes_read").set(
+            sum(int(p.get("disk_bytes_read") or 0) for p in log)
+        )
+        registry.counter("spill.disk_bytes_written").set(
+            sum(int(p.get("disk_bytes_written") or 0) for p in log)
+        )
+        gens = getattr(spill_store, "generations", {})
+        registry.gauge("spill.generations_live").set(len(gens))
+        live = list(gens.values()) if hasattr(gens, "values") else list(gens)
+        registry.gauge("spill.packed_bytes").set(
+            sum(int(g.nbytes) for g in live)
+        )
+        registry.gauge("spill.logical_bytes").set(
+            sum(int(getattr(g, "logical_nbytes", g.nbytes)) for g in live)
         )
     if timer is not None:
         for name, d in timer.as_dict().items():

@@ -123,6 +123,23 @@ def bucket_read(obs, phase: str, staged, programs: int = 1):
     obs.metrics.counter("ingest.bucket_read_bytes", labels=lab).inc(nbytes)
 
 
+def resolved_bits_gauge(obs, pass_label, bits) -> None:
+    """Record the cumulative resolved-bit depth after a histogram pass:
+    ``ingest.resolved_bits{pass}`` is how the adaptive width schedule's
+    progress becomes observable — a wide pass 0 jumps the gauge to w₀
+    where the fixed schedule would read ``radix_bits``, and the gap
+    between consecutive passes IS the per-pass digit width. The ``pass``
+    label set is closed by construction: labels are the descent's pass
+    indices, at most ``total_bits / 1`` of them (64 for uint64) per run.
+    Pure host observation; no-op when metrics are off."""
+    if obs is None or obs.metrics is None:
+        return
+    obs.metrics.gauge(
+        "ingest.resolved_bits",
+        labels={"pass": str(pass_label)},  # ksel: noqa[KSL013] -- pass indices, bounded by key bits / min digit width
+    ).set(int(bits))
+
+
 class _FanRecorder:
     """Forwards every finished span to several recorders (the trace
     recorder and the flight ring observe the same phases — neither

@@ -88,7 +88,13 @@ STAGED_TYPES = frozenset({"StagedKeys"})
 SPILL_ACQUIRE_CALLS = frozenset(
     {"SpillStore", "SpillWriter", "TemporaryDirectory", "mkdtemp",
      # a store's generation writer: commit() hands its records to the
-     # store, abort() drops them — one of the two must run on every path
+     # store, abort() drops them — one of the two must run on every path.
+     # The prefix-packed (format v2) writer is THIS SAME surface:
+     # new_generation(pack_specs=...) / (pack_digit_bits=...) returns the
+     # same SpillWriter, its bit-pack buffers are plain numpy arrays
+     # (no tracked resource), and every packed record still reaches disk
+     # only through the writer's one sanctioned append/commit path — so
+     # KSL008/KSL020 see the v2 path with no extra protocol entries
      "new_generation"}
 )
 #: The cleanup surface: ``store.close()`` / ``writer.abort()`` /

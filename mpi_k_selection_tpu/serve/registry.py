@@ -336,7 +336,8 @@ class DatasetRegistry:
         here to build the resident sketch (and establish n/dtype); exact
         queries later replay the source through the sketch-seeded
         streaming descent. ``stream_kwargs`` are held for those descents
-        (``pipeline_depth``, ``devices``, ``hist_method``, ...)."""
+        (``pipeline_depth``, ``devices``, ``hist_method``,
+        ``width_schedule``, ``pack_spill``, ...)."""
         from mpi_k_selection_tpu.streaming.chunked import as_chunk_source
         from mpi_k_selection_tpu.streaming.sketch import RadixSketch
 

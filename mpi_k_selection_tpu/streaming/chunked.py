@@ -96,6 +96,125 @@ DEFAULT_COLLECT_BUDGET = 1 << 20
 #: bit-identical replay path unless ``"force"`` asks for the spill descent.
 DEFAULT_SPILL = "auto"
 
+#: Widest digit one streamed pass may histogram. Bounded by the KSC102
+#: counter discipline — per-chunk device counts are int32 partials over
+#: ``2**width`` buckets (a chunk never exceeds 2^31 elements, so any
+#: single bucket's partial is int32-exact at ANY width; the cap is the
+#: device histogram MEMORY: 2^20 int32 bins = 4 MiB per in-flight
+#: (prefix, chunk) dispatch, the same bound as
+#: streaming/sketch.py:_MAX_RESOLUTION_BITS). Wider would trade the
+#: saved ingest bytes for multi-MiB scatter targets per window slot.
+MAX_PASS_BITS = 20
+
+#: Default for ``width_schedule``: ``"off"`` keeps the fixed
+#: one-radix-digit-per-pass schedule (byte-for-byte the historical
+#: descent). ``"auto"`` is opt-in until validated on silicon — flip after
+#: a tpu_smoke run confirms the wide pass-0 win end to end (ROADMAP).
+DEFAULT_WIDTH_SCHEDULE = "off"
+
+#: Default for ``pack_spill`` (streaming/spill.py:PACK_SPILL_MODES):
+#: ``"off"`` writes the historical full-width v1 records; ``"auto"``
+#: prefix-packs survivor generations (format v2) wherever packing wins.
+DEFAULT_PACK_SPILL = "off"
+
+WIDTH_SCHEDULE_MODES = ("auto", "off")
+
+
+def validate_width_schedule(width_schedule):
+    """Normalize the ``width_schedule`` knob: ``"auto"``, ``"off"``
+    (``None`` = off), or an explicit per-pass digit-width tuple. Widths
+    outside ``[1, MAX_PASS_BITS]`` are refused LOUDLY here — a wider
+    digit would blow the device histogram budget the int32-partial
+    counter discipline (KSC102) is sized for — before any stream is
+    touched."""
+    if width_schedule is None:
+        return "off"
+    if width_schedule in WIDTH_SCHEDULE_MODES:
+        return width_schedule
+    if isinstance(width_schedule, str):
+        raise ValueError(
+            f"width_schedule must be one of {WIDTH_SCHEDULE_MODES} or a "
+            f"tuple of per-pass digit widths, got {width_schedule!r}"
+        )
+    try:
+        widths = tuple(int(w) for w in width_schedule)
+    except TypeError:
+        raise ValueError(
+            f"width_schedule must be one of {WIDTH_SCHEDULE_MODES} or a "
+            f"tuple of per-pass digit widths, got {width_schedule!r}"
+        ) from None
+    if not widths:
+        raise ValueError("width_schedule tuple must name at least one pass")
+    for w in widths:
+        if not 1 <= w <= MAX_PASS_BITS:
+            raise ValueError(
+                f"width_schedule pass width {w} outside [1, {MAX_PASS_BITS}]"
+                ": a streamed pass histograms 2**width int32 device "
+                "partials per in-flight (prefix, chunk) dispatch (KSC102's "
+                "counter discipline), so wider digits would overflow the "
+                f"device histogram budget (2**{MAX_PASS_BITS} bins = "
+                "4 MiB); split the schedule into more passes instead"
+            )
+    return widths
+
+
+def resolve_width_schedule(
+    width_schedule, total_bits: int, radix_bits: int, start_bits: int = 0
+) -> tuple:
+    """Resolve a validated ``width_schedule`` against the stream's key
+    geometry (known only at dtype-probe time): the returned tuple's
+    widths sum to ``total_bits - start_bits`` (``start_bits`` = a seeding
+    sketch's resolved depth). ``"off"`` reproduces the fixed
+    ``radix_bits`` schedule exactly (including its divisibility error);
+    ``"auto"`` front-loads ONE wide pass — the largest width <= 16 that
+    leaves the remainder on radix_bits boundaries — so generation 0
+    shrinks by ~2^w0 and the second full-N read disappears, while later
+    passes keep the narrow kernel-friendly digits."""
+    remaining = total_bits - start_bits
+    if width_schedule == "off":
+        if remaining % radix_bits:
+            if start_bits:
+                raise ValueError(
+                    f"radix_bits={radix_bits} must divide the {remaining} "
+                    f"key bits left below the resolved {start_bits} bits"
+                )
+            raise ValueError(
+                f"radix_bits={radix_bits} must divide key bits {total_bits}"
+            )
+        return (radix_bits,) * (remaining // radix_bits)
+    if width_schedule == "auto":
+        for w in range(min(16, remaining), 0, -1):
+            if (remaining - w) % radix_bits == 0:
+                return (w,) + (radix_bits,) * ((remaining - w) // radix_bits)
+        # radix_bits > 16 with remaining on its boundaries: no wide first
+        # pass fits under the budget — keep the fixed schedule
+        return (radix_bits,) * (remaining // radix_bits)
+    widths = tuple(width_schedule)
+    if sum(widths) != remaining:
+        raise ValueError(
+            f"width_schedule {widths} resolves {sum(widths)} bits but the "
+            f"descent must resolve {remaining}"
+            + (
+                f" ({total_bits} key bits minus the sketch's {start_bits} "
+                "resolved)"
+                if start_bits
+                else f" ({total_bits} key bits)"
+            )
+        )
+    return widths
+
+
+def _pass_method(method, width: int):
+    """Per-pass histogram method: digits wider than 8 bits exceed the
+    SWAR/pallas kernels' radix support (ops/pallas/histogram.py,
+    PR 13's rb <= 8 rule), so wide passes route device counting through
+    the scatter path — the same method the sketch's deep
+    ``resolution_bits``-wide fold already uses on device — while the
+    host-exact ``"numpy"`` route is width-agnostic and stays put."""
+    if width <= 8 or method == "numpy":
+        return method
+    return "scatter"
+
 
 def _is_device_array(chunk) -> bool:
     import jax
@@ -449,8 +568,8 @@ def _recover_pass(
 
 def _collect_survivors(
     src, dtype, specs, *, pipeline_depth=0, timer=None, devices=None,
-    hist_method=None, obs=None, read_from="source", deferred=True,
-    fused=False, retry=None,
+    hist_method=None, obs=None, read_from="source", disk_bytes_read=None,
+    deferred=True, fused=False, retry=None,
 ):
     """One streamed pass collecting survivors for EVERY ``(resolved_bits,
     prefix) -> expected population`` spec at once — the shared finish of
@@ -544,6 +663,11 @@ def _collect_survivors(
                 chunks=chunk_i,
                 keys_read=keys_read,
                 bytes_read=keys_read * kdt.itemsize,
+                disk_bytes_read=(
+                    keys_read * kdt.itemsize
+                    if disk_bytes_read is None
+                    else int(disk_bytes_read)
+                ),
                 read_from=read_from,
                 bucket_total=sum(sizes),
                 bucket_max=max(sizes, default=0),
@@ -606,6 +730,8 @@ def streaming_kselect(
     spill_dir=None,
     deferred=DEFAULT_DEFERRED,
     fused=DEFAULT_FUSED,
+    width_schedule=DEFAULT_WIDTH_SCHEDULE,
+    pack_spill=DEFAULT_PACK_SPILL,
     retry=None,
     obs=None,
 ):
@@ -694,6 +820,31 @@ def streaming_kselect(
     ``ingest.bucket_reads{phase}`` (docs/OBSERVABILITY.md) makes the
     reads-per-pass collapse measurable.
 
+    ``width_schedule`` (default ``"off"``) makes the per-pass digit
+    width adaptive: ``"auto"`` front-loads ONE wide pass — up to 16 bits,
+    chosen so the remainder stays on ``radix_bits`` boundaries — so the
+    first spill generation shrinks by ~2^w0 instead of ~2^radix_bits and
+    the second full-N read disappears; an explicit tuple names every
+    pass's width (summing to the unresolved key bits, each within
+    ``[1, MAX_PASS_BITS]`` — wider is refused loudly: the device
+    histogram's int32 partials budget, KSC102). Wide digits exceed the
+    pallas kernels' radix support, so those passes count through the
+    scatter path on device (and per staged bucket the ``fused="kernel"``
+    tier falls back to the xla tier exactly like any other unsupported
+    bucket). ``"off"`` is byte-for-byte the fixed one-digit-per-pass
+    descent, and answers are bit-identical under EVERY schedule.
+
+    ``pack_spill`` (default ``"off"``) prefix-packs survivor spill
+    generations (streaming/spill.py format v2): generation g's records
+    store only each survivor's unresolved low ``total_bits - resolved``
+    bits, bit-packed per ``(resolved, prefix)`` segment and CRC'd over
+    the packed payload, reconstructed exactly at replay — disk bytes
+    shrink multiplicatively with population and resolved depth, and
+    replay re-stages onto the recorded device slots unchanged. ``"auto"``
+    packs wherever it wins (per record; physical bytes never exceed
+    logical); generation 0 always stays full-width v1. Answers are
+    bit-identical with packing on or off.
+
     ``retry`` configures the resilience policies (see
     :func:`streaming_kselect_many` and docs/ROBUSTNESS.md): ``None`` =
     the bounded-retry default, ``"off"`` = fail on the first transient,
@@ -722,6 +873,8 @@ def streaming_kselect(
         spill_dir=spill_dir,
         deferred=deferred,
         fused=fused,
+        width_schedule=width_schedule,
+        pack_spill=pack_spill,
         retry=retry,
         obs=obs,
     )[0]
@@ -742,6 +895,8 @@ def streaming_kselect_many(
     spill_dir=None,
     deferred=DEFAULT_DEFERRED,
     fused=DEFAULT_FUSED,
+    width_schedule=DEFAULT_WIDTH_SCHEDULE,
+    pack_spill=DEFAULT_PACK_SPILL,
     retry=None,
     obs=None,
 ):
@@ -789,7 +944,18 @@ def streaming_kselect_many(
     runs are bit-identical to fault-free runs; exhausted policies raise
     typed errors (``RetryExhaustedError``, ``SpillCapacityError``,
     ``SpillRecordError``).
+
+    ``width_schedule`` and ``pack_spill`` (see :func:`streaming_kselect`)
+    shrink the descent's byte volume on both axes: a wide pass 0 makes
+    generation 0 ~N/2^w0 (total streamed bytes ≈ one read of N plus a
+    geometric tail instead of ~2N+), and packed generations store only
+    each survivor's unresolved low bits on disk. Both default off;
+    answers are bit-identical at every knob setting, and
+    ``width_schedule="off"`` + ``pack_spill="off"`` is byte-for-byte the
+    historical path.
     """
+    width_schedule = validate_width_schedule(width_schedule)
+    pack_spill = _sp.validate_pack_spill(pack_spill)
     pipeline_depth = _pl.validate_pipeline_depth(pipeline_depth)
     devs = _pl.resolve_stream_devices(devices)
     defer = _ex.resolve_deferred(deferred)
@@ -836,8 +1002,14 @@ def streaming_kselect_many(
     # raises the one-shot disk bound to ~3·N·key_bytes worst case)
     protected = None
 
-    def _gen_src():
-        return read_gen.as_source(mmap=defer) if read_gen is not None else src
+    def _gen_src(filter_specs=None):
+        # filter_specs prune the replay of a v2 (segment-directoried)
+        # generation to the surviving buckets — a superset of the pass's
+        # own exact filters, so consumers see every key they would have
+        # selected from the full read (spill.py:iter_chunks)
+        if read_gen is not None:
+            return read_gen.as_source(mmap=defer, filter_specs=filter_specs)
+        return src
 
     def _fallback_src():
         """The rebuild source when the generation being read is corrupt:
@@ -849,21 +1021,32 @@ def streaming_kselect_many(
             return protected.as_source(mmap=defer)
         return None  # pragma: no cover - one-shot descents always anchor gen 0
 
-    def _log_pass(label, wrote=None, *, keys_read=None, read=None):
+    def _log_pass(label, wrote=None, *, keys_read=None, read=None,
+                  disk_read=None):
         if store is None:
             return
         if read is None:
             read = "spill" if read_gen is not None else "source"
         if keys_read is None:
             keys_read = int(read_gen.keys) if read_gen is not None else int(n)
+        # LOGICAL bytes (full-width keys streamed into consumers) vs the
+        # PHYSICAL disk bytes actually read/written — these diverge only
+        # for packed (format-v2) generations, and physical <= logical
+        # always (spill.py's per-record pack-only-when-it-wins rule)
         entry = {
             "pass": label, "read": read,
             "keys_read": int(keys_read),
             "bytes_read": int(keys_read) * kdt.itemsize,
+            "disk_bytes_read": (
+                int(keys_read) * kdt.itemsize
+                if disk_read is None
+                else int(disk_read)
+            ),
         }
         if wrote is not None:
             entry["keys_written"] = int(wrote.keys)
-            entry["bytes_written"] = int(wrote.nbytes)
+            entry["bytes_written"] = int(wrote.logical_nbytes)
+            entry["disk_bytes_written"] = int(wrote.nbytes)
         store.pass_log.append(entry)
 
     def _rotate(gen):
@@ -912,7 +1095,14 @@ def streaming_kselect_many(
             kdt = np.dtype(_dt.key_dtype(dtype))
             total_bits = _dt.key_bits(dtype)
             method = resolve_stream_hist(hist_method, dtype)
-            sketch.check_stream(dtype, radix_bits)
+            sketch.check_stream(dtype, radix_bits, width_schedule=width_schedule)
+            # the remaining passes walk the bits BELOW the sketch's
+            # resolved prefix — the schedule covers exactly those
+            schedule = resolve_width_schedule(
+                width_schedule, total_bits, radix_bits,
+                start_bits=sketch.resolution_bits,
+            )
+            start_bits = sketch.resolution_bits
             n = sketch.n
             _validate_ks(ks, n)
             states = [list(sketch.walk(k)) for k in ks]
@@ -926,16 +1116,25 @@ def streaming_kselect_many(
             # pipelined), so no later pass touches the source again.
             dtype = None
             n = 0
-            kdt = total_bits = method = None
+            kdt = total_bits = method = schedule = None
             pass0_gen = read_gen  # what pass 0 actually read from
 
             def _pass0(src_override, tee):
-                nonlocal dtype, n, kdt, total_bits, method
+                nonlocal dtype, n, kdt, total_bits, method, schedule
                 dtype = None  # fresh per attempt: the probe re-runs whole
                 n = 0
                 chunk_i0 = 0
                 writer = (
-                    store.new_generation()
+                    # pack_spill="auto": tee generation 0 segmented by
+                    # each key's top digit, so pass 1's filtered replay
+                    # prunes to the surviving buckets instead of
+                    # re-reading all N keys (spill.py format v2)
+                    store.new_generation(
+                        pack_digit_bits=(
+                            _sp.GEN0_SEGMENT_BITS
+                            if pack_spill == "auto" else None
+                        )
+                    )
                     if tee and store is not None and read_gen is None
                     else None
                 )
@@ -951,15 +1150,19 @@ def streaming_kselect_many(
                                 dtype = np.dtype(chunk.dtype)
                                 kdt = np.dtype(_dt.key_dtype(dtype))
                                 total_bits = _dt.key_bits(dtype)
-                                if total_bits % radix_bits:
-                                    raise ValueError(
-                                        f"radix_bits={radix_bits} must divide "
-                                        f"key bits {total_bits}"
-                                    )
+                                # the schedule resolves at dtype-probe time
+                                # (key geometry is only now known); "off"
+                                # reproduces the fixed radix_bits schedule
+                                # INCLUDING its divisibility refusal
+                                schedule = resolve_width_schedule(
+                                    width_schedule, total_bits, radix_bits
+                                )
                                 method = resolve_stream_hist(hist_method, dtype)
-                                shift0 = total_bits - radix_bits
+                                w0 = schedule[0]
+                                shift0 = total_bits - w0
                                 hist_c = _ex.HistogramConsumer(
-                                    shift0, radix_bits, [None], method, kdt,
+                                    shift0, w0, [None],
+                                    _pass_method(method, w0), kdt,
                                     obs=obs,
                                 )
                                 ex = _ex.StreamExecutor(
@@ -1017,15 +1220,26 @@ def streaming_kselect_many(
                 created.append(gen0)
                 if not own_store or one_shot:
                     protected = gen0
-                _log_pass(0, gen0)
+                _log_pass(
+                    0, gen0,
+                    disk_read=(
+                        None if pass0_gen is None else int(pass0_gen.nbytes)
+                    ),
+                )
                 read_gen = gen0
             else:
-                _log_pass(0)
+                _log_pass(
+                    0,
+                    disk_read=(
+                        None if pass0_gen is None else int(pass0_gen.nbytes)
+                    ),
+                )
             _validate_ks(ks, n)
+            start_bits = 0
             states = []
             for k in ks:
-                prefix, kk, pop = _np_walk(hist, k, None, radix_bits)
-                states.append([prefix, kk, radix_bits, pop])
+                prefix, kk, pop = _np_walk(hist, k, None, schedule[0])
+                states.append([prefix, kk, schedule[0], pop])
             if obs is not None:
                 if gen0 is not None:
                     obs.emit(
@@ -1034,32 +1248,56 @@ def streaming_kselect_many(
                             records=len(gen0.records),
                             keys=gen0.keys,
                             nbytes=gen0.nbytes,
+                            logical_nbytes=gen0.logical_nbytes,
+                            packed=gen0.packed,
                         )
                     )
                 total0, max0, nz0 = _hist_summary(hist)
+                keys_read0 = (
+                    int(pass0_gen.keys) if pass0_gen is not None else n
+                )
                 obs.emit(
                     _ev.StreamPassEvent(
                         pass_index=0,
                         resolved_bits=0,
                         prefixes=(),
                         chunks=chunk_i0,
-                        keys_read=(
-                            int(pass0_gen.keys) if pass0_gen is not None else n
-                        ),
-                        bytes_read=(
-                            int(pass0_gen.nbytes)
-                            if pass0_gen is not None
-                            else n * kdt.itemsize
-                        ),
+                        keys_read=keys_read0,
+                        bytes_read=keys_read0 * kdt.itemsize,
                         read_from="spill" if pass0_gen is not None else "source",
                         bucket_total=total0,
                         bucket_max=max0,
                         bucket_nonzero=nz0,
                         survivors=tuple(int(st[3]) for st in states),
                         keys_written=None if gen0 is None else int(gen0.keys),
-                        bytes_written=None if gen0 is None else int(gen0.nbytes),
+                        bytes_written=(
+                            None if gen0 is None else int(gen0.logical_nbytes)
+                        ),
+                        disk_bytes_read=(
+                            int(pass0_gen.nbytes)
+                            if pass0_gen is not None
+                            else n * kdt.itemsize
+                        ),
+                        disk_bytes_written=(
+                            None if gen0 is None else int(gen0.nbytes)
+                        ),
                     )
                 )
+                _wr.resolved_bits_gauge(obs, 0, schedule[0])
+
+        # per-step schedule bookkeeping: active ranks advance in lockstep,
+        # so every pass sits on a schedule-step boundary — map each
+        # boundary to (digit width, pass label). base_label reproduces the
+        # historical ``resolved // radix_bits`` labels exactly under
+        # ``width_schedule="off"`` (floor((start + i*rb)/rb) ==
+        # floor(start/rb) + i), and labels stay strictly-increasing ints
+        # under every schedule (check_stream_invariants' contract).
+        base_label = start_bits // radix_bits
+        steps = {}
+        acc = start_bits
+        for i, w in enumerate(schedule):
+            steps[acc] = (w, base_label + i)
+            acc += w
 
         def _active(st):
             return st[2] < total_bits and st[3] > collect_budget
@@ -1069,7 +1307,8 @@ def streaming_kselect_many(
             # active set), so they all sit at one resolved depth: one
             # streamed pass serves every distinct surviving prefix
             resolved = next(st[2] for st in states if _active(st))
-            shift = total_bits - resolved - radix_bits
+            width, pass_label = steps[resolved]
+            shift = total_bits - resolved - width
             prefixes = sorted({st[0] for st in states if _active(st)})
             expected = {st[0]: st[3] for st in states if _active(st)}
             filter_specs = None
@@ -1086,17 +1325,25 @@ def streaming_kselect_many(
                         if not _active(st) and st[2] < total_bits
                     }
                 )
-            pass_label = resolved // radix_bits
             pass_read_gen = read_gen  # what this pass reads from
 
             def _run_pass(
                 src_override, tee,
-                shift=shift, prefixes=prefixes, expected=expected,
-                filter_specs=filter_specs, pass_label=pass_label,
-                pass_read_gen=pass_read_gen,
+                shift=shift, width=width, prefixes=prefixes,
+                expected=expected, filter_specs=filter_specs,
+                pass_label=pass_label, pass_read_gen=pass_read_gen,
             ):
                 writer = (
-                    store.new_generation()
+                    store.new_generation(
+                        # pack_spill="auto": the tee's own filter union IS
+                        # the segment directory — every surviving key's
+                        # resolved prefix is known, so only its unresolved
+                        # low bits hit disk (spill.py format v2)
+                        pack_specs=(
+                            filter_specs if pack_spill == "auto" else None
+                        ),
+                        total_bits=total_bits,
+                    )
                     if tee and filter_specs is not None
                     else None
                 )
@@ -1113,6 +1360,14 @@ def streaming_kselect_many(
                     or (src_override is not None and one_shot)
                     else "source"
                 )
+                # the generation whose PHYSICAL bytes this attempt reads
+                # (None = a source read, where disk == logical): the
+                # scheduled generation, or a one-shot rebuild's gen-0
+                # anchor — honest disk accounting per attempt
+                disk_gen = (
+                    pass_read_gen if src_override is None
+                    else (protected if one_shot else None)
+                )
                 ex = keys = None
                 try:
                     # ONE executor bundle per chunk: the spill tee (first,
@@ -1128,7 +1383,8 @@ def streaming_kselect_many(
                     # executor constructor raising must still abort the
                     # generation, or its records strand on disk (KSL020)
                     hist_c = _ex.HistogramConsumer(
-                        shift, radix_bits, prefixes, method, kdt, obs=obs
+                        shift, width, prefixes, _pass_method(method, width),
+                        kdt, obs=obs,
                     )
                     tee_c = (
                         _ex.SpillTeeConsumer(
@@ -1153,7 +1409,8 @@ def streaming_kselect_many(
                         consumers, window=window, occupancy=occupancy
                     )
                     with _pl._phase(timer, "descent.pass"), _key_chunk_stream(
-                        src_override if src_override is not None else _gen_src(),
+                        src_override if src_override is not None
+                        else _gen_src(filter_specs),
                         dtype, hist_method=method, **stream_kw
                     ) as kc:
                         for keys, _ in kc:
@@ -1201,9 +1458,19 @@ def streaming_kselect_many(
                             writer.abort()
                     raise
                 gen = writer.commit() if writer is not None else None
-                return hists, gen, chunk_i, pass_keys, read_from
+                if disk_gen is None:
+                    disk_read = pass_keys * kdt.itemsize
+                elif src_override is None:
+                    # the scheduled (pruned) read: price the directory +
+                    # matching segments, not the whole generation
+                    disk_read = int(disk_gen.read_nbytes(filter_specs))
+                else:
+                    disk_read = int(disk_gen.nbytes)
+                return hists, gen, chunk_i, pass_keys, read_from, disk_read
 
-            hists, gen, chunk_i, pass_keys, pass_read_from = _recover_pass(
+            (
+                hists, gen, chunk_i, pass_keys, pass_read_from, pass_disk_read,
+            ) = _recover_pass(
                 _run_pass,
                 policy=policy,
                 reading_spill=read_gen is not None,
@@ -1214,7 +1481,8 @@ def streaming_kselect_many(
             )
             if gen is not None:
                 _log_pass(
-                    pass_label, gen, keys_read=pass_keys, read=pass_read_from
+                    pass_label, gen, keys_read=pass_keys, read=pass_read_from,
+                    disk_read=pass_disk_read,
                 )
                 _rotate(gen)
             elif store is not None:
@@ -1222,13 +1490,16 @@ def streaming_kselect_many(
                 # the pass_log keeps its one-entry-per-pass accounting —
                 # and stays consistent with the StreamPassEvents — after
                 # an ENOSPC downgrade
-                _log_pass(pass_label, keys_read=pass_keys, read=pass_read_from)
+                _log_pass(
+                    pass_label, keys_read=pass_keys, read=pass_read_from,
+                    disk_read=pass_disk_read,
+                )
             for st in states:
                 if _active(st):
                     st[0], st[1], st[3] = _np_walk(
-                        hists[st[0]], st[1], st[0], radix_bits
+                        hists[st[0]], st[1], st[0], width
                     )
-                    st[2] = resolved + radix_bits
+                    st[2] = resolved + width
             if obs is not None:
                 if gen is not None:
                     obs.emit(
@@ -1237,6 +1508,8 @@ def streaming_kselect_many(
                             records=len(gen.records),
                             keys=gen.keys,
                             nbytes=gen.nbytes,
+                            logical_nbytes=gen.logical_nbytes,
+                            packed=gen.packed,
                         )
                     )
                 totalp, maxp, nzp = _hist_summary(hists)
@@ -1256,9 +1529,16 @@ def streaming_kselect_many(
                         bucket_nonzero=nzp,
                         survivors=tuple(int(st[3]) for st in states),
                         keys_written=None if gen is None else int(gen.keys),
-                        bytes_written=None if gen is None else int(gen.nbytes),
+                        bytes_written=(
+                            None if gen is None else int(gen.logical_nbytes)
+                        ),
+                        disk_bytes_read=pass_disk_read,
+                        disk_bytes_written=(
+                            None if gen is None else int(gen.nbytes)
+                        ),
                     )
                 )
+                _wr.resolved_bits_gauge(obs, pass_label, resolved + width)
 
         specs = {}
         for prefix, _kk, resolved, pop in states:
@@ -1270,28 +1550,40 @@ def streaming_kselect_many(
             def _run_collect(src_override, tee):
                 # the SUCCESSFUL attempt's actual read, for the event AND
                 # the pass_log (a rebuilt collect reads the source — or a
-                # one-shot run's gen-0 anchor — not the scheduled gen)
+                # one-shot run's gen-0 anchor — not the scheduled gen);
+                # the scheduled read prunes the generation to the collect
+                # specs' segments, and the accounting prices that
+                cspecs = tuple(specs)
                 if src_override is None:
                     read_from = "spill" if read_gen is not None else "source"
-                    kr = read_gen.keys if read_gen is not None else n
+                    kr = read_gen.read_keys(cspecs) if read_gen is not None else n
+                    dg = read_gen
+                    disk = (
+                        int(dg.read_nbytes(cspecs)) if dg is not None
+                        else int(kr) * kdt.itemsize
+                    )
                 elif one_shot:
-                    read_from, kr = "spill", protected.keys
+                    read_from, kr, dg = "spill", protected.keys, protected
+                    disk = int(dg.nbytes)
                 else:
-                    read_from, kr = "source", n
+                    read_from, kr, dg = "source", n, None
+                    disk = int(kr) * kdt.itemsize
                 return (
                     _collect_survivors(
-                        src_override if src_override is not None else _gen_src(),
+                        src_override if src_override is not None
+                        else _gen_src(cspecs),
                         dtype, specs, pipeline_depth=pipeline_depth,
                         timer=timer, devices=None if devices is None else devs,
                         hist_method=method, obs=obs,
-                        read_from=read_from,
+                        read_from=read_from, disk_bytes_read=disk,
                         deferred=defer, fused=fuse, retry=policy,
                     ),
                     read_from,
                     int(kr),
+                    disk,
                 )
 
-            collected, coll_read, coll_keys = _recover_pass(
+            collected, coll_read, coll_keys, coll_disk = _recover_pass(
                 _run_collect,
                 policy=policy,
                 reading_spill=read_gen is not None,
@@ -1300,7 +1592,10 @@ def streaming_kselect_many(
                 obs=obs,
                 site="collect",
             )
-            _log_pass("collect", keys_read=coll_keys, read=coll_read)
+            _log_pass(
+                "collect", keys_read=coll_keys, read=coll_read,
+                disk_read=coll_disk,
+            )
 
         if obs is not None and obs.metrics is not None:
             # snapshot the run's counters while the store is still open
@@ -1340,8 +1635,9 @@ def streaming_kselect_many(
 
 def streaming_rank_certificate(
     source, value, *, pipeline_depth: int = DEFAULT_PIPELINE_DEPTH, timer=None,
-    devices=None, deferred=DEFAULT_DEFERRED, fused=DEFAULT_FUSED, retry=None,
-    obs=None,
+    devices=None, deferred=DEFAULT_DEFERRED, fused=DEFAULT_FUSED,
+    width_schedule=DEFAULT_WIDTH_SCHEDULE, pack_spill=DEFAULT_PACK_SPILL,
+    retry=None, obs=None,
 ):
     """``(#elements < value, #elements <= value)`` streamed — the O(n)
     exactness proof of utils/debug.py:rank_certificate without residency:
@@ -1372,7 +1668,16 @@ def streaming_rank_certificate(
     source's answer without re-reading it). ``retry`` (see
     :func:`streaming_kselect_many`; None = the bounded default) gives
     the counting pass mid-pass re-pull on transient source errors and
-    in-place staging retries — counts are bit-identical on recovery."""
+    in-place staging retries — counts are bit-identical on recovery.
+    ``width_schedule``/``pack_spill`` are accepted (and validated — a
+    typo must raise here like on every other entry point, so one knob
+    dict can serve a whole workload) but are no-ops: the certificate is
+    a single comparison pass with no digit histogram to widen and no
+    survivor generation to pack. Reading a PACKED store-as-source works
+    regardless — record format is a property of the store, not the
+    reader."""
+    validate_width_schedule(width_schedule)
+    _sp.validate_pack_spill(pack_spill)
     defer = _ex.resolve_deferred(deferred)
     # fusion is a deferral discipline (streaming_kselect_many's rule);
     # the knob validates on the eager route too

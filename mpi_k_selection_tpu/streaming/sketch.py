@@ -211,7 +211,7 @@ class RadixSketch:
 
     def update_stream(
         self, source, *, pipeline_depth=None, timer=None, devices=None,
-        spill=None, fused=None, obs=None,
+        spill=None, fused=None, pack_spill=None, obs=None,
     ) -> "RadixSketch":
         """Fold EVERY chunk of a replayable/listed ``source`` in (one
         stream pass), drawing from the pipelined iterator: a background
@@ -246,6 +246,17 @@ class RadixSketch:
         per bucket) instead of the historical 2-program pair, which the
         ``"xla"``/``"off"`` tiers keep. Bit-identical either way.
 
+        ``pack_spill="auto"`` tees the generation in spill.py's format
+        v2, segmented by each key's top digit
+        (:data:`~mpi_k_selection_tpu.streaming.spill.GEN0_SEGMENT_BITS`)
+        — exactly like the descent's own pass-0 tee. A later
+        :meth:`refine`/:meth:`refine_many` over the store then PRUNES its
+        sketch-seeded first pass to the segments under the surviving
+        sketch buckets instead of re-reading the whole generation, and
+        each record sheds its stored top bits on disk. ``"off"`` (the
+        ``None`` default) keeps the full-width v1 records. Bit-identical
+        answers either way.
+
         ``obs`` (an :class:`~mpi_k_selection_tpu.obs.Observability`) emits
         per-chunk ingest events, a ``sketch.pass`` summary event, window
         occupancy samples and the StagingPool counters — off by default,
@@ -265,6 +276,7 @@ class RadixSketch:
         )
 
         pipeline_depth = _pl.validate_pipeline_depth(pipeline_depth)
+        pack_spill = _sp.validate_pack_spill(pack_spill)
         devs = _pl.resolve_stream_devices(devices)
         # the staged fold is deferred by construction (it rides the FIFO
         # window), so the tier resolves unconditionally
@@ -282,7 +294,14 @@ class RadixSketch:
                 f"owns its lifecycle), got {type(spill).__name__!r}"
             )
         src = as_chunk_source(source, one_shot_ok=spill is not None)
-        writer = spill.new_generation() if spill is not None else None
+        writer = (
+            spill.new_generation(
+                pack_digit_bits=(
+                    _sp.GEN0_SEGMENT_BITS if pack_spill == "auto" else None
+                )
+            )
+            if spill is not None else None
+        )
         chunk_i = keys_read = 0
         ex = keys = None
         try:
@@ -659,14 +678,20 @@ class RadixSketch:
         b, lo, hi = self._bucket(k)
         return b, int(k) - lo, self.resolution_bits, hi - lo
 
-    def check_stream(self, dtype, radix_bits: int) -> None:
+    def check_stream(self, dtype, radix_bits: int, width_schedule="off") -> None:
         """Validate that a chunked descent with ``radix_bits`` can continue
         from this sketch's resolved prefix (streaming/chunked.py calls this
-        before seeding)."""
+        before seeding). With a non-``"off"`` ``width_schedule`` the
+        divisibility constraint moves to the schedule itself
+        (chunked.py:resolve_width_schedule validates that the widths sum
+        to the remaining bits, whatever ``radix_bits`` is) — only the
+        dtype agreement is checked here."""
         if np.dtype(dtype) != self.dtype:
             raise TypeError(
                 f"stream dtype {np.dtype(dtype)} != sketch dtype {self.dtype}"
             )
+        if width_schedule != "off":
+            return
         remaining = self.total_bits - self.resolution_bits
         if remaining % radix_bits:
             raise ValueError(

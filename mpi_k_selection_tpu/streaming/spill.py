@@ -21,6 +21,21 @@ module is that discipline applied to the streaming axis:
   one-shot (non-replayable) sources become first-class: passes >= 1 never
   touch the source.
 
+Format v2 (``pack_spill="auto"``, streaming/chunked.py) shrinks the disk
+AND the replay-read side further: a survivor generation's records store
+only the unresolved low ``total_bits - resolved`` bits of each key,
+bit-packed per ``(resolved, prefix)`` segment (the tee union mixes
+prefixes, so each record carries a segment directory), CRC'd per segment
+and reconstructed exactly at replay — disk bytes shrink multiplicatively
+with population AND resolved depth. The pass-0 tee writes the same
+format segmented by each key's top :data:`GEN0_SEGMENT_BITS` digit
+(``pack_digit_bits``), and filtered replays PRUNE through the directory
+to the segments under their surviving prefixes — so the historical
+second full-N read of generation 0 collapses to a read of the surviving
+buckets. Records where packing would not help (directory-dominated tiny
+chunks) fall back to v1 per record, so a generation's physical bytes
+(``nbytes``) never exceed its logical bytes (``logical_nbytes``).
+
 Records are bucket-sized and keyed by ``(chunk_index, bucket, dtype,
 device)`` — the :class:`~mpi_k_selection_tpu.streaming.pipeline.
 StagingPool` key plus the chunk index — so a replay re-stages every chunk
@@ -79,11 +94,61 @@ from mpi_k_selection_tpu.streaming.pipeline import _bucket_elems
 #: The ``spill=`` knob's string modes (a SpillStore instance is also legal).
 SPILL_MODES = ("auto", "off", "force")
 
+#: The ``pack_spill`` knob's modes: ``"auto"`` writes format-v2
+#: prefix-packed records wherever packing actually shrinks the record
+#: (falling back to v1 per record otherwise — so physical bytes never
+#: exceed logical bytes), ``"off"`` keeps the v1 full-width records.
+PACK_SPILL_MODES = ("auto", "off")
+
 _MAGIC = b"KSPILL1\x00"
 _VERSION = 1
+#: Format v2 — prefix-packed records: the payload stores, per
+#: ``(resolved, prefix)`` segment, only the unresolved low
+#: ``total_bits - resolved`` bits of each survivor, bit-packed. The base
+#: header is unchanged (same magic/struct); ``crc32``/``nbytes`` describe
+#: the PACKED tail (segment directory + payloads), and the reader
+#: reconstructs the full-width keys exactly.
+_VERSION_PACKED = 2
 # magic, version, chunk_index, n_valid, bucket, device_slot,
-# key dtype str, orig dtype str, payload crc32, payload nbytes
+# key dtype str, orig dtype str, crc32, payload nbytes. The crc covers
+# the whole payload for v1; for v2 it covers the SEGMENT DIRECTORY only
+# (each directory entry carries its own payload crc — see _SEG_ENTRY),
+# so pruned reads validate exactly what they touch.
 _HEADER = struct.Struct("<8sIqqqq8s8sIQ")
+# v2 segment directory: one count, then per segment (resolved_bits,
+# prefix, n_keys, payload crc32); payloads follow in directory order,
+# each byte-aligned. The header's crc32 covers the DIRECTORY bytes and
+# each entry's crc covers its own payload — so a replay that PRUNES to
+# the segments matching its filter specs checksums exactly what it
+# reads, without touching the pruned-away bytes (which cannot reach a
+# consumer, hence cannot corrupt an answer).
+_SEG_COUNT = struct.Struct("<q")
+# resolved_bits (u8 — key widths cap at 64), prefix (u64), n_keys (u32 —
+# records cap at the int32 device-partial chunk bound), payload crc (u32)
+_SEG_ENTRY = struct.Struct("<BQII")
+#: Top-digit granularity of a ``pack_digit_bits`` tee (the pass-0 /
+#: sketch tee under ``pack_spill="auto"``): records segment by the top
+#: ``GEN0_SEGMENT_BITS`` of each key, so a later pass's filtered replay
+#: seeks straight to the surviving buckets and reads ~population/2^8 of
+#: the generation instead of all of it. 8 keeps the per-record directory
+#: at <= 256 entries (bounded overhead on small chunks) while any deeper
+#: filter spec still prunes through it (ancestor matching).
+GEN0_SEGMENT_BITS = 8
+#: Values per ``np.packbits`` slice — a multiple of 8, so every slice of
+#: the bit stream is byte-aligned and pack/unpack can work in bounded
+#: memory without splitting a byte across slices.
+_PACK_SLICE = 1 << 16
+
+
+def validate_pack_spill(pack_spill):
+    """Normalize the ``pack_spill`` knob (None = the ``"off"`` default)."""
+    if pack_spill is None:
+        return "off"
+    if pack_spill in PACK_SPILL_MODES:
+        return pack_spill
+    raise ValueError(
+        f"pack_spill must be one of {PACK_SPILL_MODES}, got {pack_spill!r}"
+    )
 
 
 def validate_spill_mode(spill):
@@ -114,11 +179,238 @@ def _unpack_dtype(raw: bytes, path: str) -> np.dtype:
         raise SpillRecordError(f"spill record {path}: bad dtype tag {raw!r}") from e
 
 
+def _pack_low_bits(vals: np.ndarray, width: int) -> np.ndarray:
+    """Bit-pack ``vals`` (uint64, each < 2**width) big-endian-within-value
+    into a uint8 array of ``ceil(len(vals) * width / 8)`` bytes (the final
+    byte zero-padded). Works in :data:`_PACK_SLICE`-value slices so the
+    transient bit expansion stays bounded regardless of chunk size."""
+    n = int(vals.shape[0])
+    if n == 0:
+        return np.empty((0,), np.uint8)
+    shifts = np.arange(width - 1, -1, -1, dtype=np.uint64)
+    parts = []
+    for lo in range(0, n, _PACK_SLICE):
+        part = vals[lo:lo + _PACK_SLICE]
+        bits = ((part[:, None] >> shifts) & np.uint64(1)).astype(np.uint8)
+        parts.append(np.packbits(bits.ravel()))
+    return parts[0] if len(parts) == 1 else np.concatenate(parts)
+
+
+def _unpack_low_bits(buf: np.ndarray, count: int, width: int) -> np.ndarray:
+    """Exact inverse of :func:`_pack_low_bits`: ``buf`` (uint8) back to a
+    uint64 array of ``count`` values."""
+    if count == 0:
+        return np.empty((0,), np.uint64)
+    shifts = np.arange(width - 1, -1, -1, dtype=np.uint64)
+    out = np.empty((count,), np.uint64)
+    slice_bytes = _PACK_SLICE * width // 8
+    for i, lo in enumerate(range(0, count, _PACK_SLICE)):
+        cnt = min(_PACK_SLICE, count - lo)
+        seg = buf[i * slice_bytes:i * slice_bytes + (cnt * width + 7) // 8]
+        bits = np.unpackbits(
+            np.ascontiguousarray(seg), count=cnt * width
+        ).reshape(cnt, width).astype(np.uint64)
+        out[lo:lo + cnt] = (bits << shifts).sum(axis=1, dtype=np.uint64)
+    return out
+
+
+def _pack_payload(keys: np.ndarray, specs, total_bits: int):
+    """Build a v2 record tail: segment ``keys`` by the DEEPEST matching
+    ``(resolved_bits, prefix)`` spec (the tee union mixes prefixes whose
+    resolved depths differ — parked ranks sit shallower than the active
+    set — and a key under a deep prefix also matches every shallower
+    ancestor, so deepest-first assignment packs each key as small as its
+    true spec allows), then bit-pack each segment's unresolved low
+    ``total_bits - resolved`` bits, CRC'ing each segment's packed bytes
+    into its directory entry. Returns ``(tail, dir_nbytes, segments)``:
+    the directory + payloads as one contiguous uint8 array, the
+    directory's byte length, and the ``(resolved, prefix, count)``
+    layout tuple the writer records for static pruned-read accounting.
+    A key matching NO spec is a tee-filter bug and raises
+    :class:`~mpi_k_selection_tpu.errors.SpillError` loudly."""
+    u = np.ascontiguousarray(keys).astype(np.uint64)
+    ordered = sorted(specs, key=lambda s: (-s[0], s[1]))
+    segments = []
+    if len({r for r, _ in ordered}) == 1:
+        # uniform-depth fast path (the digit-segmented tee, and filter
+        # unions with no parked ranks): ONE stable sort groups every
+        # segment instead of one boolean sweep per spec — original key
+        # order is preserved within each segment either way
+        r0 = ordered[0][0]
+        tops = (
+            u >> np.uint64(total_bits - r0)
+            if r0 else np.zeros(u.shape[0], np.uint64)
+        )
+        order = np.argsort(tops, kind="stable")
+        su, stops = u[order], tops[order]
+        pref = np.asarray([p for _, p in ordered], np.uint64)
+        lo = np.searchsorted(stops, pref, side="left")
+        hi = np.searchsorted(stops, pref, side="right")
+        if int((hi - lo).sum()) != u.shape[0]:
+            raise SpillError(
+                f"packed spill writer: {u.shape[0] - int((hi - lo).sum())} "
+                "keys match no (resolved, prefix) spec — the tee filter "
+                "and the pack specs disagree (a bug in streaming/"
+                "chunked.py, not in the stream)"
+            )
+        width = total_bits - r0
+        mask = np.uint64((1 << width) - 1) if width < 64 else None
+        for (rr, pp), start, stop in zip(ordered, lo, hi):
+            vals = su[start:stop]
+            if mask is not None:
+                vals = vals & mask
+            segments.append(
+                (int(rr), int(pp), int(stop - start),
+                 _pack_low_bits(vals, width))
+            )
+    else:
+        assigned = np.zeros(u.shape[0], dtype=bool)
+        for resolved, prefix in ordered:
+            sel = ~assigned
+            if resolved:
+                sel &= (
+                    u >> np.uint64(total_bits - resolved)
+                ) == np.uint64(prefix)
+            vals = u[sel]
+            assigned |= sel
+            width = total_bits - resolved
+            if width < 64:
+                vals = vals & np.uint64((1 << width) - 1)
+            segments.append(
+                (int(resolved), int(prefix), int(vals.shape[0]),
+                 _pack_low_bits(vals, width))
+            )
+        if not bool(assigned.all()):
+            raise SpillError(
+                f"packed spill writer: {int((~assigned).sum())} keys match "
+                "no (resolved, prefix) spec — the tee filter and the pack "
+                "specs disagree (a bug in streaming/chunked.py, not in the "
+                "stream)"
+            )
+    parts = [np.frombuffer(_SEG_COUNT.pack(len(segments)), np.uint8)]
+    for resolved, prefix, count, payload in segments:
+        parts.append(
+            np.frombuffer(
+                _SEG_ENTRY.pack(
+                    resolved, prefix, count,
+                    zlib.crc32(payload.data) & 0xFFFFFFFF,
+                ),
+                np.uint8,
+            )
+        )
+    parts.extend(payload for *_, payload in segments)
+    dir_nbytes = _SEG_COUNT.size + len(segments) * _SEG_ENTRY.size
+    layout = tuple((r, p, c) for r, p, c, _ in segments)
+    return np.concatenate(parts), dir_nbytes, layout
+
+
+def _segment_matches(r_seg: int, p_seg: int, specs) -> bool:
+    """True when a ``(r_seg, p_seg)`` segment may hold keys under ANY
+    ``(resolved, prefix)`` filter spec: a deeper filter matches iff the
+    segment prefix is its ancestor, a shallower one iff the segment sits
+    under it — keys live in exactly one segment, so a pruned read that
+    keeps every matching segment keeps every key a filtered consumer
+    could possibly select."""
+    for r_f, p_f in specs:
+        if r_f >= r_seg:
+            if (p_f >> (r_f - r_seg) if r_f > r_seg else p_f) == p_seg:
+                return True
+        elif (p_seg >> (r_seg - r_f)) == p_f:
+            return True
+    return False
+
+
+def _read_packed(read_at, nbytes, n_valid, key_dt, dir_crc, path,
+                 filter_specs=None) -> np.ndarray:
+    """Directory-driven v2 record read: validate the segment directory
+    (its own CRC is the record header's ``crc32``), then read, checksum
+    and reconstruct each segment — ONLY the segments matching
+    ``filter_specs`` when given, seeking past the rest, which is what
+    turns a filtered replay's full-generation read into a read of the
+    surviving buckets. ``read_at(offset, size)`` serves bytes relative
+    to the payload start (file seek+read, or an mmap slice, so pruning
+    skips real I/O on both routes); any truncation, count/size
+    inconsistency or checksum mismatch raises
+    :class:`~mpi_k_selection_tpu.errors.SpillRecordError` before a single
+    key reaches a consumer."""
+    total_bits = key_dt.itemsize * 8
+    if nbytes < _SEG_COUNT.size:
+        raise SpillRecordError(
+            f"spill record {path}: truncated segment directory"
+        )
+    head = read_at(0, _SEG_COUNT.size)
+    (nseg,) = _SEG_COUNT.unpack(head.tobytes())
+    dirlen = _SEG_COUNT.size + nseg * _SEG_ENTRY.size
+    if nseg < 0 or dirlen > nbytes:
+        raise SpillRecordError(
+            f"spill record {path}: segment directory of {nseg} entries "
+            "does not fit the payload"
+        )
+    dirbytes = read_at(0, dirlen)
+    if (zlib.crc32(dirbytes) & 0xFFFFFFFF) != dir_crc:
+        raise SpillRecordError(
+            f"spill record {path}: checksum mismatch (corrupt segment "
+            "directory)"
+        )
+    entries = []
+    pos = _SEG_COUNT.size
+    raw_dir = dirbytes.tobytes()
+    for _ in range(nseg):
+        r, p, c, seg_crc = _SEG_ENTRY.unpack_from(raw_dir, pos)
+        pos += _SEG_ENTRY.size
+        if not 0 <= r < total_bits or c < 0 or (p >> r if r else p):
+            raise SpillRecordError(
+                f"spill record {path}: bad segment (resolved={r}, "
+                f"prefix={p:#x}, count={c}) for {total_bits}-bit keys"
+            )
+        entries.append((r, p, c, seg_crc))
+    if sum(c for _, _, c, _ in entries) != n_valid:
+        raise SpillRecordError(
+            f"spill record {path}: segment counts sum to "
+            f"{sum(c for _, _, c, _ in entries)}, header says "
+            f"{n_valid} keys"
+        )
+    expect = dirlen + sum(
+        (c * (total_bits - r) + 7) // 8 for r, _, c, _ in entries
+    )
+    if expect != nbytes:
+        raise SpillRecordError(
+            f"spill record {path}: packed payload is {nbytes} bytes, "
+            f"segment directory implies {expect}"
+        )
+    off = dirlen
+    parts = []
+    for r, p, c, seg_crc in entries:
+        width = total_bits - r
+        nb = (c * width + 7) // 8
+        if c and (
+            filter_specs is None or _segment_matches(r, p, filter_specs)
+        ):
+            buf = read_at(off, nb)
+            if (zlib.crc32(buf) & 0xFFFFFFFF) != seg_crc:
+                raise SpillRecordError(
+                    f"spill record {path}: checksum mismatch (corrupt "
+                    f"segment resolved={r} prefix={p:#x})"
+                )
+            low = _unpack_low_bits(buf, c, width)
+            if r:
+                low |= np.uint64(p << width)
+            parts.append(low.astype(key_dt))
+        off += nb
+    if not parts:
+        return np.empty((0,), key_dt)
+    return parts[0] if len(parts) == 1 else np.concatenate(parts)
+
+
 @dataclasses.dataclass(frozen=True)
 class SpillRecord:
     """On-disk metadata of one spilled chunk — the ``(chunk_index, bucket,
     dtype, device)`` key plus payload size/checksum. The header written to
-    disk repeats all of it, and the reader cross-checks both."""
+    disk repeats all of it, and the reader cross-checks both. ``nbytes``
+    and ``crc32`` describe the PHYSICAL payload — the full-width keys for
+    format v1, the packed tail (directory + bit-packed segments) for v2;
+    ``logical_nbytes`` is always the full-width key bytes a pass reading
+    this record streams into its consumers."""
 
     path: str
     chunk_index: int
@@ -129,6 +421,19 @@ class SpillRecord:
     orig_dtype: np.dtype
     crc32: int
     nbytes: int
+    version: int = _VERSION
+    #: v2 records: the ``(resolved, prefix, count)`` segment layout the
+    #: writer produced — what :meth:`SpillGeneration.read_nbytes` prices
+    #: a pruned read against without touching disk. ``None`` for v1.
+    segments: tuple | None = None
+
+    @property
+    def packed(self) -> bool:
+        return self.version >= _VERSION_PACKED
+
+    @property
+    def logical_nbytes(self) -> int:
+        return self.n_valid * self.key_dtype.itemsize
 
 
 @dataclasses.dataclass(frozen=True)
@@ -149,12 +454,46 @@ class SpillWriter:
     """Append-only writer for ONE spill generation. ``append`` is called
     from a single thread per pass (the pipeline's producer for the pass-0
     tee, the descent's consumer for the filtered survivor writes);
-    ``commit``/``abort`` run after the pass's threads are joined."""
+    ``commit``/``abort`` run after the pass's threads are joined.
 
-    def __init__(self, store: "SpillStore", index: int, path: str):
+    With ``pack_specs`` (the pass's ``(resolved_bits, prefix)`` filter
+    union) and ``total_bits``, each appended record is prefix-packed on
+    the appending thread into format v2 — only the unresolved low bits of
+    each survivor hit disk — whenever the packed form is actually smaller
+    than the full-width record (tiny records where the segment directory
+    would dominate fall back to v1 per record, so a generation's physical
+    bytes never exceed its logical bytes).
+
+    ``pack_digit_bits`` is the UNFILTERED tee's form of the same format
+    (the pass-0 / sketch tee, where nothing is resolved yet and there is
+    no spec union): each record segments its keys by their top
+    ``pack_digit_bits`` bits, with the specs derived per record from the
+    digits actually present. The point is less the pack (it strips only
+    ``pack_digit_bits`` per key) than the DIRECTORY: a later pass's
+    filtered replay prunes straight to the segments under its surviving
+    prefixes, which is what deletes the historical second full-N read."""
+
+    def __init__(
+        self, store: "SpillStore", index: int, path: str,
+        pack_specs=None, total_bits: int | None = None,
+        pack_digit_bits: int | None = None,
+    ):
         self.store = store
         self.index = index
         self.path = path
+        if pack_specs is not None and total_bits is None:  # pragma: no cover
+            raise SpillError("pack_specs requires total_bits")
+        if pack_specs is not None and pack_digit_bits:  # pragma: no cover
+            raise SpillError("pack_specs and pack_digit_bits are exclusive")
+        self._pack_specs = (
+            None if pack_specs is None else tuple(
+                (int(r), int(p)) for r, p in pack_specs
+            )
+        )
+        self._total_bits = total_bits
+        self._pack_digit_bits = (
+            int(pack_digit_bits) if pack_digit_bits else None
+        )
         os.makedirs(path)
         self._records: list[SpillRecord] = []
         self._count = 0
@@ -183,10 +522,35 @@ class SpillWriter:
         n = int(keys.shape[0])
         slot = -1 if device_slot is None else int(device_slot)
         rec_path = os.path.join(self.path, f"r{self._count:08d}.kspill")
-        crc = zlib.crc32(keys.data) & 0xFFFFFFFF
+        specs, total_bits = self._pack_specs, self._total_bits
+        if specs is None and self._pack_digit_bits is not None and n:
+            # digit-segmented tee: specs derive from the record's own
+            # keys (the digits present), so every key assigns and empty
+            # segments never burden the directory
+            total_bits = keys.dtype.itemsize * 8
+            s = min(self._pack_digit_bits, total_bits - 1)
+            tops = np.unique(
+                np.ascontiguousarray(keys).astype(np.uint64)
+                >> np.uint64(total_bits - s)
+            )
+            specs = tuple((s, int(t)) for t in tops)
+        version, payload, layout = _VERSION, keys, None
+        if specs is not None:
+            tail, dir_nbytes, seg_layout = _pack_payload(
+                keys, specs, total_bits
+            )
+            if tail.nbytes < keys.nbytes:
+                # packing wins only when the directory + packed segments
+                # undercut the full-width record — per record, so a
+                # packed generation is never physically larger than v1
+                version, payload, layout = _VERSION_PACKED, tail, seg_layout
+        crc = zlib.crc32(
+            payload[:dir_nbytes].data if version == _VERSION_PACKED
+            else payload.data
+        ) & 0xFFFFFFFF
         header = _HEADER.pack(
             _MAGIC,
-            _VERSION,
+            version,
             self._count,
             n,
             _bucket_elems(n),
@@ -194,11 +558,11 @@ class SpillWriter:
             _pack_dtype(keys.dtype),
             _pack_dtype(orig_dtype),
             crc,
-            keys.nbytes,
+            payload.nbytes,
         )
         with open(rec_path, "wb") as f:
             f.write(header)
-            f.write(keys.data)
+            f.write(payload.data)
         rec = SpillRecord(
             path=rec_path,
             chunk_index=self._count,
@@ -208,7 +572,9 @@ class SpillWriter:
             key_dtype=np.dtype(keys.dtype),
             orig_dtype=np.dtype(orig_dtype),
             crc32=crc,
-            nbytes=int(keys.nbytes),
+            nbytes=int(payload.nbytes),
+            version=version,
+            segments=layout,
         )
         self._records.append(rec)
         self._count += 1
@@ -246,37 +612,110 @@ class SpillGeneration:
 
     @property
     def nbytes(self) -> int:
-        """Total payload bytes (the bytes a pass reading this gen streams)."""
+        """Total PHYSICAL payload bytes on disk (packed size for v2
+        records) — what the generation costs in disk and disk-read I/O."""
         return sum(r.nbytes for r in self.records)
+
+    @property
+    def logical_nbytes(self) -> int:
+        """Total full-width key bytes a pass reading this generation
+        streams into its consumers (== ``nbytes`` for all-v1 gens)."""
+        return sum(r.logical_nbytes for r in self.records)
+
+    @property
+    def packed(self) -> bool:
+        """True when any record is format-v2 prefix-packed."""
+        return any(r.packed for r in self.records)
 
     @property
     def keys(self) -> int:
         return sum(r.n_valid for r in self.records)
 
-    def iter_chunks(self, mmap: bool = False):
+    def iter_chunks(self, mmap: bool = False, filter_specs=None):
         """Yield every record as a :class:`SpillChunk`, validating headers,
         sizes and checksums — any mismatch raises
         :class:`~mpi_k_selection_tpu.errors.SpillRecordError`. With
         ``mmap`` the payload is served as a read-only ``np.memmap`` view
         (page-cache backed, checksummed in place) instead of a fresh heap
         copy — the deferred executor's replay mode, where most of each
-        record's bytes are about to be filtered away on device anyway."""
+        record's bytes are about to be filtered away on device anyway.
+
+        ``filter_specs`` (a ``(resolved_bits, prefix)`` union) PRUNES the
+        read of v2 records to the segments that may hold matching keys,
+        seeking past the rest — the consumers' own exact filters see
+        every key they would have selected from the full read (segment
+        pruning is a superset of the spec filter), so answers are
+        bit-identical while the generation's I/O shrinks to the surviving
+        buckets. v1 records have no directory and are always read whole;
+        records left with no matching segment (or no keys) are skipped
+        entirely."""
         if self.dropped:
             raise SpillError(
                 f"spill generation {self.index} was dropped (or its store "
                 "closed); it can no longer serve as a chunk source"
             )
         for rec in self.records:
-            yield _read_record(rec, mmap=mmap)
+            chunk = _read_record(rec, mmap=mmap, filter_specs=filter_specs)
+            if filter_specs is not None and chunk.keys.shape[0] == 0:
+                continue
+            yield chunk
 
-    def as_source(self, mmap: bool = False):
+    def as_source(self, mmap: bool = False, filter_specs=None):
         """Zero-arg callable returning a fresh record iterator — the
-        replayable chunk-source form streaming/chunked.py consumes."""
-        if not mmap:
+        replayable chunk-source form streaming/chunked.py consumes.
+        ``filter_specs`` prunes v2 records to matching segments (see
+        :meth:`iter_chunks`)."""
+        if not mmap and filter_specs is None:
             return self.iter_chunks
         import functools
 
-        return functools.partial(self.iter_chunks, mmap=True)
+        return functools.partial(
+            self.iter_chunks, mmap=mmap,
+            filter_specs=(
+                None if filter_specs is None
+                else tuple((int(r), int(p)) for r, p in filter_specs)
+            ),
+        )
+
+    def read_nbytes(self, filter_specs=None) -> int:
+        """PHYSICAL bytes a (possibly pruned) read of this generation
+        touches: every v1 record whole; for v2 records the directory plus
+        the segments matching ``filter_specs`` — priced statically from
+        the writers' recorded segment layouts, so the descent's disk
+        accounting needs no second pass over the files."""
+        if filter_specs is None:
+            return self.nbytes
+        specs = tuple((int(r), int(p)) for r, p in filter_specs)
+        total = 0
+        for rec in self.records:
+            if rec.segments is None:
+                total += rec.nbytes
+                continue
+            bits = rec.key_dtype.itemsize * 8
+            total += _SEG_COUNT.size + len(rec.segments) * _SEG_ENTRY.size
+            total += sum(
+                (c * (bits - r) + 7) // 8
+                for r, p, c in rec.segments
+                if _segment_matches(r, p, specs)
+            )
+        return total
+
+    def read_keys(self, filter_specs=None) -> int:
+        """Keys a (possibly pruned) read of this generation streams into
+        its consumers — the logical twin of :meth:`read_nbytes`."""
+        if filter_specs is None:
+            return self.keys
+        specs = tuple((int(r), int(p)) for r, p in filter_specs)
+        total = 0
+        for rec in self.records:
+            if rec.segments is None:
+                total += rec.n_valid
+            else:
+                total += sum(
+                    c for r, p, c in rec.segments
+                    if _segment_matches(r, p, specs)
+                )
+        return total
 
     def __repr__(self) -> str:  # pragma: no cover - debugging aid
         return (
@@ -285,7 +724,9 @@ class SpillGeneration:
         )
 
 
-def _read_record(rec: SpillRecord, mmap: bool = False) -> SpillChunk:
+def _read_record(
+    rec: SpillRecord, mmap: bool = False, filter_specs=None
+) -> SpillChunk:
     # chaos hook, keyed by the record's chunk index: transient raises and
     # checksum blips fire here; the persistent kinds (corrupt_disk,
     # truncate) damage the file on disk and fall through, so the REAL
@@ -308,7 +749,7 @@ def _read_record(rec: SpillRecord, mmap: bool = False) -> SpillChunk:
             magic, version, chunk_index, n_valid, bucket, slot,
             key_dt_raw, orig_dt_raw, crc, nbytes,
         ) = _HEADER.unpack(head)
-        if magic != _MAGIC or version != _VERSION:
+        if magic != _MAGIC or version not in (_VERSION, _VERSION_PACKED):
             raise SpillRecordError(
                 f"spill record {rec.path}: bad magic/version "
                 f"({magic!r}, {version})"
@@ -316,11 +757,11 @@ def _read_record(rec: SpillRecord, mmap: bool = False) -> SpillChunk:
         key_dt = _unpack_dtype(key_dt_raw, rec.path)
         orig_dt = _unpack_dtype(orig_dt_raw, rec.path)
         meta = (
-            chunk_index, n_valid, bucket,
+            version, chunk_index, n_valid, bucket,
             None if slot < 0 else slot, key_dt, orig_dt, crc, nbytes,
         )
         want = (
-            rec.chunk_index, rec.n_valid, rec.bucket,
+            rec.version, rec.chunk_index, rec.n_valid, rec.bucket,
             rec.device_slot, rec.key_dtype, rec.orig_dtype, rec.crc32, rec.nbytes,
         )
         if meta != want:
@@ -328,12 +769,14 @@ def _read_record(rec: SpillRecord, mmap: bool = False) -> SpillChunk:
                 f"spill record {rec.path}: header does not match the "
                 f"writer's metadata (header {meta}, expected {want})"
             )
-        if nbytes != n_valid * key_dt.itemsize:
+        if version == _VERSION and nbytes != n_valid * key_dt.itemsize:
+            # a v2 payload's size is validated against its own segment
+            # directory inside _unpack_payload instead
             raise SpillRecordError(
                 f"spill record {rec.path}: payload size {nbytes} != "
                 f"{n_valid} x {key_dt.itemsize}-byte keys"
             )
-        if not mmap:
+        if not mmap and version == _VERSION:
             payload = f.read(nbytes)
             if len(payload) != nbytes:
                 raise SpillRecordError(
@@ -345,25 +788,59 @@ def _read_record(rec: SpillRecord, mmap: bool = False) -> SpillChunk:
                     f"spill record {rec.path}: checksum mismatch (corrupt payload)"
                 )
             keys = np.frombuffer(payload, dtype=key_dt)
+        elif not mmap:
+            # v2 on the read route: seek-driven — the directory names
+            # every segment's offset, so a pruned read's file I/O really
+            # is only the directory plus the matching segments
+            def _file_at(off, size, f=f):
+                f.seek(_HEADER.size + off)
+                buf = f.read(size)
+                if len(buf) != size:
+                    raise SpillRecordError(
+                        f"spill record {rec.path}: truncated payload "
+                        f"({len(buf)} of {size} bytes at offset {off})"
+                    )
+                return np.frombuffer(buf, np.uint8)
+
+            keys = _read_packed(
+                _file_at, int(nbytes), int(n_valid), key_dt, crc, rec.path,
+                filter_specs,
+            )
     if mmap and n_valid == 0:  # pragma: no cover - writers skip empty chunks
         keys = np.empty((0,), key_dt)
     elif mmap:
         # read-only page-cache view of the payload (no heap copy); the
-        # checksum still runs over EVERY payload byte before a single key
-        # reaches a consumer — mmap changes residency, never the contract
+        # checksum still runs over every payload byte a consumer can see
+        # before a single key reaches it (v2 pruned reads checksum the
+        # directory + each read segment) — mmap changes residency, never
+        # the contract
         try:
-            keys = np.memmap(  # read-only payload view inside the sanctioned spill module (KSL008 exempts spill.py; the staleness audit retired the old noqa)
-                rec.path, dtype=key_dt, mode="r",
-                offset=_HEADER.size, shape=(int(n_valid),),
+            raw = np.memmap(  # read-only payload view inside the sanctioned spill module (KSL008 exempts spill.py; the staleness audit retired the old noqa)
+                rec.path,
+                dtype=key_dt if version == _VERSION else np.uint8,
+                mode="r", offset=_HEADER.size,
+                shape=(int(n_valid if version == _VERSION else nbytes),),
             )
         except (OSError, ValueError) as e:
             raise SpillRecordError(
                 f"spill record {rec.path}: truncated payload (mmap of "
                 f"{nbytes} bytes failed: {e})"
             ) from e
-        if (zlib.crc32(keys) & 0xFFFFFFFF) != crc:
-            raise SpillRecordError(
-                f"spill record {rec.path}: checksum mismatch (corrupt payload)"
+        if version == _VERSION:
+            if (zlib.crc32(raw) & 0xFFFFFFFF) != crc:
+                raise SpillRecordError(
+                    f"spill record {rec.path}: checksum mismatch (corrupt payload)"
+                )
+            keys = raw  # v1 serves the page-cache view itself
+        else:
+            # a packed record necessarily reconstructs onto the heap
+            # (bits -> full keys); pruned segments' pages stay untouched
+            def _mem_at(off, size, raw=raw):
+                return raw[off:off + size]
+
+            keys = _read_packed(
+                _mem_at, int(nbytes), int(n_valid), key_dt, crc, rec.path,
+                filter_specs,
             )
     return SpillChunk(
         keys=keys,
@@ -391,8 +868,11 @@ class SpillStore:
         self.generations: dict[int, SpillGeneration] = {}
         #: One dict per streamed pass of a spill-enabled descent:
         #: ``{"pass", "read", "keys_read", "bytes_read"[, "keys_written",
-        #: "bytes_written"]}`` — the raw material of bench_streaming_oc's
-        #: ``_spill`` record (pass_shrink_ratio).
+        #: "bytes_written", "disk_bytes_read", "disk_bytes_written"]}`` —
+        #: the raw material of bench_streaming_oc's ``_spill`` record
+        #: (pass_shrink_ratio, disk_bytes_ratio). ``bytes_*`` are LOGICAL
+        #: full-width key bytes; the ``disk_bytes_*`` columns are the
+        #: physical on-disk bytes (smaller for packed v2 generations).
         self.pass_log: list[dict] = []
         self._counter = 0
         self._closed = False
@@ -405,11 +885,26 @@ class SpillStore:
         if self._closed:
             raise SpillError("spill store is closed")
 
-    def new_generation(self) -> SpillWriter:
+    def new_generation(
+        self, pack_specs=None, total_bits=None, pack_digit_bits=None,
+    ) -> SpillWriter:
+        """Open a writer for the next generation. ``pack_specs`` (a
+        ``(resolved_bits, prefix)`` union) + ``total_bits`` turn on the
+        format-v2 prefix packing for every record the writer appends —
+        the descent passes its tee filter specs here under
+        ``pack_spill="auto"``. ``pack_digit_bits`` is the unfiltered
+        (pass-0 / sketch) tee's v2 mode: records segment by their keys'
+        top digit so later filtered replays can prune (see
+        :class:`SpillWriter`). ``None`` for both keeps the full-width v1
+        records."""
         self._check_open()
         idx = self._counter
         self._counter += 1
-        return SpillWriter(self, idx, os.path.join(self.root, f"gen-{idx:04d}"))
+        return SpillWriter(
+            self, idx, os.path.join(self.root, f"gen-{idx:04d}"),
+            pack_specs=pack_specs, total_bits=total_bits,
+            pack_digit_bits=pack_digit_bits,
+        )
 
     def _register(self, gen: SpillGeneration) -> None:
         self._check_open()

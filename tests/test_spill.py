@@ -507,3 +507,217 @@ def test_cli_spill_flags(tmp_path, capsys):
     assert passes[0]["pass"] == 0 and passes[0]["keys_written"] == 40000
     # the store is gone afterwards (only the empty root dir may remain)
     assert not glob.glob(os.path.join(str(tmp_path), SPILL_DIR_PREFIX + "*"))
+
+# -- the packed (format v2) record surface ------------------------------------
+
+
+def _random_packed_population(rng, total_bits, n_specs, max_per_spec):
+    """Random ``(keys, specs)`` with ragged (possibly EMPTY) segments:
+    each spec is a random ``(resolved, prefix)`` at a random depth, and
+    each key is drawn under one spec's prefix — the shape of a filtered
+    survivor write (mixed depths = parked ranks among the active set)."""
+    specs, parts = [], []
+    seen = set()
+    for _ in range(n_specs):
+        resolved = int(rng.integers(0, total_bits))  # 0..total_bits-1
+        prefix = int(rng.integers(0, 1 << resolved)) if resolved else 0
+        if (resolved, prefix) in seen:
+            continue
+        seen.add((resolved, prefix))
+        specs.append((resolved, prefix))
+        count = int(rng.integers(0, max_per_spec + 1))  # ragged incl. empty
+        width = total_bits - resolved
+        low = rng.integers(0, 1 << min(width, 63), size=count).astype(np.uint64)
+        if width == 64:
+            low |= rng.integers(0, 2, size=count).astype(np.uint64) << np.uint64(63)
+        parts.append(low | np.uint64(prefix << width) if resolved else low)
+    keys = np.concatenate(parts) if parts else np.empty(0, np.uint64)
+    # shuffle across segments: the writer must group them itself
+    keys = keys[rng.permutation(keys.shape[0])]
+    return keys, tuple(specs)
+
+
+@pytest.mark.parametrize("key_dtype", [np.uint32, np.uint64])
+@pytest.mark.parametrize("mmap", [False, True])
+def test_packed_roundtrip_fuzz(key_dtype, mmap, tmp_path, rng):
+    """pack -> CRC -> replay is key-exact for random spec unions (uint64
+    included, resolved depths 0..total_bits-1, ragged/empty segments) on
+    both the read and the mmap routes, and the physical record never
+    exceeds the logical one (the per-record v1 fallback)."""
+    total_bits = np.dtype(key_dtype).itemsize * 8
+    for trial in range(8):
+        keys, specs = _random_packed_population(
+            rng, total_bits, n_specs=int(rng.integers(1, 7)), max_per_spec=800
+        )
+        keys = keys.astype(key_dtype)
+        store = SpillStore(str(tmp_path / f"t{total_bits}-{trial}-{mmap}"))
+        w = store.new_generation(pack_specs=specs, total_bits=total_bits)
+        w.append(keys, np.float64 if total_bits == 64 else np.int32)
+        gen = w.commit()
+        [rec] = gen.records
+        assert rec.nbytes <= keys.nbytes  # physical <= logical, always
+        [chunk] = list(gen.iter_chunks(mmap=mmap)) or [None]
+        got = chunk.keys if chunk is not None else np.empty(0, key_dtype)
+        np.testing.assert_array_equal(np.sort(got), np.sort(keys))
+        # a filtered read is SEGMENT-granular: it returns exactly the
+        # keys of every segment matching a kept spec (the writer assigns
+        # deepest-first), which is a superset of the keys matching the
+        # filter directly — the pruning contract the descent leans on
+        if specs:
+            from mpi_k_selection_tpu.streaming.spill import _segment_matches
+
+            keep = specs[: max(1, len(specs) // 2)]
+            u = keys.astype(np.uint64)
+            assigned = np.zeros(u.shape[0], dtype=bool)
+            expect = np.zeros(u.shape[0], dtype=bool)
+            direct = np.zeros(u.shape[0], dtype=bool)
+            for r, p in sorted(specs, key=lambda s: (-s[0], s[1])):
+                seg = ~assigned
+                if r:
+                    seg &= (u >> np.uint64(total_bits - r)) == np.uint64(p)
+                assigned |= seg
+                if _segment_matches(r, p, keep):
+                    expect |= seg
+            for r, p in keep:
+                direct |= (
+                    (u >> np.uint64(total_bits - r)) == np.uint64(p)
+                    if r else np.ones_like(direct)
+                )
+            got_f = np.concatenate(
+                [c.keys for c in gen.iter_chunks(mmap=mmap, filter_specs=keep)]
+                or [np.empty(0, key_dtype)]
+            )
+            np.testing.assert_array_equal(np.sort(got_f), np.sort(keys[expect]))
+            assert not np.any(direct & ~expect)  # never drops a match
+        store.close()
+
+
+def test_packed_digit_tee_prunes_and_prices(tmp_path, rng):
+    """The digit-segmented tee (pack_digit_bits): filtered replay returns
+    exactly the keys under the filter, and ``read_nbytes``/``read_keys``
+    price the pruned read from the static layout — strictly below the
+    full generation for a narrow filter."""
+    keys = rng.integers(0, 1 << 63, size=20_000, dtype=np.int64).astype(np.uint64)
+    store = SpillStore(str(tmp_path))
+    w = store.new_generation(pack_digit_bits=8)
+    for part in np.array_split(keys, 4):
+        w.append(part, np.uint64)
+    gen = w.commit()
+    assert gen.packed and gen.nbytes < gen.logical_nbytes
+    specs = ((4, 0x7),)  # every key whose top 4 bits are 0b0111
+    mask = (keys >> np.uint64(60)) == np.uint64(0x7)
+    got = np.concatenate(
+        [c.keys for c in gen.iter_chunks(filter_specs=specs)]
+        or [np.empty(0, np.uint64)]
+    )
+    np.testing.assert_array_equal(np.sort(got), np.sort(keys[mask]))
+    assert gen.read_keys(specs) == int(mask.sum())
+    assert gen.read_nbytes(specs) < gen.nbytes
+    assert gen.read_nbytes(None) == gen.nbytes
+    assert gen.read_keys(None) == keys.shape[0]
+    store.close()
+
+
+def test_packed_tiny_record_falls_back_to_v1(tmp_path):
+    """Records the directory would dominate (and full-width resolved=0
+    packs) stay format v1 — a packed generation is never physically
+    larger than its logical bytes, and mixed v1/v2 generations replay."""
+    store = SpillStore(str(tmp_path))
+    w = store.new_generation(pack_digit_bits=8)
+    big = np.arange(4096, dtype=np.uint64) * np.uint64(1 << 50)
+    tiny = np.asarray([1, 2], np.uint64)
+    w.append(big, np.uint64)
+    w.append(tiny, np.uint64)
+    gen = w.commit()
+    versions = [rec.version for rec in gen.records]
+    assert versions == [2, 1]
+    assert all(r.nbytes <= r.logical_nbytes for r in gen.records)
+    got = np.concatenate([c.keys for c in gen.iter_chunks()])
+    np.testing.assert_array_equal(
+        np.sort(got), np.sort(np.concatenate([big, tiny]))
+    )
+    # resolved=0 pack (width == total_bits) can never shrink: stays v1
+    w2 = store.new_generation(pack_specs=((0, 0),), total_bits=64)
+    w2.append(big, np.uint64)
+    assert w2.commit().records[0].version == 1
+    store.close()
+
+
+def _packed_store(tmp_path, name, rng):
+    keys = rng.integers(0, 1 << 63, size=4096, dtype=np.int64).astype(np.uint64)
+    store = SpillStore(str(tmp_path / name))
+    w = store.new_generation(pack_digit_bits=8)
+    w.append(keys, np.uint64)
+    gen = w.commit()
+    assert gen.records[0].version == 2
+    return keys, store, gen
+
+
+@pytest.mark.parametrize("mmap", [False, True])
+def test_packed_corrupt_directory_raises_typed(mmap, tmp_path, rng):
+    _, store, gen = _packed_store(tmp_path, f"dir{mmap}", rng)
+    rec = gen.records[0]
+    data = bytearray(open(rec.path, "rb").read())
+    data[128 + 12] ^= 0xFF  # a directory entry byte (header is 64B)
+    with open(rec.path, "wb") as f:
+        f.write(data)
+    with pytest.raises(SpillRecordError, match="corrupt segment directory"):
+        list(gen.iter_chunks(mmap=mmap))
+    store.close()
+
+
+@pytest.mark.parametrize("mmap", [False, True])
+def test_packed_corrupt_segment_raises_typed(mmap, tmp_path, rng):
+    keys, store, gen = _packed_store(tmp_path, f"seg{mmap}", rng)
+    rec = gen.records[0]
+    data = bytearray(open(rec.path, "rb").read())
+    data[-2] ^= 0xFF  # a byte inside the LAST segment's payload
+    with open(rec.path, "wb") as f:
+        f.write(data)
+    with pytest.raises(SpillRecordError, match="corrupt segment resolved="):
+        list(gen.iter_chunks(mmap=mmap))
+    # a pruned read that skips the damaged segment still serves — per-
+    # segment CRCs checksum exactly what a filtered replay touches —
+    # and one that includes it still raises
+    tops = np.sort(np.unique(keys >> np.uint64(56)))
+    good, bad = int(tops[0]), int(tops[-1])
+    got = np.concatenate(
+        [c.keys for c in gen.iter_chunks(mmap=mmap, filter_specs=((8, good),))]
+    )
+    np.testing.assert_array_equal(
+        np.sort(got), np.sort(keys[(keys >> np.uint64(56)) == np.uint64(good)])
+    )
+    with pytest.raises(SpillRecordError, match="checksum"):
+        list(gen.iter_chunks(mmap=mmap, filter_specs=((8, bad),)))
+    store.close()
+
+
+@pytest.mark.parametrize("mmap", [False, True])
+def test_packed_truncated_raises_typed(mmap, tmp_path, rng):
+    _, store, gen = _packed_store(tmp_path, f"trunc{mmap}", rng)
+    rec = gen.records[0]
+    data = open(rec.path, "rb").read()
+    with open(rec.path, "wb") as f:
+        f.write(data[:-9])
+    with pytest.raises(SpillRecordError, match="truncated|implies|short read"):
+        list(gen.iter_chunks(mmap=mmap))
+    store.close()
+
+
+def test_packed_descent_reads_v1_generations(tmp_path, rng):
+    """v1 compatibility: a store teed WITHOUT packing serves a descent
+    that asks for pack_spill='auto' — the reader keys on each record's
+    header version, so old generations stay readable (chosen over a
+    versioned refusal)."""
+    x = _ints(rng, 1 << 12)
+    store = SpillStore(str(tmp_path))
+    want = seq.kselect(x, 77)
+    got = streaming_kselect(
+        iter(_chunks(x, 4)), 77, spill=store, collect_budget=64,
+        pack_spill="off",
+    )
+    assert got == want
+    assert not store.latest_generation().packed
+    got2 = streaming_kselect(store, 77, collect_budget=64, pack_spill="auto")
+    assert got2 == want
+    store.close()

@@ -538,3 +538,120 @@ def test_cli_streaming_mode(capsys):
     assert rec["extra"]["exact_match"] is True
     assert rec["extra"]["certificate_ok"] is True
     assert rec["extra"]["chunks"] == 11
+
+
+# -- the adaptive width schedule + prefix-packed spill grid -------------------
+
+
+@pytest.mark.parametrize("width_schedule", ["auto", "off"])
+@pytest.mark.parametrize("pack_spill", ["auto", "off"])
+def test_width_pack_grid_bit_identical(width_schedule, pack_spill, rng):
+    """devices {1,2} x depth {0,2} x spill {off,force} x the two new
+    knobs, over float32 and uint64 streams: every leg is bit-identical
+    to the seq oracle (the knob-off legs double as the legacy anchor)."""
+    n = 1 << 13
+    for dtype in (np.float32, np.uint64):
+        if np.dtype(dtype).kind == "f":
+            x = (rng.standard_normal(n) * 100).astype(dtype)
+        else:
+            x = rng.integers(0, 1 << 63, size=n, dtype=np.int64).astype(dtype)
+        ks = [1, 1337, n // 2, n]
+        want = [seq.kselect_sort(x, k) for k in ks]
+        chunks = _chunks(x, 8)
+        for devices in (1, 2):
+            for depth in (0, 2):
+                for spill in ("off", "force"):
+                    got = streaming_kselect_many(
+                        chunks, ks, pipeline_depth=depth, devices=devices,
+                        spill=spill, collect_budget=256,
+                        width_schedule=width_schedule, pack_spill=pack_spill,
+                    )
+                    assert [np.asarray(g).item() for g in got] == [
+                        np.asarray(w).item() for w in want
+                    ], (dtype, devices, depth, spill)
+
+
+@pytest.mark.parametrize("fused", ["kernel", "xla", "off"])
+def test_width_pack_fused_tiers_bit_identical(fused, rng):
+    """The knobs compose with every fused ingest tier: wide digits route
+    per-bucket counting through the tiers' supported widths (the rb <= 8
+    kernel rule downgrades wide passes to the scatter path) with
+    bit-identical answers."""
+    n = 1 << 13
+    x = rng.integers(-(2**31), 2**31, size=n, dtype=np.int64).astype(np.int32)
+    ks = [7, n // 3]
+    want = [seq.kselect_sort(x, k) for k in ks]
+    got = streaming_kselect_many(
+        _chunks(x, 8), ks, pipeline_depth=2, devices=2, spill="force",
+        fused=fused, collect_budget=256, width_schedule="auto",
+        pack_spill="auto",
+    )
+    assert [np.asarray(g).item() for g in got] == [
+        np.asarray(w).item() for w in want
+    ]
+
+
+def test_width_schedule_tuple_and_one_shot(rng):
+    """An explicit per-pass width tuple resolves the full key width, and
+    a ONE-SHOT generator source runs the packed spill descent end to
+    end; a tuple that does not sum to the key width is refused."""
+    n = 1 << 13
+    x = rng.integers(0, 1 << 63, size=n, dtype=np.int64).astype(np.uint64)
+    want = seq.kselect_sort(x, 999)
+    got = streaming_kselect(
+        iter(_chunks(x, 8)), 999, collect_budget=128,
+        width_schedule=(16, 16, 16, 8, 8), pack_spill="auto",
+    )
+    assert got == want
+    with pytest.raises(ValueError, match="resolves"):
+        streaming_kselect(
+            _chunks(x, 8), 999, width_schedule=(16, 16), collect_budget=128
+        )
+    with pytest.raises(ValueError, match="outside"):
+        streaming_kselect(_chunks(x, 8), 999, width_schedule=(64,))
+
+
+def test_knobs_off_is_byte_for_byte_legacy(rng):
+    """width_schedule='off' + pack_spill='off' IS the legacy descent:
+    the spilled pass_log (passes, logical AND physical byte columns) of
+    an explicit knobs-off run equals a defaults run entry for entry."""
+    from mpi_k_selection_tpu.streaming import SpillStore
+
+    n = 1 << 13
+    x = rng.integers(-(2**31), 2**31, size=n, dtype=np.int64).astype(np.int32)
+
+    def run(**kw):
+        store = SpillStore()
+        try:
+            got = streaming_kselect(
+                _chunks(x, 8), n // 2, spill=store, collect_budget=128, **kw
+            )
+            return np.asarray(got).item(), list(store.pass_log)
+        finally:
+            store.close()
+
+    got_default, log_default = run()
+    got_off, log_off = run(width_schedule="off", pack_spill="off")
+    assert got_default == got_off == np.asarray(seq.kselect_sort(x, n // 2)).item()
+    assert log_off == log_default
+    for entry in log_off:
+        # unpacked physical == logical on every write, byte for byte
+        if entry.get("bytes_written") is not None:
+            assert entry["disk_bytes_written"] == entry["bytes_written"]
+
+
+def test_sketch_seeded_descent_with_knobs(rng):
+    """A sketch-seeded refine under both knobs: the wide schedule starts
+    below the sketch's resolved depth, and the packed tee's segments
+    prune the refine's first pass — bit-identical to the plain refine."""
+    n = 1 << 13
+    x = (rng.standard_normal(n) * 50).astype(np.float32)
+    sk = RadixSketch(np.float32)
+    for c in _chunks(x, 8):
+        sk.update(c)
+    want = seq.kselect_sort(x, n // 4)
+    got = sk.refine(
+        _chunks(x, 8), n // 4, collect_budget=128,
+        width_schedule="auto", pack_spill="auto", spill="force",
+    )
+    assert got == want

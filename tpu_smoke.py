@@ -278,6 +278,51 @@ def main() -> int:
         )
         check("spill passes shrink geometrically", shrink_ok, True)
 
+    # --- adaptive width schedule + prefix-packed spill (ISSUE 19, the
+    # bench_streaming_oc width_pack config at smoke scale): the wide
+    # pass-0 digit + segment-pruned packed replay must be bit-identical
+    # on real silicon at devices {1, all}, stream <= 1.2 * n key bytes
+    # total (the legacy spill path pays ~2x), and write strictly fewer
+    # physical than logical bytes — the silicon validation the
+    # width_schedule default flip waits on (ROADMAP) ---
+    print("adaptive width schedule + packed spill:")
+    for dv in sp_devgrid:
+        for ws, ps in (("auto", "auto"), ("auto", "off"), ("off", "auto")):
+            got_wp = int(
+                _sp_ksel(
+                    sp_chunks, sp_k, spill="force", devices=dv,
+                    width_schedule=ws, pack_spill=ps, **sp_kw,
+                )
+            )
+            check(
+                f"width_schedule={ws} pack_spill={ps} devices={dv} "
+                "bit-identical",
+                got_wp, want_sp,
+            )
+    with SpillStore() as wp_store:
+        got_wp = int(
+            _sp_ksel(
+                sp_chunks, sp_k, spill=wp_store,
+                width_schedule="auto", pack_spill="auto", **sp_kw,
+            )
+        )
+        check("width+pack spill-store bit-identical", got_wp, want_sp)
+        wp_log = list(wp_store.pass_log)
+    wp_streamed = sum(p["bytes_read"] for p in wp_log)
+    wp_disk_w = sum(p.get("disk_bytes_written") or 0 for p in wp_log)
+    wp_logical_w = sum(p.get("bytes_written") or 0 for p in wp_log)
+    wp_ratio = wp_streamed / (sp_n * 4)
+    check("width+pack bytes streamed <= 1.2 n key bytes", wp_ratio <= 1.2, True)
+    check(
+        "packed writes below logical",
+        wp_logical_w > 0 and wp_disk_w < wp_logical_w, True,
+    )
+    print(
+        f"    bytes_streamed / (n * key_bytes) = {round(wp_ratio, 4)}; "
+        f"disk_bytes_ratio = "
+        f"{round(wp_disk_w / wp_logical_w, 4) if wp_logical_w else None}"
+    )
+
     # the spill-pass device_scaling the ROADMAP sweep item needs: the
     # deferred spill descent's wall at devices {1, all} (+ the eager
     # wall at devices=all as the before/after) — on real silicon these
