@@ -920,6 +920,78 @@ def bench_streaming_oc(on_tpu: bool):
     )
     ok = ok and exact_wp and wp_ratio <= 1.2 and packed_under
 
+    # --- parallel host data plane (ISSUE 20): the SAME encode-bound
+    # packed-spill config, ingest_workers=1 (legacy single producer) vs
+    # "auto" (the pooled plane), interleaved A/B across rounds so host
+    # drift lands on both legs equally; best-of per leg. The gate is
+    # EITHER-OR by design: on a many-core host the pool must win wall
+    # time outright (`workers_speedup` > 1) or prove the encode wall is
+    # already hidden behind the consumer (`encode_hidden_frac` >= 0.9);
+    # on a 1-core CI host auto resolves to 1, BOTH legs are byte-for-
+    # byte the same code path, and any measured "speedup" is pure noise
+    # — there is no perf claim to test, so only the correctness clauses
+    # gate. `exact_match` REQUIRES bit-equality of BOTH legs against
+    # the spill-off oracle, and the workers=1 leg must never touch the
+    # sequencer (`seq_wait` == 0 — byte-for-byte legacy means no
+    # coordination phase at all).
+    from mpi_k_selection_tpu.streaming.pipeline import (
+        SEQ_WAIT_PHASE,
+        encode_hidden_frac,
+        resolve_ingest_workers,
+    )
+
+    pw_auto = resolve_ingest_workers("auto")
+    pw_times: dict = {1: [], "auto": []}
+    pw_ans: dict = {}
+    pw_timers = {1: PhaseTimer(), "auto": PhaseTimer()}
+    for _pw_round in range(2):
+        for pw_wk in (1, "auto"):
+            t0 = time.perf_counter()
+            pw_ans[pw_wk] = streaming_kselect(
+                sp_source, sp_k, radix_bits=sp_rb,
+                collect_budget=sp_budget, spill="force",
+                devices=sp_devices, width_schedule="auto",
+                pack_spill="auto", ingest_workers=pw_wk,
+                timer=pw_timers[pw_wk],
+            )
+            pw_times[pw_wk].append(time.perf_counter() - t0)
+    pw_s1, pw_sp = min(pw_times[1]), min(pw_times["auto"])
+    pw_speedup = pw_s1 / pw_sp if pw_sp > 0 else 0.0
+    pw_hidden = encode_hidden_frac(pw_timers["auto"])
+    pw_seq_wait_w1 = pw_timers[1].phases.get(SEQ_WAIT_PHASE, 0.0)
+    exact_pw = int(pw_ans[1]) == int(pw_ans["auto"]) == int(ans_off)
+    pw_gate = (
+        exact_pw
+        and (
+            pw_auto == 1
+            or pw_speedup > 1.0
+            or (pw_hidden or 0.0) >= 0.9
+        )
+        and pw_seq_wait_w1 < 1e-9
+    )
+    _emit(
+        {
+            "metric": "kselect_streaming_oc_workers",
+            "value": round(sp_n / pw_sp, 1) if exact_pw else 0.0,
+            "unit": "elems/sec/chip",
+            "n": sp_n,
+            "k": sp_k,
+            "radix_bits": sp_rb,
+            "collect_budget": sp_budget,
+            "devices": sp_ndev,
+            "ingest_workers": pw_auto,
+            "seconds_workers_1": round(pw_s1, 6),
+            "seconds_workers_auto": round(pw_sp, 6),
+            "workers_speedup": round(pw_speedup, 4),
+            "encode_hidden_frac": (
+                round(pw_hidden, 4) if pw_hidden is not None else None
+            ),
+            "seq_wait_workers_1": round(pw_seq_wait_w1, 6),
+            "exact_match": bool(exact_pw),
+        }
+    )
+    ok = ok and pw_gate
+
     # --- multi-device config: the same stream, staged round-robin across
     # every local device (devices=p, ISSUE 4) vs the devices=1 run above.
     # `device_scaling` is pipelined-devices=1 wall / multi-device wall;

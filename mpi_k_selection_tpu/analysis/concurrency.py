@@ -72,9 +72,15 @@ _GUARDED_BY_RE = re.compile(
     r"#\s*ksel:\s*guarded-by\[(?P<lock>[A-Za-z_][A-Za-z0-9_]*)\]"
 )
 
-#: Factory calls whose result is a lock object.
+#: Factory calls whose result is a lock object. ``threading.Condition``
+#: lives here (not in the self-sync set): a Condition IS its lock —
+#: ``with self._cond:`` guards state exactly like a Lock, ``guarded-by``
+#: annotations may name it, and it participates in the lock-order graph
+#: (the ingest pool's reorder sequencer is ordered against every other
+#: package lock through it).
 _LOCK_FACTORIES = {
     "threading.Lock", "threading.RLock", "Lock", "RLock",
+    "threading.Condition", "Condition",
     "multiprocessing.Lock", "multiprocessing.RLock",
 }
 
@@ -85,7 +91,6 @@ _SELF_SYNC_FACTORIES = {
     "queue.LifoQueue", "queue.PriorityQueue",
     "collections.deque", "deque",
     "threading.Event", "Event",
-    "threading.Condition", "Condition",
     "threading.Semaphore", "Semaphore",
     "threading.BoundedSemaphore", "threading.Barrier", "Barrier",
 }

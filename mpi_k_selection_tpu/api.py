@@ -365,6 +365,16 @@ def kselect_streaming(source, k, **kwargs):
     bit-identical to fault-free runs, and exhausted policies raise
     typed errors; ``"off"`` restores fail-on-first-fault.
 
+    ``ingest_workers`` (default 1) widens the HOST side of every streamed
+    pass: ``"auto"`` (= min(4, cores)) or an int > 1 runs chunk encode,
+    spill-tee packing and device staging on a pool of ``ksel-ingest-*``
+    workers behind a reorder sequencer that releases chunks strictly in
+    stream order — so answers, pass logs, spill records and the
+    chunk->device round-robin are bit-identical at every worker count,
+    and ``1`` is byte-for-byte the legacy single-producer plane. The pool
+    pays off when the host work (key encode, ``pack_spill`` bit-packing,
+    CRC) is the bottleneck rather than the device programs.
+
     ``obs`` (an :class:`~mpi_k_selection_tpu.obs.Observability`) turns on
     the descent telemetry — typed per-pass/per-chunk events, a metrics
     registry (occupancy per executor phase, stall seconds, bytes per
@@ -374,7 +384,7 @@ def kselect_streaming(source, k, **kwargs):
     (``radix_bits``, ``hist_method``, ``collect_budget``, ``sketch``,
     ``pipeline_depth``, ``timer``, ``devices``, ``spill``, ``spill_dir``,
     ``deferred``, ``fused``, ``width_schedule``, ``pack_spill``,
-    ``retry``, ``obs``)."""
+    ``ingest_workers``, ``retry``, ``obs``)."""
     from mpi_k_selection_tpu.streaming.chunked import streaming_kselect
 
     return streaming_kselect(source, k, **kwargs)
@@ -419,6 +429,7 @@ class StreamingQuantiles:
         fused=None,
         width_schedule=None,
         pack_spill=None,
+        ingest_workers=None,
         obs=None,
     ):
         from mpi_k_selection_tpu.streaming.chunked import (
@@ -434,6 +445,7 @@ class StreamingQuantiles:
         )
         from mpi_k_selection_tpu.streaming.spill import validate_pack_spill
         from mpi_k_selection_tpu.streaming.pipeline import (
+            resolve_ingest_workers,
             resolve_stream_devices,
             validate_pipeline_depth,
         )
@@ -465,6 +477,12 @@ class StreamingQuantiles:
         self.pack_spill = validate_pack_spill(
             DEFAULT_PACK_SPILL if pack_spill is None else pack_spill
         )
+        #: host ingest-pool width for update_stream and the refinement
+        #: passes ("auto", or an int; None = 1 = the single-producer
+        #: plane — streaming/pipeline.py). Stored RAW ("auto" resolves
+        #: per call, so a tracker pickled on one host adapts to another).
+        resolve_ingest_workers(ingest_workers)  # validate eagerly, like depth
+        self.ingest_workers = ingest_workers
         #: optional Observability bundle threaded through update_stream
         #: and refine_quantiles (off = None, the default)
         self.obs = obs
@@ -498,7 +516,7 @@ class StreamingQuantiles:
         self.sketch.update_stream(
             source, pipeline_depth=self.pipeline_depth, devices=self.devices,
             spill=spill, fused=self.fused, pack_spill=self.pack_spill,
-            obs=self.obs,
+            ingest_workers=self.ingest_workers, obs=self.obs,
         )
         return self
 
@@ -513,6 +531,7 @@ class StreamingQuantiles:
             fused=self.fused,
             width_schedule=self.width_schedule,
             pack_spill=self.pack_spill,
+            ingest_workers=self.ingest_workers,
             obs=self.obs,
         )
         out.sketch = self.sketch.merge(
@@ -547,6 +566,7 @@ class StreamingQuantiles:
             fused=self.fused,
             width_schedule=self.width_schedule,
             pack_spill=self.pack_spill,
+            ingest_workers=self.ingest_workers,
             obs=self.obs,
         )
 

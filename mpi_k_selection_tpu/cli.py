@@ -189,6 +189,15 @@ def build_parser() -> argparse.ArgumentParser:
         "are bit-identical either way",
     )
     p.add_argument(
+        "--ingest-workers", default=None, metavar="auto|N",
+        help="--streaming host data-plane width: N > 1 (or auto = "
+        "min(4, cores)) runs chunk encode, spill-tee packing and device "
+        "staging on a pool of ksel-ingest-* workers behind a reorder "
+        "sequencer that releases chunks strictly in stream order; 1 "
+        "(default) = the single-producer plane. Answers, pass logs and "
+        "spill records are bit-identical at every width",
+    )
+    p.add_argument(
         "--retry", choices=("default", "off"), default="default",
         help="--streaming resilience policies (faults/, docs/ROBUSTNESS.md): "
         "default = bounded retry (3 attempts, exponential backoff) for "
@@ -405,6 +414,20 @@ def _chunk_source(args):
     return source
 
 
+def _parse_ingest_workers(raw):
+    """``--ingest-workers`` arrives as a string (or None): keep ``auto``
+    and None symbolic, convert digits to int, and let the pipeline's
+    resolver reject everything else with its canonical message."""
+    if raw is None or raw == "auto":
+        return raw
+    try:
+        return int(raw)
+    except (TypeError, ValueError):
+        raise SystemExit(
+            f"error: --ingest-workers must be auto or an int, got {raw!r}"
+        )
+
+
 def _run_streaming(args, obs=None):
     from mpi_k_selection_tpu.api import kselect_streaming
     from mpi_k_selection_tpu.streaming.chunked import streaming_rank_certificate
@@ -416,11 +439,17 @@ def _run_streaming(args, obs=None):
     if not 1 <= k <= n:
         raise SystemExit(f"error: k={k} out of range [1, {n}]")
     from mpi_k_selection_tpu.streaming.pipeline import (
+        resolve_ingest_workers,
         resolve_stream_devices,
         validate_pipeline_depth,
     )
 
     depth = validate_pipeline_depth(args.pipeline_depth)
+    ingest_workers = _parse_ingest_workers(args.ingest_workers)
+    try:
+        n_workers = resolve_ingest_workers(ingest_workers)
+    except (ValueError, TypeError) as e:
+        raise SystemExit(f"error: {e}")
     # --width-schedule accepts the mode strings or a comma-separated
     # per-pass width list; validate eagerly so a typo is a clean
     # SystemExit instead of a mid-descent ValueError
@@ -513,6 +542,7 @@ def _run_streaming(args, obs=None):
             fused=args.fused,
             width_schedule=width_schedule,
             pack_spill=args.pack_spill,
+            ingest_workers=ingest_workers,
             retry=args.retry,
             obs=obs,
         )
@@ -543,6 +573,9 @@ def _run_streaming(args, obs=None):
             else width_schedule
         )
         record.extra["pack_spill"] = args.pack_spill
+        # the RESOLVED pool width (auto pinned to this host's answer), so
+        # a recorded run names the plane it actually used
+        record.extra["ingest_workers"] = n_workers
         record.extra["retry"] = args.retry
         if injector is not None:
             record.extra["chaos"] = {
@@ -619,6 +652,7 @@ def _run_streaming(args, obs=None):
                 answer, pipeline_depth=depth, devices=devices,
                 deferred=args.deferred, fused=args.fused,
                 width_schedule=width_schedule, pack_spill=args.pack_spill,
+                ingest_workers=ingest_workers,
                 retry=args.retry, obs=cert_obs,
             )
             cert_ok = less < k <= leq

@@ -140,6 +140,19 @@ def resolved_bits_gauge(obs, pass_label, bits) -> None:
     ).set(int(bits))
 
 
+def ingest_workers_gauge(obs, workers) -> None:
+    """Record the RESOLVED ingest-pool width a streamed run is using:
+    ``ingest.workers`` is how an ``ingest_workers="auto"`` caller learns
+    what the knob resolved to on this host (and dashboards correlate a
+    throughput change with the pool width that produced it). Unlabeled —
+    one value per run, last-writer-wins across concurrent runs like every
+    resolved-knob gauge. Pure host observation; no-op when metrics are
+    off."""
+    if obs is None or obs.metrics is None:
+        return
+    obs.metrics.gauge("ingest.workers").set(int(workers))
+
+
 class _FanRecorder:
     """Forwards every finished span to several recorders (the trace
     recorder and the flight ring observe the same phases — neither

@@ -32,6 +32,10 @@ KSEL_PREFIX = "ksel-"
 
 #: streaming/pipeline.py ChunkPipeline producer threads.
 PIPELINE_THREAD_PREFIX = "ksel-pipeline"
+#: Parallel host data plane: ingest-pool encode/stage workers
+#: (streaming/pipeline.py ``ksel-ingest-<pipeline>-<w>``) and the spill
+#: replay decode pool (streaming/spill.py ``ksel-ingest-decode-*``).
+INGEST_THREAD_PREFIX = "ksel-ingest"
 #: serve/ threads: the per-device dispatch-lane threads (serve/lanes.py
 #: names each lane's supervised QueryBatcher thread
 #: ``ksel-serve-lane-<key>-dispatch-*``; a standalone batcher keeps
@@ -43,6 +47,7 @@ MONITOR_THREAD_PREFIX = "ksel-monitor"
 
 THREAD_PREFIXES = (
     PIPELINE_THREAD_PREFIX,
+    INGEST_THREAD_PREFIX,
     SERVE_THREAD_PREFIX,
     MONITOR_THREAD_PREFIX,
 )
@@ -123,8 +128,11 @@ THREAD_OWNER_CALLS = frozenset()
 #: _req_threads list in serve/http.py and monitor/monitor.py, and the
 #: LaneDispatcher's _lanes map in serve/lanes.py — each lane is a whole
 #: QueryBatcher whose close() joins its own _thread).
+#: ``_workers`` is the ingest-pool family: ChunkPipeline's worker list
+#: (close() joins every entry) and the spill decode pool's thread list
+#: (the reader generator's finally joins them on every exit path).
 THREAD_OWNER_ATTRS = frozenset(
-    {"_thread", "_serve_thread", "_req_threads", "_lanes"}
+    {"_thread", "_serve_thread", "_req_threads", "_lanes", "_workers"}
 )
 THREAD_TYPES = frozenset({"Thread"})
 

@@ -337,7 +337,9 @@ class DatasetRegistry:
         queries later replay the source through the sketch-seeded
         streaming descent. ``stream_kwargs`` are held for those descents
         (``pipeline_depth``, ``devices``, ``hist_method``,
-        ``width_schedule``, ``pack_spill``, ...)."""
+        ``width_schedule``, ``pack_spill``, ``ingest_workers``, ...);
+        the accumulation pass below honors the staging/data-plane subset
+        (depth, devices, fused, ingest_workers) immediately."""
         from mpi_k_selection_tpu.streaming.chunked import as_chunk_source
         from mpi_k_selection_tpu.streaming.sketch import RadixSketch
 
@@ -365,6 +367,7 @@ class DatasetRegistry:
             pipeline_depth=stream_kwargs.get("pipeline_depth", 0),
             devices=stream_kwargs.get("devices"),
             fused=stream_kwargs.get("fused"),
+            ingest_workers=stream_kwargs.get("ingest_workers"),
         )
         n = int(sk.n)
         if n == 0:

@@ -166,10 +166,15 @@ def resolve_width_schedule(
     widths sum to ``total_bits - start_bits`` (``start_bits`` = a seeding
     sketch's resolved depth). ``"off"`` reproduces the fixed
     ``radix_bits`` schedule exactly (including its divisibility error);
-    ``"auto"`` front-loads ONE wide pass — the largest width <= 16 that
+    ``"auto"`` front-loads wide passes — the largest width <= 16 that
     leaves the remainder on radix_bits boundaries — so generation 0
     shrinks by ~2^w0 and the second full-N read disappears, while later
-    passes keep the narrow kernel-friendly digits."""
+    passes keep the narrow kernel-friendly digits. 64-bit keys (> 32
+    remaining bits) get a SECOND wide pass by the same rule: with ~48
+    bits still unresolved after pass 0, generation 1 is otherwise still
+    descended by narrow digits for 5+ more full-generation reads — a
+    second 2^w1 shrink retires most of them (each pass stays within the
+    KSC102 2**MAX_PASS_BITS int32-partial budget independently)."""
     remaining = total_bits - start_bits
     if width_schedule == "off":
         if remaining % radix_bits:
@@ -185,7 +190,19 @@ def resolve_width_schedule(
     if width_schedule == "auto":
         for w in range(min(16, remaining), 0, -1):
             if (remaining - w) % radix_bits == 0:
-                return (w,) + (radix_bits,) * ((remaining - w) // radix_bits)
+                rem = remaining - w
+                head = (w,)
+                if rem > 16 and w > radix_bits and remaining > 32:
+                    # 64-bit keys: a second STRICTLY-wide pass (> the
+                    # narrow digit, same <= 16 budget, remainder still on
+                    # radix_bits boundaries) — 32-bit schedules are
+                    # untouched (remaining <= 32 never enters here)
+                    for w2 in range(min(16, rem), radix_bits, -1):
+                        if (rem - w2) % radix_bits == 0:
+                            head += (w2,)
+                            rem -= w2
+                            break
+                return head + (radix_bits,) * (rem // radix_bits)
         # radix_bits > 16 with remaining on its boundaries: no wide first
         # pass fits under the budget — keep the fixed schedule
         return (radix_bits,) * (remaining // radix_bits)
@@ -259,7 +276,9 @@ class _OneShotSource:
         return self._it
 
 
-def as_chunk_source(source, *, one_shot_ok: bool = False, mmap: bool = False):
+def as_chunk_source(
+    source, *, one_shot_ok: bool = False, mmap: bool = False, workers: int = 1,
+):
     """Normalize ``source`` to a zero-arg callable returning a fresh chunk
     iterator — the replayable form every streaming pass needs.
 
@@ -267,7 +286,9 @@ def as_chunk_source(source, *, one_shot_ok: bool = False, mmap: bool = False):
     zero-arg callable returning an iterable of arrays, or a
     :class:`~mpi_k_selection_tpu.streaming.spill.SpillStore` with a
     committed generation (replayed from disk; ``mmap`` selects mmap-backed
-    record payload reads — the deferred executor's replay mode). A bare
+    record payload reads — the deferred executor's replay mode, and
+    ``workers`` > 1 decodes records on a ``ksel-ingest-decode-*`` pool,
+    in-order — spill.py:SpillGeneration.iter_chunks). A bare
     one-shot iterator/generator is accepted only under ``one_shot_ok``
     (the spill descent: pass 0 tees it to disk and never reads it again);
     otherwise it is rejected with instructions — exact selection re-reads
@@ -275,7 +296,7 @@ def as_chunk_source(source, *, one_shot_ok: bool = False, mmap: bool = False):
     serve.
     """
     if isinstance(source, _sp.SpillStore):
-        return source.latest_generation().as_source(mmap=mmap)
+        return source.latest_generation().as_source(mmap=mmap, workers=workers)
     if callable(source):
         return source
     if isinstance(source, (list, tuple)):
@@ -300,22 +321,23 @@ def as_chunk_source(source, *, one_shot_ok: bool = False, mmap: bool = False):
     raise TypeError(f"unsupported chunk source type {type(source).__name__!r}")
 
 
-def _encode_chunk(chunk, dtype):
-    """Validate + key-encode ONE chunk: returns ``(keys, c)`` with ``keys``
-    the order-preserving unsigned view (host numpy for host chunks, device
-    array for device chunks — each stays where it lives) and ``c`` the
-    raveled original, or ``None`` for an empty chunk. ``dtype`` is the
-    stream dtype to validate against (``None`` = first chunk, adopt its
-    dtype — the caller reads it off ``c.dtype``). Shared verbatim by the
-    synchronous iterator below and the pipelined producer thread
-    (streaming/pipeline.py), so both paths enforce identical contracts."""
+def _normalize_chunk(chunk, dtype):
+    """The ORDER-SENSITIVE half of chunk encoding: ravel, the empty-skip,
+    the 2^31 per-chunk counter guard, and the one-dtype-per-stream drift
+    check — everything whose errors (and dtype adoption) must fire in
+    source order. Returns the raveled chunk (host numpy or device array;
+    a :class:`~mpi_k_selection_tpu.streaming.spill.SpillChunk` passes
+    through whole), or ``None`` for an empty chunk. ``dtype`` is the
+    stream dtype to validate against (``None`` = first chunk: the caller
+    adopts the returned chunk's dtype). The pooled ingest plane
+    (streaming/pipeline.py) runs THIS on its sequential puller and hands
+    the result to a worker for :func:`_encode_normalized`; depth-0 and
+    single-producer paths compose both via :func:`_encode_chunk`."""
     if isinstance(chunk, _sp.SpillChunk):
         # replayed spill record: keys are ALREADY the host key-space view
-        # (encoded once, at pass-0 tee time) — validate the recorded stream
-        # dtype and hand them through; the zero-length companion carries
-        # the dtype for first-chunk probes exactly like the pipelined path
-        keys = chunk.keys
-        if keys.size == 0:
+        # (encoded once, at pass-0 tee time) — validate the recorded
+        # stream dtype and hand the chunk through whole
+        if chunk.keys.size == 0:
             return None
         odt = np.dtype(chunk.orig_dtype)
         if dtype is not None and odt != np.dtype(dtype):
@@ -323,7 +345,7 @@ def _encode_chunk(chunk, dtype):
                 f"spill chunk dtype {odt} != stream dtype {np.dtype(dtype)}; "
                 "streaming selection requires one dtype per stream"
             )
-        return keys, np.empty((0,), odt)
+        return chunk
     if _is_device_array(chunk):
         c = chunk.ravel()
     else:
@@ -343,6 +365,31 @@ def _encode_chunk(chunk, dtype):
             f"{np.dtype(dtype)}; streaming selection requires one dtype "
             "per stream"
         )
+    return c
+
+
+def _encodes_to_host(c) -> bool:
+    """True when :func:`_encode_normalized` will produce HOST keys for
+    normalized chunk ``c``: replayed spill records (already host
+    key-space), host arrays, and the exact f64-on-TPU route (device f64
+    keys are the ~49-bit approximation; the chunk decodes to host). The
+    pooled puller uses this to pre-assign round-robin staging slots
+    without encoding anything."""
+    if isinstance(c, _sp.SpillChunk) or not _is_device_array(c):
+        return True
+    return np.dtype(c.dtype) == np.float64 and _tpu_backend()
+
+
+def _encode_normalized(c):
+    """The ORDER-FREE half of chunk encoding: the key-encode proper of an
+    already-:func:`_normalize_chunk`-ed chunk. Returns ``(keys, comp)``
+    with ``keys`` the order-preserving unsigned view (host numpy for host
+    chunks, device array for device chunks — each stays where it lives)
+    and ``comp`` a zero-length dtype carrier for first-chunk probes
+    (consumers read only ``.dtype`` off it). Pure per-chunk compute —
+    the pooled plane runs it concurrently across ingest workers."""
+    if isinstance(c, _sp.SpillChunk):
+        return c.keys, np.empty((0,), np.dtype(c.orig_dtype))
     if not _is_device_array(c):
         return _dt.np_to_sortable_bits(c), c
     if np.dtype(c.dtype) == np.float64 and _tpu_backend():
@@ -354,6 +401,24 @@ def _encode_chunk(chunk, dtype):
         hc = np.asarray(c)
         return _dt.np_to_sortable_bits(hc), hc
     return _dt.to_sortable_bits(c), c
+
+
+def _encode_chunk(chunk, dtype):
+    """Validate + key-encode ONE chunk: returns ``(keys, c)`` with ``keys``
+    the order-preserving unsigned view (host numpy for host chunks, device
+    array for device chunks — each stays where it lives) and ``c`` the
+    raveled original (a zero-length dtype carrier for spill replays), or
+    ``None`` for an empty chunk. ``dtype`` is the stream dtype to validate
+    against (``None`` = first chunk, adopt its dtype — the caller reads it
+    off ``c.dtype``). Shared verbatim by the synchronous iterator below
+    and the pipelined producer thread (streaming/pipeline.py), so both
+    paths enforce identical contracts; the pooled plane runs the same two
+    halves (:func:`_normalize_chunk` on the puller,
+    :func:`_encode_normalized` on a worker) split across threads."""
+    c = _normalize_chunk(chunk, dtype)
+    if c is None:
+        return None
+    return _encode_normalized(c)
 
 
 def _iter_key_chunks(src, dtype=None, spill=None):
@@ -382,7 +447,7 @@ def _iter_key_chunks(src, dtype=None, spill=None):
 @contextlib.contextmanager
 def _key_chunk_stream(
     src, dtype=None, *, pipeline_depth=0, hist_method=None, timer=None,
-    devices=None, spill=None, retry=None, obs=None,
+    devices=None, spill=None, retry=None, obs=None, workers=1,
 ):
     """Context-managed ``(keys, chunk)`` iterator: the synchronous
     generator at depth 0, a :class:`~mpi_k_selection_tpu.streaming.
@@ -395,14 +460,16 @@ def _key_chunk_stream(
     when pipelined); the caller owns commit/abort. ``retry`` (a
     faults/policy.py RetryPolicy, or None) governs in-place retries of
     the producer's staging transfers; ``obs`` receives their retry
-    events."""
+    events. ``workers`` (resolved, >= 1) selects the pooled host data
+    plane at depth >= 1; depth 0 ignores it (the synchronous oracle has
+    no threads to pool)."""
     depth = _pl.validate_pipeline_depth(pipeline_depth)
     if depth == 0:
         yield _iter_key_chunks(src, dtype, spill=spill)
         return
     pipe = _pl.ChunkPipeline(
         src, dtype, depth=depth, hist_method=hist_method, timer=timer,
-        devices=devices, spill=spill, retry=retry, obs=obs,
+        devices=devices, spill=spill, retry=retry, obs=obs, workers=workers,
     )
     try:
         yield iter(pipe)
@@ -569,7 +636,7 @@ def _recover_pass(
 def _collect_survivors(
     src, dtype, specs, *, pipeline_depth=0, timer=None, devices=None,
     hist_method=None, obs=None, read_from="source", disk_bytes_read=None,
-    deferred=True, fused=False, retry=None,
+    deferred=True, fused=False, retry=None, ingest_workers=1,
 ):
     """One streamed pass collecting survivors for EVERY ``(resolved_bits,
     prefix) -> expected population`` spec at once — the shared finish of
@@ -627,6 +694,7 @@ def _collect_survivors(
             src, dtype, pipeline_depth=pipeline_depth, timer=timer,
             hist_method=hist_method if staged else None,
             devices=devs if staged else None, retry=retry, obs=obs,
+            workers=ingest_workers,
         ) as kc:
             for keys, _ in kc:
                 if obs is not None:
@@ -732,6 +800,7 @@ def streaming_kselect(
     fused=DEFAULT_FUSED,
     width_schedule=DEFAULT_WIDTH_SCHEDULE,
     pack_spill=DEFAULT_PACK_SPILL,
+    ingest_workers=None,
     retry=None,
     obs=None,
 ):
@@ -845,6 +914,24 @@ def streaming_kselect(
     logical); generation 0 always stays full-width v1. Answers are
     bit-identical with packing on or off.
 
+    ``ingest_workers`` (default ``1``) widens the HOST side of the
+    pipelined ingest into the parallel data plane
+    (streaming/pipeline.py): one sequential puller preserves source
+    order (one-shot consumption, drift detection, round-robin slot and
+    fault-index assignment), a pool of ``ksel-ingest-*`` workers runs
+    each chunk's key-encode, spill-tee pack/CRC and staging
+    ``device_put`` concurrently, and a reorder sequencer releases
+    chunks to the descent strictly in chunk order — so answers, pass
+    events, spill records and chunk->device assignment are
+    bit-identical at EVERY worker count. ``"auto"`` resolves to
+    ``min(4, cores)``; ``1`` is byte-for-byte the legacy
+    single-producer path. Spill replays decode records on the same
+    width of pool (read + CRC + v2 unpack off the consumer thread).
+    Engages only with ``pipeline_depth >= 1`` (the depth-0 oracle is
+    synchronous); it pays off when host encode/pack dominates —
+    64-bit keys, ``pack_spill`` on, f64-on-TPU — and is wasted width
+    when the device histogram is already the wall.
+
     ``retry`` configures the resilience policies (see
     :func:`streaming_kselect_many` and docs/ROBUSTNESS.md): ``None`` =
     the bounded-retry default, ``"off"`` = fail on the first transient,
@@ -875,6 +962,7 @@ def streaming_kselect(
         fused=fused,
         width_schedule=width_schedule,
         pack_spill=pack_spill,
+        ingest_workers=ingest_workers,
         retry=retry,
         obs=obs,
     )[0]
@@ -897,6 +985,7 @@ def streaming_kselect_many(
     fused=DEFAULT_FUSED,
     width_schedule=DEFAULT_WIDTH_SCHEDULE,
     pack_spill=DEFAULT_PACK_SPILL,
+    ingest_workers=None,
     retry=None,
     obs=None,
 ):
@@ -957,6 +1046,7 @@ def streaming_kselect_many(
     width_schedule = validate_width_schedule(width_schedule)
     pack_spill = _sp.validate_pack_spill(pack_spill)
     pipeline_depth = _pl.validate_pipeline_depth(pipeline_depth)
+    pool_n = _pl.resolve_ingest_workers(ingest_workers)
     devs = _pl.resolve_stream_devices(devices)
     defer = _ex.resolve_deferred(deferred)
     # fusion is a deferral discipline: the fused handle materializes at
@@ -977,15 +1067,18 @@ def streaming_kselect_many(
     stream_kw = dict(
         pipeline_depth=pipeline_depth, timer=timer,
         devices=None if devices is None else devs,
-        retry=policy, obs=obs,
+        retry=policy, obs=obs, workers=pool_n,
     )
+    _wr.ingest_workers_gauge(obs, pool_n)
     ks = [int(k) for k in ks]
     if not ks:
         return []
 
     store, own_store, read_gen = _resolve_spill(source, spill, spill_dir)
     one_shot = _is_one_shot_source(source)
-    src = as_chunk_source(source, one_shot_ok=store is not None, mmap=defer)
+    src = as_chunk_source(
+        source, one_shot_ok=store is not None, mmap=defer, workers=pool_n,
+    )
     if policy is not None and not one_shot:
         # mid-pass re-pull for transient source errors (replayable
         # sources only — a consumed generator cannot be re-invoked; its
@@ -1008,7 +1101,9 @@ def streaming_kselect_many(
         # own exact filters, so consumers see every key they would have
         # selected from the full read (spill.py:iter_chunks)
         if read_gen is not None:
-            return read_gen.as_source(mmap=defer, filter_specs=filter_specs)
+            return read_gen.as_source(
+                mmap=defer, filter_specs=filter_specs, workers=pool_n
+            )
         return src
 
     def _fallback_src():
@@ -1018,7 +1113,7 @@ def streaming_kselect_many(
         if not one_shot:
             return src
         if protected is not None and not protected.dropped:
-            return protected.as_source(mmap=defer)
+            return protected.as_source(mmap=defer, workers=pool_n)
         return None  # pragma: no cover - one-shot descents always anchor gen 0
 
     def _log_pass(label, wrote=None, *, keys_read=None, read=None,
@@ -1577,6 +1672,7 @@ def streaming_kselect_many(
                         hist_method=method, obs=obs,
                         read_from=read_from, disk_bytes_read=disk,
                         deferred=defer, fused=fuse, retry=policy,
+                        ingest_workers=pool_n,
                     ),
                     read_from,
                     int(kr),
@@ -1637,7 +1733,7 @@ def streaming_rank_certificate(
     source, value, *, pipeline_depth: int = DEFAULT_PIPELINE_DEPTH, timer=None,
     devices=None, deferred=DEFAULT_DEFERRED, fused=DEFAULT_FUSED,
     width_schedule=DEFAULT_WIDTH_SCHEDULE, pack_spill=DEFAULT_PACK_SPILL,
-    retry=None, obs=None,
+    ingest_workers=None, retry=None, obs=None,
 ):
     """``(#elements < value, #elements <= value)`` streamed — the O(n)
     exactness proof of utils/debug.py:rank_certificate without residency:
@@ -1675,7 +1771,10 @@ def streaming_rank_certificate(
     a single comparison pass with no digit histogram to widen and no
     survivor generation to pack. Reading a PACKED store-as-source works
     regardless — record format is a property of the store, not the
-    reader."""
+    reader. ``ingest_workers`` (see :func:`streaming_kselect`) widens
+    the host plane of the counting pass the same way — encode and
+    staging on the pool, counts folded in sequencer-preserved chunk
+    order, bit-identical at every width."""
     validate_width_schedule(width_schedule)
     _sp.validate_pack_spill(pack_spill)
     defer = _ex.resolve_deferred(deferred)
@@ -1683,12 +1782,14 @@ def streaming_rank_certificate(
     # the knob validates on the eager route too
     fused = _ex.validate_fused(fused)
     fuse = _ex.resolve_fused(fused) if defer else False
+    pool_n = _pl.resolve_ingest_workers(ingest_workers)
     policy = _fp.resolve_retry(retry)
-    src = as_chunk_source(source, mmap=defer)
+    src = as_chunk_source(source, mmap=defer, workers=pool_n)
     if policy is not None:
         src = _fp.resilient_source(src, policy, obs=obs)
     devs = _pl.resolve_stream_devices(devices)
     timer, _restore_recorder = _wr.attach_timer(obs, timer)
+    _wr.ingest_workers_gauge(obs, pool_n)
     depth = _pl.validate_pipeline_depth(pipeline_depth)
     # gate staging on the raw knobs, not the resolved tuple (KSL022): an
     # explicit single device must stage committed, not host-fold
@@ -1702,6 +1803,7 @@ def streaming_rank_certificate(
             src, pipeline_depth=pipeline_depth, timer=timer,
             hist_method="auto" if staged else None,
             devices=devs if staged else None, retry=policy, obs=obs,
+            workers=pool_n,
         ) as kc:
             for keys, chunk in kc:
                 if vkey is None:

@@ -50,6 +50,13 @@ Determinism contract (the grid tests/test_executor.py enforces):
   release — exactly the pre-executor serial discipline, which is what
   makes ``deferred="off"`` a bit-for-bit oracle rather than a near
   re-implementation.
+- The executor consumes chunks from ONE thread in stream order, and the
+  parallel host data plane (``ingest_workers`` > 1,
+  streaming/pipeline.py) preserves that: its reorder sequencer releases
+  chunks to this consumer strictly in chunk-index order, so the FIFO
+  window's push/pop sequence — and therefore every dispatch, fold and
+  release order above — is identical at every pool width. Nothing in
+  this module is pool-aware; the contract is upheld upstream.
 
 On top of the deferral, the ``fused`` knob (default ``"auto"``)
 collapses a pass's per-chunk device programs — the histogram, the

@@ -211,7 +211,8 @@ class RadixSketch:
 
     def update_stream(
         self, source, *, pipeline_depth=None, timer=None, devices=None,
-        spill=None, fused=None, pack_spill=None, obs=None,
+        spill=None, fused=None, pack_spill=None, ingest_workers=None,
+        obs=None,
     ) -> "RadixSketch":
         """Fold EVERY chunk of a replayable/listed ``source`` in (one
         stream pass), drawing from the pipelined iterator: a background
@@ -257,6 +258,13 @@ class RadixSketch:
         ``None`` default) keeps the full-width v1 records. Bit-identical
         answers either way.
 
+        ``ingest_workers`` widens the host data plane exactly as in
+        ``streaming_kselect``: ``"auto"``/an int > 1 runs the stream
+        pass's encode + spill-tee pack + staging on a pool of
+        ``ksel-ingest-*`` workers behind a reorder sequencer, 1 (the
+        ``None`` default) is the byte-for-byte single-producer path.
+        The fold itself (and its chunk order) is unchanged either way.
+
         ``obs`` (an :class:`~mpi_k_selection_tpu.obs.Observability`) emits
         per-chunk ingest events, a ``sketch.pass`` summary event, window
         occupancy samples and the StagingPool counters — off by default,
@@ -277,6 +285,7 @@ class RadixSketch:
 
         pipeline_depth = _pl.validate_pipeline_depth(pipeline_depth)
         pack_spill = _sp.validate_pack_spill(pack_spill)
+        pool_n = _pl.resolve_ingest_workers(ingest_workers)
         devs = _pl.resolve_stream_devices(devices)
         # the staged fold is deferred by construction (it rides the FIFO
         # window), so the tier resolves unconditionally
@@ -293,7 +302,10 @@ class RadixSketch:
                 "update_stream's spill must be a SpillStore (the caller "
                 f"owns its lifecycle), got {type(spill).__name__!r}"
             )
-        src = as_chunk_source(source, one_shot_ok=spill is not None)
+        src = as_chunk_source(
+            source, one_shot_ok=spill is not None, workers=pool_n
+        )
+        _wr.ingest_workers_gauge(obs, pool_n)
         writer = (
             spill.new_generation(
                 pack_digit_bits=(
@@ -322,6 +334,7 @@ class RadixSketch:
                 hist_method="scatter" if staged else None,
                 devices=devs if staged else None,
                 spill=writer,
+                workers=pool_n,
             ) as kc:
                 for keys, _ in kc:
                     if obs is not None:

@@ -36,12 +36,28 @@ buckets. Records where packing would not help (directory-dominated tiny
 chunks) fall back to v1 per record, so a generation's physical bytes
 (``nbytes``) never exceed its logical bytes (``logical_nbytes``).
 
+Each v2 directory entry also lands in a GENERATION-LEVEL segment index
+(:class:`SpillGeneration` hoists every record's ``(resolved, prefix,
+count, crc)`` layout plus computed offsets into one in-memory map at
+commit), so pruned replays seek straight to their segments without
+re-reading each record's on-disk directory — deleting the per-record
+directory tax that could push a small pruned read's physical bytes above
+its logical bytes. The on-disk directory stays authoritative and v2
+records remain readable without any index (external readers, pre-index
+stores). The read side also mirrors the write side's ingest pool:
+``iter_chunks(workers=n)`` decodes records (file read + CRC + bit
+unpack) on ``ksel-ingest-decode-*`` threads while still yielding
+strictly in record order.
+
 Records are bucket-sized and keyed by ``(chunk_index, bucket, dtype,
 device)`` — the :class:`~mpi_k_selection_tpu.streaming.pipeline.
 StagingPool` key plus the chunk index — so a replay re-stages every chunk
 onto the round-robin device that already compiled its bucket programs,
 preserving the chunk->device determinism contract of the multi-device
-ingest. Every record carries a CRC32 and a full metadata header; any
+ingest. The write itself splits into an order-free ``prepare`` (pack +
+checksum, safe from any ingest worker) and a sequencer-serialized
+``append_prepared`` (index assignment + disk write), so a pooled ingest
+plane produces byte-identical generations to the single-threaded path. Every record carries a CRC32 and a full metadata header; any
 mismatch raises :class:`~mpi_k_selection_tpu.errors.SpillRecordError`
 before a single key reaches a histogram (a corrupt cache fails loudly,
 never answers wrong).
@@ -72,10 +88,13 @@ or truncated survivors.
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import os
+import queue
 import shutil
 import struct
 import tempfile
+import threading
 import zlib
 
 import numpy as np
@@ -83,7 +102,10 @@ import numpy as np
 from mpi_k_selection_tpu.errors import SpillError, SpillRecordError
 from mpi_k_selection_tpu.faults.inject import maybe_fault as _maybe_fault
 from mpi_k_selection_tpu.obs import ledger as _ledger
-from mpi_k_selection_tpu.resource_protocols import SPILL_DIR_PREFIX
+from mpi_k_selection_tpu.resource_protocols import (
+    INGEST_THREAD_PREFIX,
+    SPILL_DIR_PREFIX,
+)
 from mpi_k_selection_tpu.streaming.pipeline import _bucket_elems
 
 # SPILL_DIR_PREFIX (imported above): temp-directory prefix for
@@ -138,6 +160,10 @@ GEN0_SEGMENT_BITS = 8
 #: the bit stream is byte-aligned and pack/unpack can work in bounded
 #: memory without splitting a byte across slices.
 _PACK_SLICE = 1 << 16
+
+# distinguishes concurrent pooled reads' thread names (the conftest leak
+# fixture matches on the INGEST_THREAD_PREFIX family either way)
+_DECODE_IDS = itertools.count()
 
 
 def validate_pack_spill(pack_spill):
@@ -224,9 +250,12 @@ def _pack_payload(keys: np.ndarray, specs, total_bits: int):
     ``total_bits - resolved`` bits, CRC'ing each segment's packed bytes
     into its directory entry. Returns ``(tail, dir_nbytes, segments)``:
     the directory + payloads as one contiguous uint8 array, the
-    directory's byte length, and the ``(resolved, prefix, count)``
-    layout tuple the writer records for static pruned-read accounting.
-    A key matching NO spec is a tee-filter bug and raises
+    directory's byte length, and the ``(resolved, prefix, count,
+    payload_crc32)`` layout tuple the writer records — the raw material
+    of the GENERATION-level segment index (static pruned-read
+    accounting, and direct-seek pruned reads that skip the on-disk
+    per-record directory entirely). A key matching NO spec is a
+    tee-filter bug and raises
     :class:`~mpi_k_selection_tpu.errors.SpillError` loudly."""
     u = np.ascontiguousarray(keys).astype(np.uint64)
     ordered = sorted(specs, key=lambda s: (-s[0], s[1]))
@@ -287,20 +316,23 @@ def _pack_payload(keys: np.ndarray, specs, total_bits: int):
                 "specs disagree (a bug in streaming/chunked.py, not in the "
                 "stream)"
             )
+    crcs = [
+        zlib.crc32(payload.data) & 0xFFFFFFFF for *_, payload in segments
+    ]
     parts = [np.frombuffer(_SEG_COUNT.pack(len(segments)), np.uint8)]
-    for resolved, prefix, count, payload in segments:
+    for (resolved, prefix, count, _payload), seg_crc in zip(segments, crcs):
         parts.append(
             np.frombuffer(
-                _SEG_ENTRY.pack(
-                    resolved, prefix, count,
-                    zlib.crc32(payload.data) & 0xFFFFFFFF,
-                ),
+                _SEG_ENTRY.pack(resolved, prefix, count, seg_crc),
                 np.uint8,
             )
         )
     parts.extend(payload for *_, payload in segments)
     dir_nbytes = _SEG_COUNT.size + len(segments) * _SEG_ENTRY.size
-    layout = tuple((r, p, c) for r, p, c, _ in segments)
+    layout = tuple(
+        (r, p, c, seg_crc)
+        for (r, p, c, _), seg_crc in zip(segments, crcs)
+    )
     return np.concatenate(parts), dir_nbytes, layout
 
 
@@ -321,7 +353,7 @@ def _segment_matches(r_seg: int, p_seg: int, specs) -> bool:
 
 
 def _read_packed(read_at, nbytes, n_valid, key_dt, dir_crc, path,
-                 filter_specs=None) -> np.ndarray:
+                 filter_specs=None, seg_index=None) -> np.ndarray:
     """Directory-driven v2 record read: validate the segment directory
     (its own CRC is the record header's ``crc32``), then read, checksum
     and reconstruct each segment — ONLY the segments matching
@@ -332,8 +364,38 @@ def _read_packed(read_at, nbytes, n_valid, key_dt, dir_crc, path,
     skips real I/O on both routes); any truncation, count/size
     inconsistency or checksum mismatch raises
     :class:`~mpi_k_selection_tpu.errors.SpillRecordError` before a single
-    key reaches a consumer."""
+    key reaches a consumer.
+
+    ``seg_index`` (a generation-level ``(resolved, prefix, count,
+    payload_crc, offset, nbytes)`` tuple — :class:`SpillGeneration`'s
+    hoisted copy of this record's directory) turns a PRUNED read into
+    direct seeks: the matching segments are read and per-segment
+    checksummed without touching the on-disk directory at all, so a
+    small pruned read stops paying the per-record directory tax (the
+    overhead that could push physical read bytes above logical on
+    directory-dominated records). Full reads keep the directory-driven
+    path — the header-crc-validates-directory defense is unchanged
+    there — and v2 records stay readable without any index."""
     total_bits = key_dt.itemsize * 8
+    if seg_index is not None and filter_specs is not None:
+        parts = []
+        for r, p, c, seg_crc, off, nb in seg_index:
+            if not c or not _segment_matches(r, p, filter_specs):
+                continue
+            buf = read_at(off, nb)
+            if (zlib.crc32(buf) & 0xFFFFFFFF) != seg_crc:
+                raise SpillRecordError(
+                    f"spill record {path}: checksum mismatch (corrupt "
+                    f"segment resolved={r} prefix={p:#x})"
+                )
+            width = total_bits - r
+            low = _unpack_low_bits(buf, c, width)
+            if r:
+                low |= np.uint64(p << width)
+            parts.append(low.astype(key_dt))
+        if not parts:
+            return np.empty((0,), key_dt)
+        return parts[0] if len(parts) == 1 else np.concatenate(parts)
     if nbytes < _SEG_COUNT.size:
         raise SpillRecordError(
             f"spill record {path}: truncated segment directory"
@@ -422,9 +484,11 @@ class SpillRecord:
     crc32: int
     nbytes: int
     version: int = _VERSION
-    #: v2 records: the ``(resolved, prefix, count)`` segment layout the
-    #: writer produced — what :meth:`SpillGeneration.read_nbytes` prices
-    #: a pruned read against without touching disk. ``None`` for v1.
+    #: v2 records: the ``(resolved, prefix, count, payload_crc32)``
+    #: segment layout the writer produced — what
+    #: :meth:`SpillGeneration.read_nbytes` prices a pruned read against
+    #: without touching disk, and the raw material of the generation's
+    #: segment index (direct-seek pruned reads). ``None`` for v1.
     segments: tuple | None = None
 
     @property
@@ -448,6 +512,23 @@ class SpillChunk:
     device_slot: int | None
     chunk_index: int
     bucket: int
+
+
+@dataclasses.dataclass(frozen=True)
+class PreparedSpillRecord:
+    """The order-free half of one spill append: keys packed (or not) and
+    checksummed, but not yet assigned a record index or written to disk.
+    :meth:`SpillWriter.prepare` builds these from ANY thread (the ingest
+    pool's pack phase); :meth:`SpillWriter.append_prepared` turns one
+    into an on-disk record on the sequencer-serialized in-order path."""
+
+    n: int
+    key_dtype: np.dtype
+    orig_dtype: np.dtype
+    version: int
+    payload: np.ndarray
+    crc: int
+    segments: tuple | None
 
 
 class SpillWriter:
@@ -499,29 +580,17 @@ class SpillWriter:
         self._count = 0
         self._done = False
 
-    def append(self, keys: np.ndarray, orig_dtype, device_slot=None) -> SpillRecord:
-        """Write one chunk's encoded keys as a record. ``keys`` must be a
-        host key-space array (the caller materializes device survivors);
-        ``orig_dtype`` is the STREAM dtype the keys encode (recorded so a
-        replay validates against the stream like any other chunk)."""
-        if self._done:
-            raise SpillError("spill generation already committed/aborted")
-        # chaos hook, keyed by the record index WITHIN the generation
-        # (ENOSPC, transient raise) — stable across recovery re-runs: a
-        # re-run pass builds a fresh writer whose counts restart, so
-        # re-appending record i advances the (site, i) ATTEMPT counter
-        # instead of landing on a fresh index, which is what lets a plan
-        # schedule both one-shot and hard write faults. Fires BEFORE
-        # anything touches disk, so a recovered pass re-appends cleanly;
-        # a real mid-write ENOSPC surfaces from the open/write below as
-        # the same OSError class either way.
-        _maybe_fault("spill.write", index=self._count)
+    def prepare(self, keys: np.ndarray, orig_dtype) -> PreparedSpillRecord:
+        """The order-free half of :meth:`append`: ravel, derive the pack
+        specs, pack, checksum. Reads only the writer's IMMUTABLE config
+        (``_pack_specs``/``_total_bits``/``_pack_digit_bits``), so any
+        ingest-pool worker may call it concurrently and out of order —
+        no record index is assigned and nothing touches disk until
+        :meth:`append_prepared` runs on the in-order path."""
         keys = np.ascontiguousarray(keys)
         if keys.ndim != 1:  # pragma: no cover - callers always ravel
             keys = keys.ravel()
         n = int(keys.shape[0])
-        slot = -1 if device_slot is None else int(device_slot)
-        rec_path = os.path.join(self.path, f"r{self._count:08d}.kspill")
         specs, total_bits = self._pack_specs, self._total_bits
         if specs is None and self._pack_digit_bits is not None and n:
             # digit-segmented tee: specs derive from the record's own
@@ -548,37 +617,82 @@ class SpillWriter:
             payload[:dir_nbytes].data if version == _VERSION_PACKED
             else payload.data
         ) & 0xFFFFFFFF
+        return PreparedSpillRecord(
+            n=n,
+            key_dtype=np.dtype(keys.dtype),
+            orig_dtype=np.dtype(orig_dtype),
+            version=version,
+            payload=payload,
+            crc=crc,
+            segments=layout,
+        )
+
+    def append_prepared(
+        self, prep: PreparedSpillRecord, device_slot=None
+    ) -> SpillRecord:
+        """Write one prepared record to disk as the NEXT record of the
+        generation — the ordered half of :meth:`append`, called from
+        exactly one thread at a time in stream order (the pipeline's
+        sequencer serializes the ingest pool onto this path)."""
+        if self._done:
+            raise SpillError("spill generation already committed/aborted")
+        # chaos hook, keyed by the record index WITHIN the generation
+        # (ENOSPC, transient raise) — stable across recovery re-runs: a
+        # re-run pass builds a fresh writer whose counts restart, so
+        # re-appending record i advances the (site, i) ATTEMPT counter
+        # instead of landing on a fresh index, which is what lets a plan
+        # schedule both one-shot and hard write faults — and stable
+        # across ingest-pool widths, because the index is assigned at
+        # in-order write time, not at pack time. Fires BEFORE anything
+        # touches disk, so a recovered pass re-appends cleanly; a real
+        # mid-write ENOSPC surfaces from the open/write below as the
+        # same OSError class either way.
+        _maybe_fault("spill.write", index=self._count)
+        slot = -1 if device_slot is None else int(device_slot)
+        rec_path = os.path.join(self.path, f"r{self._count:08d}.kspill")
         header = _HEADER.pack(
             _MAGIC,
-            version,
+            prep.version,
             self._count,
-            n,
-            _bucket_elems(n),
+            prep.n,
+            _bucket_elems(prep.n),
             slot,
-            _pack_dtype(keys.dtype),
-            _pack_dtype(orig_dtype),
-            crc,
-            payload.nbytes,
+            _pack_dtype(prep.key_dtype),
+            _pack_dtype(prep.orig_dtype),
+            prep.crc,
+            prep.payload.nbytes,
         )
         with open(rec_path, "wb") as f:
             f.write(header)
-            f.write(payload.data)
+            f.write(prep.payload.data)
         rec = SpillRecord(
             path=rec_path,
             chunk_index=self._count,
-            n_valid=n,
-            bucket=_bucket_elems(n),
+            n_valid=prep.n,
+            bucket=_bucket_elems(prep.n),
             device_slot=device_slot,
-            key_dtype=np.dtype(keys.dtype),
-            orig_dtype=np.dtype(orig_dtype),
-            crc32=crc,
-            nbytes=int(payload.nbytes),
-            version=version,
-            segments=layout,
+            key_dtype=prep.key_dtype,
+            orig_dtype=prep.orig_dtype,
+            crc32=prep.crc,
+            nbytes=int(prep.payload.nbytes),
+            version=prep.version,
+            segments=prep.segments,
         )
         self._records.append(rec)
         self._count += 1
         return rec
+
+    def append(self, keys: np.ndarray, orig_dtype, device_slot=None) -> SpillRecord:
+        """Write one chunk's encoded keys as a record. ``keys`` must be a
+        host key-space array (the caller materializes device survivors);
+        ``orig_dtype`` is the STREAM dtype the keys encode (recorded so a
+        replay validates against the stream like any other chunk).
+        Composition of :meth:`prepare` + :meth:`append_prepared` — the
+        single-threaded legacy shape, byte-identical on disk to the
+        pooled split."""
+        return self.append_prepared(
+            self.prepare(keys, orig_dtype), device_slot=device_slot
+        )
 
     def commit(self) -> "SpillGeneration":
         """Finalize: register the generation with the store and return it."""
@@ -609,6 +723,28 @@ class SpillGeneration:
         self.path = path
         self.records = records
         self.dropped = False
+        # generation-level segment index: the per-record v2 directories
+        # hoisted into one in-memory map (chunk_index -> ((resolved,
+        # prefix, count, payload_crc, offset, nbytes), ...)), offsets
+        # relative to the payload start. Pruned reads seek straight to
+        # matching segments through this instead of re-reading each
+        # record's on-disk directory — the per-record directory tax that
+        # could push a small pruned read's physical bytes above logical.
+        # Records written before the 4-tuple layout (no per-segment crc)
+        # stay index-less and keep the directory-driven read.
+        seg_index = {}
+        for rec in records:
+            if rec.segments is None or any(len(s) != 4 for s in rec.segments):
+                continue
+            bits = rec.key_dtype.itemsize * 8
+            off = _SEG_COUNT.size + len(rec.segments) * _SEG_ENTRY.size
+            entries = []
+            for r, p, c, seg_crc in rec.segments:
+                nb = (c * (bits - r) + 7) // 8
+                entries.append((r, p, c, seg_crc, off, nb))
+                off += nb
+            seg_index[rec.chunk_index] = tuple(entries)
+        self._seg_index = seg_index
 
     @property
     def nbytes(self) -> int:
@@ -631,7 +767,8 @@ class SpillGeneration:
     def keys(self) -> int:
         return sum(r.n_valid for r in self.records)
 
-    def iter_chunks(self, mmap: bool = False, filter_specs=None):
+    def iter_chunks(self, mmap: bool = False, filter_specs=None,
+                    workers: int = 1):
         """Yield every record as a :class:`SpillChunk`, validating headers,
         sizes and checksums — any mismatch raises
         :class:`~mpi_k_selection_tpu.errors.SpillRecordError`. With
@@ -648,24 +785,114 @@ class SpillGeneration:
         bit-identical while the generation's I/O shrinks to the surviving
         buckets. v1 records have no directory and are always read whole;
         records left with no matching segment (or no keys) are skipped
-        entirely."""
+        entirely.
+
+        ``workers`` > 1 decodes records on a pool of
+        ``ksel-ingest-decode-*`` threads (file read + CRC + v2 bit
+        unpack off the consumer thread, both heap and mmap routes) while
+        this generator still yields strictly in record order — the read
+        side's mirror of the ingest pool, same chunks in the same order
+        as the serial path. Decode-ahead is bounded (pool + 2 records)
+        so a slow consumer never forces the whole generation resident."""
         if self.dropped:
             raise SpillError(
                 f"spill generation {self.index} was dropped (or its store "
                 "closed); it can no longer serve as a chunk source"
             )
+        pool_n = min(int(workers), len(self.records))
+        if pool_n > 1:
+            yield from self._iter_chunks_pooled(pool_n, mmap, filter_specs)
+            return
         for rec in self.records:
-            chunk = _read_record(rec, mmap=mmap, filter_specs=filter_specs)
+            chunk = _read_record(
+                rec, mmap=mmap, filter_specs=filter_specs,
+                seg_index=self._seg_index.get(rec.chunk_index),
+            )
             if filter_specs is not None and chunk.keys.shape[0] == 0:
                 continue
             yield chunk
 
-    def as_source(self, mmap: bool = False, filter_specs=None):
+    def _iter_chunks_pooled(self, pool_n: int, mmap, filter_specs):
+        """Worker-pool decode: each ``ksel-ingest-decode-*`` thread pulls
+        record indices, runs ``_read_record`` OUTSIDE any lock, and
+        parks the result (or the exception) for the main generator to
+        release in index order. Every record still passes through
+        ``_read_record`` — the ``spill.read`` chaos hook and header
+        validation fire per record exactly as on the serial path, so
+        seeded fault plans replay identically at any pool width."""
+        gen_id = next(_DECODE_IDS)
+        window = pool_n + 2  # bounded decode-ahead
+        tasks = queue.Queue()
+        for i in range(len(self.records)):
+            tasks.put(i)
+        stop = threading.Event()
+        cond = threading.Condition()
+        results = {}  # ksel: guarded-by[cond]
+        state = {"next": 0}  # ksel: guarded-by[cond]
+
+        def _decode():
+            while not stop.is_set():
+                try:
+                    i = tasks.get_nowait()
+                except queue.Empty:
+                    return
+                with cond:
+                    while (
+                        i >= state["next"] + window and not stop.is_set()
+                    ):
+                        cond.wait(0.05)
+                if stop.is_set():
+                    return
+                rec = self.records[i]
+                try:
+                    out = _read_record(
+                        rec, mmap=mmap, filter_specs=filter_specs,
+                        seg_index=self._seg_index.get(rec.chunk_index),
+                    )
+                except BaseException as e:  # noqa: BLE001 - surfaced in order
+                    out = e
+                with cond:
+                    results[i] = out
+                    cond.notify_all()
+
+        threads = [
+            threading.Thread(
+                target=_decode,
+                name=f"{INGEST_THREAD_PREFIX}-decode-{gen_id}-{w}",
+                daemon=True,
+            )
+            for w in range(pool_n)
+        ]
+        for t in threads:
+            t.start()
+        try:
+            for i in range(len(self.records)):
+                with cond:
+                    while i not in results:
+                        cond.wait(0.05)
+                    chunk = results.pop(i)
+                    state["next"] = i + 1
+                    cond.notify_all()
+                if isinstance(chunk, BaseException):
+                    raise chunk
+                if filter_specs is not None and chunk.keys.shape[0] == 0:
+                    continue
+                yield chunk
+        finally:
+            stop.set()
+            with cond:
+                cond.notify_all()
+            for t in threads:
+                t.join(timeout=10.0)
+
+    def as_source(self, mmap: bool = False, filter_specs=None,
+                  workers: int = 1):
         """Zero-arg callable returning a fresh record iterator — the
         replayable chunk-source form streaming/chunked.py consumes.
-        ``filter_specs`` prunes v2 records to matching segments (see
+        ``filter_specs`` prunes v2 records to matching segments;
+        ``workers`` > 1 decodes on a thread pool (see
         :meth:`iter_chunks`)."""
-        if not mmap and filter_specs is None:
+        if not mmap and filter_specs is None and workers <= 1:
             return self.iter_chunks
         import functools
 
@@ -675,14 +902,18 @@ class SpillGeneration:
                 None if filter_specs is None
                 else tuple((int(r), int(p)) for r, p in filter_specs)
             ),
+            workers=int(workers),
         )
 
     def read_nbytes(self, filter_specs=None) -> int:
         """PHYSICAL bytes a (possibly pruned) read of this generation
-        touches: every v1 record whole; for v2 records the directory plus
-        the segments matching ``filter_specs`` — priced statically from
-        the writers' recorded segment layouts, so the descent's disk
-        accounting needs no second pass over the files."""
+        touches: every v1 record whole; for v2 records the segments
+        matching ``filter_specs`` — plus the on-disk directory only for
+        records the generation-level segment index does not cover (an
+        indexed pruned read seeks straight to its segments and never
+        touches the directory). Priced statically from the writers'
+        recorded segment layouts, so the descent's disk accounting needs
+        no second pass over the files."""
         if filter_specs is None:
             return self.nbytes
         specs = tuple((int(r), int(p)) for r, p in filter_specs)
@@ -692,10 +923,11 @@ class SpillGeneration:
                 total += rec.nbytes
                 continue
             bits = rec.key_dtype.itemsize * 8
-            total += _SEG_COUNT.size + len(rec.segments) * _SEG_ENTRY.size
+            if rec.chunk_index not in self._seg_index:
+                total += _SEG_COUNT.size + len(rec.segments) * _SEG_ENTRY.size
             total += sum(
                 (c * (bits - r) + 7) // 8
-                for r, p, c in rec.segments
+                for r, p, c, *_ in rec.segments
                 if _segment_matches(r, p, specs)
             )
         return total
@@ -712,7 +944,7 @@ class SpillGeneration:
                 total += rec.n_valid
             else:
                 total += sum(
-                    c for r, p, c in rec.segments
+                    c for r, p, c, *_ in rec.segments
                     if _segment_matches(r, p, specs)
                 )
         return total
@@ -725,7 +957,7 @@ class SpillGeneration:
 
 
 def _read_record(
-    rec: SpillRecord, mmap: bool = False, filter_specs=None
+    rec: SpillRecord, mmap: bool = False, filter_specs=None, seg_index=None
 ) -> SpillChunk:
     # chaos hook, keyed by the record's chunk index: transient raises and
     # checksum blips fire here; the persistent kinds (corrupt_disk,
@@ -804,7 +1036,7 @@ def _read_record(
 
             keys = _read_packed(
                 _file_at, int(nbytes), int(n_valid), key_dt, crc, rec.path,
-                filter_specs,
+                filter_specs, seg_index=seg_index,
             )
     if mmap and n_valid == 0:  # pragma: no cover - writers skip empty chunks
         keys = np.empty((0,), key_dt)
@@ -840,7 +1072,7 @@ def _read_record(
 
             keys = _read_packed(
                 _mem_at, int(nbytes), int(n_valid), key_dt, crc, rec.path,
-                filter_specs,
+                filter_specs, seg_index=seg_index,
             )
     return SpillChunk(
         keys=keys,

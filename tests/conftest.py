@@ -36,7 +36,9 @@ def _no_leaked_pipeline_threads():
     """Every package-owned thread must be joined by the time its owner
     returns/closes — normally AND on every raise/injected-fault path.
     All such threads carry the ``ksel-`` name prefix (``ksel-pipeline-*``
-    producers, ``ksel-serve-*``: the per-device dispatch-lane threads
+    producers/pullers, ``ksel-ingest-*``: the parallel data plane's
+    encode/pack/stage workers and the spill read side's
+    ``ksel-ingest-decode-*`` pool, ``ksel-serve-*``: the per-device dispatch-lane threads
     (``ksel-serve-lane-<key>-dispatch-*``, serve/lanes.py) and the
     standalone batcher's SUPERVISED dispatch thread — restarts reuse the
     same thread, so its name survives a crash-recover cycle — the HTTP
@@ -56,10 +58,14 @@ def _no_leaked_pipeline_threads():
     # the generic match, and that the live constants ARE the registry's
     from mpi_k_selection_tpu.monitor.monitor import MONITOR_THREAD_PREFIX
     from mpi_k_selection_tpu.serve.batcher import SERVE_THREAD_PREFIX
-    from mpi_k_selection_tpu.streaming.pipeline import THREAD_NAME_PREFIX
+    from mpi_k_selection_tpu.streaming.pipeline import (
+        INGEST_THREAD_PREFIX,
+        THREAD_NAME_PREFIX,
+    )
 
     assert set(_rp.THREAD_PREFIXES) == {
-        THREAD_NAME_PREFIX, SERVE_THREAD_PREFIX, MONITOR_THREAD_PREFIX
+        THREAD_NAME_PREFIX, INGEST_THREAD_PREFIX, SERVE_THREAD_PREFIX,
+        MONITOR_THREAD_PREFIX,
     }
     for prefix in _rp.RESOURCE_PREFIXES:
         assert prefix.startswith(_rp.KSEL_PREFIX)

@@ -772,6 +772,13 @@ def test_cli_concurrency_report(tmp_path, capsys):
     assert data["lock_graph"]["cycles"] == []
     assert any("PendingQuery" in k for k in data["guards"])
     assert any("StagingPool" in k for k in data["guards"])
+    # the ingest pool's reorder sequencer (a Condition IS a lock) is in
+    # the exported graph, so the runtime sanitizer can order it against
+    # every other package lock
+    assert any(
+        k.endswith("ChunkPipeline._cond") for k in data["lock_graph"]["nodes"]
+    )
+    assert any(k.endswith("ChunkPipeline") for k in data["guards"])
     # node sites are package-relative regardless of the scan's cwd/root,
     # so they join the runtime sanitizer's labels (review finding, PR 12)
     for node in data["lock_graph"]["nodes"].values():
@@ -971,6 +978,18 @@ def _chaos_descent(san):
             collect_budget=64,
         )
     assert int(got) == int(np.sort(x, kind="stable")[k - 1])
+    # the pooled host data plane under the same chaos plan: the reorder
+    # sequencer's Condition + the ingest workers contend with the spill
+    # writer and the injector under the sanitizer, and the recovered
+    # answer is identical
+    plan2 = faults.FaultPlan.seeded(11, n_chunks=len(chunks), faults=3)
+    with faults.inject(plan2, sleeper=faults.VirtualSleeper()) as inj:
+        got_pooled = streaming_kselect(
+            inj.wrap_chunk_source(lambda: iter(chunks)), k,
+            spill="force", devices=2, retry=policy, radix_bits=4,
+            collect_budget=64, ingest_workers=3,
+        )
+    assert int(got_pooled) == int(got)
 
 
 def _monitor_run(san):

@@ -1,0 +1,340 @@
+"""Parallel host data plane: the multi-worker ingest pool.
+
+The contract under test: ``ingest_workers`` trades host threads for
+ingest throughput and NOTHING else. One sequential puller preserves
+source order, a pool of ``ksel-ingest-*`` workers runs encode ->
+spill-tee pack -> staging independently, and the reorder sequencer
+releases finished chunks to the consumer strictly in chunk-index order
+— so every answer is bit-identical at every pool width, spill records
+land in pull order, seeded chaos replays identically, and ``1`` is
+byte-for-byte the legacy single-producer path. The read side mirrors
+it: ``SpillGeneration.iter_chunks(workers=N)`` decodes records on a
+pool and yields them in index order.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from mpi_k_selection_tpu.backends import seq
+from mpi_k_selection_tpu.streaming import (
+    SpillStore,
+    streaming_kselect,
+    streaming_kselect_many,
+)
+from mpi_k_selection_tpu.streaming import pipeline as pl
+from mpi_k_selection_tpu.streaming.chunked import resolve_width_schedule
+from mpi_k_selection_tpu.streaming.pipeline import (
+    INGEST_THREAD_PREFIX,
+    INGEST_WORKERS_AUTO_CAP,
+    MAX_INGEST_WORKERS,
+    resolve_ingest_workers,
+)
+
+
+def _chunks(x, nchunks):
+    return [np.ascontiguousarray(c) for c in np.array_split(x, nchunks)]
+
+
+def _assert_no_ingest_threads():
+    """Every pooled path joins its workers before returning: no
+    ``ksel-ingest-*`` thread (encode pool or decode pool) survives."""
+    leaked = [
+        t.name
+        for t in threading.enumerate()
+        if t.name.startswith(INGEST_THREAD_PREFIX)
+    ]
+    assert not leaked, leaked
+
+
+# -- the knob itself ----------------------------------------------------------
+
+
+def test_resolve_ingest_workers_contract():
+    """None -> legacy 1; 'auto' -> min(cap, cores); ints validate into
+    [1, MAX]; bools and junk are refused loudly (a bool silently meaning
+    0 or 1 workers is exactly the bug the isinstance guard exists for)."""
+    import os
+
+    assert resolve_ingest_workers(None) == 1
+    auto = resolve_ingest_workers("auto")
+    assert auto == min(INGEST_WORKERS_AUTO_CAP, os.cpu_count() or 1)
+    assert 1 <= auto <= INGEST_WORKERS_AUTO_CAP
+    assert resolve_ingest_workers(1) == 1
+    assert resolve_ingest_workers(np.int64(3)) == 3
+    assert resolve_ingest_workers(MAX_INGEST_WORKERS) == MAX_INGEST_WORKERS
+    with pytest.raises(ValueError, match="out of range"):
+        resolve_ingest_workers(0)
+    with pytest.raises(ValueError, match="out of range"):
+        resolve_ingest_workers(MAX_INGEST_WORKERS + 1)
+    for junk in (True, False, 2.0, "three"):
+        with pytest.raises(ValueError, match="ingest_workers"):
+            resolve_ingest_workers(junk)
+
+
+# -- bit-equality across the full grid ----------------------------------------
+
+
+@pytest.mark.parametrize("fused", ["auto", "off"])
+def test_pool_bit_equality_grid(fused, rng):
+    """workers {1,2,4} x devices {1,2} x depth {0,2} x spill {off,force}:
+    every leg is bit-identical to the workers=1 oracle — the reorder
+    sequencer makes pool width invisible to the descent."""
+    n = 1 << 13
+    x = rng.integers(-(2**31), 2**31, size=n, dtype=np.int64).astype(np.int32)
+    ks = [1, 1337, n // 2, n]
+    want = [np.asarray(seq.kselect_sort(x, k)).item() for k in ks]
+    chunks = _chunks(x, 8)
+    for devices in (1, 2):
+        for depth in (0, 2):
+            for spill in ("off", "force"):
+                legs = {}
+                for workers in (1, 2, 4):
+                    got = streaming_kselect_many(
+                        chunks, ks, pipeline_depth=depth, devices=devices,
+                        spill=spill, collect_budget=256, fused=fused,
+                        ingest_workers=workers,
+                    )
+                    legs[workers] = [np.asarray(g).item() for g in got]
+                assert legs[1] == want, (devices, depth, spill)
+                assert legs[2] == legs[1], (devices, depth, spill)
+                assert legs[4] == legs[1], (devices, depth, spill)
+    _assert_no_ingest_threads()
+
+
+@pytest.mark.parametrize("dtype", [np.uint64, np.float64], ids=["u64", "f64"])
+def test_pool_host_exact_bypass_dtypes(dtype, rng):
+    """64-bit streams take the host-exact bypass (host histograms, no
+    device counting) — the pool parallelizes their encode too, and the
+    answer stays bit-identical, spilled or not."""
+    n = 1 << 13
+    if np.dtype(dtype).kind == "f":
+        x = (rng.standard_normal(n) * 1e6).astype(dtype)
+    else:
+        x = rng.integers(0, 1 << 63, size=n, dtype=np.int64).astype(dtype)
+    ks = [7, n // 2]
+    want = [np.asarray(seq.kselect_sort(x, k)).item() for k in ks]
+    for spill in ("off", "force"):
+        for workers in (1, 4):
+            got = streaming_kselect_many(
+                _chunks(x, 8), ks, spill=spill, collect_budget=256,
+                ingest_workers=workers,
+            )
+            assert [np.asarray(g).item() for g in got] == want, (spill, workers)
+    _assert_no_ingest_threads()
+
+
+def test_one_shot_source_under_pool(rng):
+    """A one-shot generator source streams through a 4-wide pool: the
+    sequential puller is the only consumer of the iterator (workers never
+    touch it), so one-shot-ness is preserved exactly as at workers=1."""
+    n = 1 << 13
+    x = rng.integers(0, 1 << 62, size=n, dtype=np.int64).astype(np.uint64)
+    want = seq.kselect_sort(x, 999)
+    got = streaming_kselect(
+        iter(_chunks(x, 8)), 999, spill="force", collect_budget=128,
+        ingest_workers=4,
+    )
+    assert got == want
+    _assert_no_ingest_threads()
+
+
+def test_drifting_source_raises_with_workers_in_flight(rng):
+    """A source that changes dtype mid-stream is refused by the
+    sequential puller while pool workers are in flight: the abort fence
+    poisons the pool, the TypeError propagates to the caller, and every
+    worker thread is joined — nothing leaks on the raise path."""
+    good = rng.integers(-1000, 1000, size=4096, dtype=np.int64).astype(np.int32)
+    chunks = _chunks(good, 6)
+    chunks[3] = chunks[3].astype(np.float32)  # drift after 3 clean chunks
+    with pytest.raises(
+        TypeError, match="streaming selection requires one dtype per stream"
+    ):
+        streaming_kselect(
+            chunks, 17, spill="force", collect_budget=64, ingest_workers=4
+        )
+    _assert_no_ingest_threads()
+
+
+# -- sequencer ordering under skewed work -------------------------------------
+
+
+def test_sequencer_orders_spill_under_slow_worker(rng, tmp_path):
+    """Chunk 0 is ~50x the later chunks, so with 4 workers the fast
+    chunks finish encoding long before chunk 0's worker: the sequencer
+    must hold them. Spill records are written at sequencer-release time,
+    so their chunk_index order IS the release order — assert it equals
+    pull order exactly, and the answer stays exact."""
+    big = rng.integers(-(2**31), 2**31, size=100_000, dtype=np.int64)
+    small = [
+        rng.integers(-(2**31), 2**31, size=2048, dtype=np.int64)
+        for _ in range(7)
+    ]
+    chunks = [c.astype(np.int32) for c in (big, *small)]
+    x = np.concatenate(chunks)
+    k = x.size // 2
+    with SpillStore(str(tmp_path)) as store:
+        got = streaming_kselect(
+            chunks, k, spill=store, collect_budget=64, ingest_workers=4
+        )
+        assert got == seq.kselect_sort(x, k)
+        gen0 = store.generations[min(store.generations)]
+        assert [r.chunk_index for r in gen0.records] == list(range(len(chunks)))
+    _assert_no_ingest_threads()
+
+
+def test_seeded_chaos_bit_equality_at_four_workers(rng):
+    """A seeded fault plan (stage + spill faults, virtual clock) replays
+    identically at workers=4: fault indices are pre-assigned in pull
+    order by the puller and fired at in-order write time, so WHICH
+    attempt faults cannot depend on pool scheduling."""
+    from mpi_k_selection_tpu import faults
+
+    chunks = [
+        rng.integers(-(2**31), 2**31 - 1, m, np.int64).astype(np.int32)
+        for m in (5000, 4096, 2048, 3000)
+    ]
+    x = np.concatenate(chunks)
+    k = x.size // 2
+    want = int(np.sort(x, kind="stable")[k - 1])
+    answers = []
+    for workers in (1, 4):
+        plan = faults.FaultPlan.seeded(23, n_chunks=len(chunks), faults=4)
+        policy = faults.RetryPolicy(sleeper=faults.VirtualSleeper())
+        with faults.inject(plan, sleeper=faults.VirtualSleeper()) as inj:
+            got = streaming_kselect(
+                inj.wrap_chunk_source(lambda: iter(chunks)), k,
+                spill="force", devices=2, retry=policy, radix_bits=4,
+                collect_budget=64, ingest_workers=workers,
+            )
+        answers.append(int(got))
+    assert answers == [want, want]
+    _assert_no_ingest_threads()
+
+
+# -- the pooled spill read side -----------------------------------------------
+
+
+@pytest.mark.parametrize("mmap", [False, True], ids=["read", "mmap"])
+def test_pooled_decode_matches_serial(mmap, tmp_path, rng):
+    """iter_chunks(workers=4) decodes on a pool but yields records in
+    index order with bit-identical keys — plain, mmap'd, and under a
+    segment filter (where filtered-empty records are skipped, shrinking
+    the yielded list the same way the serial path shrinks it)."""
+    keys = rng.integers(0, 1 << 63, size=30_000, dtype=np.int64).astype(np.uint64)
+    store = SpillStore(str(tmp_path))
+    w = store.new_generation(pack_digit_bits=8)
+    for part in np.array_split(keys, 7):
+        w.append(part, np.uint64)
+    gen = w.commit()
+    serial = list(gen.iter_chunks(mmap=mmap))
+    pooled = list(gen.iter_chunks(mmap=mmap, workers=4))
+    assert [c.chunk_index for c in pooled] == [c.chunk_index for c in serial]
+    for s, p in zip(serial, pooled):
+        np.testing.assert_array_equal(s.keys, p.keys)
+    top = int(keys[0] >> np.uint64(60))
+    specs = ((4, top),)
+    serial_f = list(gen.iter_chunks(filter_specs=specs))
+    pooled_f = list(gen.iter_chunks(filter_specs=specs, workers=4))
+    assert [c.chunk_index for c in pooled_f] == [
+        c.chunk_index for c in serial_f
+    ]
+    for s, p in zip(serial_f, pooled_f):
+        np.testing.assert_array_equal(s.keys, p.keys)
+    store.close()
+    _assert_no_ingest_threads()
+
+
+def test_pooled_decode_propagates_corruption(tmp_path, rng):
+    """A corrupt record raises through the pool exactly as it does
+    serially, and the decode workers are joined on the raise path."""
+    from mpi_k_selection_tpu.streaming.spill import SpillRecordError
+
+    keys = rng.integers(0, 1 << 62, size=8192, dtype=np.int64).astype(np.uint64)
+    store = SpillStore(str(tmp_path))
+    w = store.new_generation(pack_digit_bits=8)
+    for part in np.array_split(keys, 4):
+        w.append(part, np.uint64)
+    gen = w.commit()
+    rec = gen.records[2]
+    data = bytearray(open(rec.path, "rb").read())
+    data[-2] ^= 0xFF  # a byte inside the last segment's payload
+    with open(rec.path, "wb") as f:
+        f.write(data)
+    with pytest.raises(SpillRecordError):
+        list(gen.iter_chunks(workers=4))
+    _assert_no_ingest_threads()
+    store.close()
+
+
+# -- the 64-bit two-wide-pass width schedule ----------------------------------
+
+
+def test_width_schedule_auto_two_wide_passes():
+    """64-bit keys get a SECOND strictly-wide pass: auto at rb=8 is
+    (16, 16, 8x4); every width respects MAX_PASS_BITS and the KSC102
+    counter budget independently; 32-bit schedules are untouched; a
+    sketch-seeded 64-bit start below the 32-bit threshold stays
+    single-wide."""
+    from mpi_k_selection_tpu.streaming.chunked import MAX_PASS_BITS
+
+    s64 = resolve_width_schedule("auto", 64, 8)
+    assert s64 == (16, 16, 8, 8, 8, 8)
+    assert sum(s64) == 64 and all(1 <= w <= MAX_PASS_BITS for w in s64)
+    assert resolve_width_schedule("auto", 64, 4) == (16, 16) + (4,) * 8
+    # 32-bit: one wide pass only, exactly as before the 64-bit rule
+    assert resolve_width_schedule("auto", 32, 8) == (16, 8, 8)
+    assert resolve_width_schedule("auto", 32, 4) == (16, 4, 4, 4, 4)
+    # seeded start with <= 32 bits remaining: the second-pass rule never
+    # fires (remaining <= 32), even on a 64-bit stream
+    seeded = resolve_width_schedule("auto", 64, 8, start_bits=32)
+    assert seeded == (16, 8, 8)
+
+
+def test_two_wide_pass_descent_bit_identical(rng):
+    """The (16, 16, ...) schedule on real uint64 streams: bit-identical
+    to the legacy fixed schedule across spill x workers, with the
+    explicit tuple equal to what auto resolves."""
+    n = 1 << 13
+    x = rng.integers(0, 1 << 63, size=n, dtype=np.int64).astype(np.uint64)
+    ks = [1, 999, n // 2, n]
+    want = [np.asarray(seq.kselect_sort(x, k)).item() for k in ks]
+    chunks = _chunks(x, 8)
+    for spill in ("off", "force"):
+        for schedule in ("auto", "off", (16, 16, 8, 8, 8, 8)):
+            for workers in (1, 4):
+                got = streaming_kselect_many(
+                    chunks, ks, spill=spill, collect_budget=256,
+                    width_schedule=schedule, pack_spill="auto",
+                    ingest_workers=workers,
+                )
+                assert [np.asarray(g).item() for g in got] == want, (
+                    spill, schedule, workers,
+                )
+    _assert_no_ingest_threads()
+
+
+# -- observability ------------------------------------------------------------
+
+
+def test_seq_wait_phase_accounting(rng):
+    """The sequencer-stall phase exists in the phase vocabulary but not
+    in INGEST_PHASES (it measures coordination, not work — folding it
+    into work would understate encode_hidden_frac), and
+    encode_hidden_frac clamps into [0, 1] / returns None on no work."""
+    assert pl.SEQ_WAIT_PHASE == "pipeline.seq_wait"
+    assert pl.SEQ_WAIT_PHASE not in pl.INGEST_PHASES
+    assert {"pipeline.encode", "pipeline.pack", "pipeline.stage"} <= set(
+        pl.INGEST_PHASES
+    )
+
+    class _T:
+        def __init__(self, phases):
+            self.phases = phases
+
+    assert pl.encode_hidden_frac(_T({})) is None
+    full = {p: 1.0 for p in pl.INGEST_PHASES}
+    assert pl.encode_hidden_frac(_T(full)) == 1.0
+    stalled = dict(full, **{pl.STALL_PHASE: 100.0})
+    assert pl.encode_hidden_frac(_T(stalled)) == 0.0

@@ -803,16 +803,21 @@ def test_leak_fixture_vocabulary_is_the_registry():
     from mpi_k_selection_tpu.monitor.monitor import MONITOR_THREAD_PREFIX
     from mpi_k_selection_tpu.obs.flight import FLIGHT_FILE_PREFIX
     from mpi_k_selection_tpu.serve.batcher import SERVE_THREAD_PREFIX
-    from mpi_k_selection_tpu.streaming.pipeline import THREAD_NAME_PREFIX
+    from mpi_k_selection_tpu.streaming.pipeline import (
+        INGEST_THREAD_PREFIX,
+        THREAD_NAME_PREFIX,
+    )
     from mpi_k_selection_tpu.streaming.spill import SPILL_DIR_PREFIX
 
     assert THREAD_NAME_PREFIX is rp.PIPELINE_THREAD_PREFIX
+    assert INGEST_THREAD_PREFIX is rp.INGEST_THREAD_PREFIX
     assert SERVE_THREAD_PREFIX is rp.SERVE_THREAD_PREFIX
     assert MONITOR_THREAD_PREFIX is rp.MONITOR_THREAD_PREFIX
     assert SPILL_DIR_PREFIX is rp.SPILL_DIR_PREFIX
     assert FLIGHT_FILE_PREFIX is rp.FLIGHT_FILE_PREFIX
     assert set(rp.THREAD_PREFIXES) == {
-        THREAD_NAME_PREFIX, SERVE_THREAD_PREFIX, MONITOR_THREAD_PREFIX
+        THREAD_NAME_PREFIX, INGEST_THREAD_PREFIX, SERVE_THREAD_PREFIX,
+        MONITOR_THREAD_PREFIX,
     }
     for prefix in rp.RESOURCE_PREFIXES:
         assert prefix.startswith(rp.KSEL_PREFIX)

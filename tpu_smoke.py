@@ -323,6 +323,51 @@ def main() -> int:
         f"{round(wp_disk_w / wp_logical_w, 4) if wp_logical_w else None}"
     )
 
+    # --- parallel host data plane (ISSUE 20, the bench_streaming_oc
+    # _workers config at smoke scale): the ingest pool must be invisible
+    # to the answer on real silicon — workers {1, 2, auto} x devices
+    # {1, all} bit-identical on the packed-spill descent, and the pooled
+    # leg's wall reported next to workers=1 (TPU hosts have the cores
+    # the CPU-mesh CI box lacks, so this is where workers_speedup is
+    # load-bearing) ---
+    print("parallel host data plane (ingest pool):")
+    from mpi_k_selection_tpu.streaming.pipeline import (
+        encode_hidden_frac as _ehf,
+        resolve_ingest_workers as _riw,
+    )
+    from mpi_k_selection_tpu.utils.profiling import PhaseTimer as _PT
+    from mpi_k_selection_tpu.utils.timing import time_fn as _pw_time_fn
+
+    pw_auto = _riw("auto")
+    pw_walls = {}
+    for dv in sp_devgrid:
+        for wk in (1, 2, "auto"):
+            pw_t = _PT()
+            pw_secs, got_pw = _pw_time_fn(
+                lambda dv=dv, wk=wk, pw_t=pw_t: int(
+                    _sp_ksel(
+                        sp_chunks, sp_k, spill="force", devices=dv,
+                        width_schedule="auto", pack_spill="auto",
+                        ingest_workers=wk, timer=pw_t, **sp_kw,
+                    )
+                )
+            )
+            pw_walls[(dv, wk)] = (pw_secs, pw_t)
+            check(
+                f"ingest_workers={wk} devices={dv} bit-identical",
+                got_pw, want_sp,
+            )
+    pw_s1 = pw_walls[(sp_devgrid[-1], 1)][0]
+    pw_sa, pw_ta = pw_walls[(sp_devgrid[-1], "auto")]
+    pw_hidden = _ehf(pw_ta)
+    print(
+        f"    workers=1 {round(pw_s1, 4)}s vs auto({pw_auto}) "
+        f"{round(pw_sa, 4)}s; workers_speedup = "
+        f"{round(pw_s1 / pw_sa, 4) if pw_sa else None}; "
+        f"encode_hidden_frac = "
+        f"{round(pw_hidden, 4) if pw_hidden is not None else None}"
+    )
+
     # the spill-pass device_scaling the ROADMAP sweep item needs: the
     # deferred spill descent's wall at devices {1, all} (+ the eager
     # wall at devices=all as the before/after) — on real silicon these
